@@ -1,0 +1,42 @@
+"""datafusion_distributed_tpu — a TPU-native distributed columnar query engine.
+
+A ground-up JAX/XLA/Pallas re-design of the capability set of
+`datafusion-contrib/datafusion-distributed` (reference at /root/reference):
+stage-split distributed query execution, with per-stage columnar compute
+compiled by XLA onto TPU and shuffle/broadcast exchanges expressed as mesh
+collectives instead of gRPC/Arrow-Flight streams.
+
+Layering (mirrors SURVEY.md §1, re-expressed TPU-first):
+- ops/       columnar substrate + compute kernels (the DataFusion-L0 analogue)
+- plan/      physical plan IR + expression IR
+- planner/   distributed planning passes (boundary injection, task counts, …)
+- parallel/  mesh + exchange collectives (shuffle/broadcast/coalesce)
+- runtime/   coordinator/worker task runtime
+- sql/       SQL frontend (parser -> logical plan -> physical plan)
+- io/        host-side Parquet/Arrow <-> device Table
+- models/    benchmark workloads (TPC-H, ClickBench) and data generators
+"""
+
+import jax as _jax
+
+# A query engine needs real 64-bit integers (join keys at SF>=100 exceed
+# int32) and float64 accumulation for result parity with the CPU reference.
+_jax.config.update("jax_enable_x64", True)
+
+from datafusion_distributed_tpu.schema import DataType, Field, Schema  # noqa: E402
+from datafusion_distributed_tpu.ops.table import (  # noqa: E402
+    Column,
+    Dictionary,
+    Table,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "DataType",
+    "Field",
+    "Schema",
+    "Column",
+    "Dictionary",
+    "Table",
+]

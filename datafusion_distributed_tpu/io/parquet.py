@@ -1,0 +1,192 @@
+"""Host-side Parquet/Arrow -> device Table loading.
+
+The reference's scan path is DataFusion's `DataSourceExec` over Parquet
+(SURVEY.md L0) with per-task file-group slicing
+(`/root/reference/src/distributed_planner/task_estimator.rs:235-300`). On TPU
+the decode stays on the host (pyarrow), and the upload pads each batch to a
+static capacity; string columns are dictionary-encoded against a per-dataset
+unified dictionary so device-side codes are comparable across files and tasks.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from datafusion_distributed_tpu.ops.table import (
+    Column,
+    Dictionary,
+    Table,
+    round_up_pow2,
+)
+from datafusion_distributed_tpu.schema import DataType, Field, Schema
+
+
+def _arrow_type_to_dtype(t) -> DataType:
+    import pyarrow as pa
+
+    if pa.types.is_int8(t) or pa.types.is_int16(t) or pa.types.is_int32(t):
+        return DataType.INT32
+    if pa.types.is_int64(t) or pa.types.is_uint32(t) or pa.types.is_uint64(t):
+        return DataType.INT64
+    if pa.types.is_uint8(t) or pa.types.is_uint16(t):
+        return DataType.INT32
+    if pa.types.is_float32(t):
+        return DataType.FLOAT32
+    if pa.types.is_float64(t):
+        return DataType.FLOAT64
+    if pa.types.is_decimal(t):
+        return DataType.FLOAT64
+    if pa.types.is_boolean(t):
+        return DataType.BOOL
+    if pa.types.is_date(t):
+        return DataType.DATE32
+    if pa.types.is_timestamp(t):
+        return DataType.INT64
+    if pa.types.is_string(t) or pa.types.is_large_string(t) or (
+        pa.types.is_dictionary(t)
+    ):
+        return DataType.STRING
+    raise NotImplementedError(f"unsupported arrow type: {t}")
+
+
+def schema_from_arrow(arrow_schema) -> Schema:
+    return Schema(
+        [
+            Field(f.name, _arrow_type_to_dtype(f.type), nullable=f.nullable)
+            for f in arrow_schema
+        ]
+    )
+
+
+def arrow_to_host_columns(
+    arrow_table,
+    dictionaries: Optional[dict[str, Dictionary]] = None,
+) -> tuple[dict[str, np.ndarray], dict[str, np.ndarray], dict[str, Dictionary], Schema]:
+    """Arrow table -> (host data arrays, validity arrays, dictionaries, schema).
+
+    String columns become int32 code arrays. If ``dictionaries`` supplies a
+    Dictionary for a column, codes are produced against it (values missing
+    from the dictionary become -1/null); otherwise a fresh sorted dictionary
+    is built from the column's values.
+    """
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    schema = schema_from_arrow(arrow_table.schema)
+    data: dict[str, np.ndarray] = {}
+    validity: dict[str, np.ndarray] = {}
+    dicts: dict[str, Dictionary] = {}
+    for f in schema.fields:
+        col = arrow_table.column(f.name)
+        if isinstance(col, pa.ChunkedArray):
+            col = col.combine_chunks()
+        null_mask = np.asarray(col.is_valid())
+        if f.dtype == DataType.STRING:
+            if pa.types.is_dictionary(col.type):
+                col = col.cast(pa.string())
+            values = np.asarray(col.to_numpy(zero_copy_only=False), dtype=object)
+            provided = dictionaries.get(f.name) if dictionaries else None
+            if provided is not None:
+                d = provided
+                idx = d.index()
+            else:
+                d = Dictionary.from_strings(
+                    sorted({v for v in values if v is not None})
+                )
+                idx = d.index()
+            codes = np.asarray(
+                [idx.get(v, -1) if v is not None else -1 for v in values],
+                dtype=np.int32,
+            )
+            null_mask = null_mask & (codes >= 0)
+            codes = np.where(codes < 0, 0, codes)
+            data[f.name] = codes
+            dicts[f.name] = d
+        elif f.dtype == DataType.DATE32:
+            arr = col.cast(pa.date32()).to_numpy(zero_copy_only=False)
+            days = arr.astype("datetime64[D]").astype(np.int64).astype(np.int32)
+            days = np.where(null_mask, days, 0).astype(np.int32)
+            data[f.name] = days
+        elif f.dtype == DataType.BOOL:
+            arr = col.to_numpy(zero_copy_only=False)
+            arr = np.asarray(arr, dtype=object)
+            arr = np.where(null_mask, arr, False)
+            data[f.name] = arr.astype(np.bool_)
+        else:
+            # Fill nulls inside Arrow first: pyarrow's to_numpy converts
+            # nullable int columns through float64, which silently rounds
+            # int64 values above 2^53 — fatal for join keys. fill_null keeps
+            # the column in its native width. Timestamps flow through int64
+            # epoch values (cast), dates already handled above. Real (valid)
+            # NaN payloads in float columns are preserved as-is.
+            if pa.types.is_timestamp(col.type):
+                col = col.cast(pa.int64())
+            elif pa.types.is_decimal(col.type):
+                col = col.cast(pa.float64())
+            if not null_mask.all():
+                col = pc.fill_null(col, 0)
+            arr = col.to_numpy(zero_copy_only=False)
+            data[f.name] = np.asarray(arr).astype(f.dtype.np_dtype)
+        validity[f.name] = null_mask
+    return data, validity, dicts, schema
+
+
+def read_parquet(
+    paths: str | Sequence[str],
+    columns: Optional[Sequence[str]] = None,
+    capacity: Optional[int] = None,
+    dictionaries: Optional[dict[str, Dictionary]] = None,
+) -> Table:
+    """Read parquet file(s) into a single padded device Table."""
+    import pyarrow.parquet as pq
+    import pyarrow as pa
+
+    if isinstance(paths, (str, os.PathLike)):
+        paths = [paths]
+    tables = [pq.read_table(p, columns=list(columns) if columns else None) for p in paths]
+    arrow_table = pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+    return arrow_to_table(arrow_table, capacity=capacity, dictionaries=dictionaries)
+
+
+def arrow_to_table(
+    arrow_table,
+    capacity: Optional[int] = None,
+    dictionaries: Optional[dict[str, Dictionary]] = None,
+) -> Table:
+    data, validity, dicts, schema = arrow_to_host_columns(arrow_table, dictionaries)
+    n = arrow_table.num_rows
+    cap = capacity or round_up_pow2(max(n, 1))
+    return Table.from_numpy(
+        data, schema, capacity=cap, validity=validity, dictionaries=dicts
+    )
+
+
+def table_to_arrow(table: Table):
+    """Device Table -> Arrow table (host materialization, decodes strings)."""
+    import pyarrow as pa
+
+    n = int(table.num_rows)
+    arrays = []
+    names = []
+    for name, col in zip(table.names, table.columns):
+        vals = np.asarray(col.data[:n])
+        mask = None
+        if col.validity is not None:
+            mask = ~np.asarray(col.validity[:n])
+        if col.dtype == DataType.STRING:
+            assert col.dictionary is not None
+            decoded = col.dictionary.decode(vals)
+            if mask is not None:
+                decoded = decoded.copy()
+                decoded[mask] = None
+            arrays.append(pa.array(decoded.tolist(), type=pa.string()))
+        elif col.dtype == DataType.DATE32:
+            arr = pa.array(vals.astype(np.int32), type=pa.int32(), mask=mask)
+            arrays.append(arr.cast(pa.date32()))
+        else:
+            arrays.append(pa.array(vals, mask=mask))
+        names.append(name)
+    return pa.table(dict(zip(names, arrays)))

@@ -1,0 +1,458 @@
+"""Device-resident columnar batches (the Arrow `RecordBatch` analogue).
+
+The reference engine streams Arrow `RecordBatch`es between operators and across
+the network (see `/root/reference/src/worker/impl_execute_task.rs` Flight
+encode loop). On TPU, XLA requires static shapes, so the equivalent unit here
+is a **padded** columnar batch:
+
+- every column is a fixed-`capacity` device array (power-of-two friendly),
+- the number of live rows is a *traced* scalar ``num_rows`` (so filters and
+  joins can change it under ``jit`` without recompiling),
+- rows at index >= num_rows are garbage and masked out by ``row_mask()``,
+- null semantics ride in per-column validity bitmaps (bool arrays),
+- strings live as int32 dictionary codes; the dictionaries themselves stay on
+  the host in a registry keyed by small ints so they never enter jit cache
+  keys (the analogue of the reference's dictionary GC before the wire,
+  `impl_execute_task.rs:244-274`: the device only ever sees compact codes).
+
+`Table` and `Column` are registered pytrees, so they flow through ``jit``,
+``shard_map``, ``lax.scan`` etc. unchanged.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from datafusion_distributed_tpu.schema import DataType, Field, Schema
+
+# ---------------------------------------------------------------------------
+# Host-side dictionary registry
+# ---------------------------------------------------------------------------
+
+import weakref
+
+_DICT_COUNTER = itertools.count()
+# Weak registry: a Dictionary lives as long as some Column references it
+# (the analogue of the reference's dictionary GC before the wire — unused
+# dictionaries must not accumulate in a long-running worker process).
+_DICT_REGISTRY: "weakref.WeakValueDictionary[int, Dictionary]" = (
+    weakref.WeakValueDictionary()
+)
+
+
+class Dictionary:
+    """A host-side sorted string dictionary, identified by a small int.
+
+    Identity (and therefore jit-cache equality) is by ``dict_id``, so huge
+    dictionaries cost nothing at trace time. Dictionaries are sorted at
+    construction so that code order == lexicographic order; this lets ORDER
+    BY / MIN / MAX / comparisons run directly on int32 codes on device.
+    """
+
+    __slots__ = ("dict_id", "values", "_index", "__weakref__")
+
+    def __init__(self, values: np.ndarray):
+        values = np.asarray(values, dtype=object)
+        if values.ndim != 1:
+            raise ValueError("dictionary must be 1-D")
+        self.dict_id = next(_DICT_COUNTER)
+        self.values = values
+        self._index: Optional[dict] = None
+        _DICT_REGISTRY[self.dict_id] = self
+
+    @staticmethod
+    def from_strings(values: Iterable[str]) -> "Dictionary":
+        return Dictionary(np.asarray(list(values), dtype=object))
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def code_of(self, value: str) -> int:
+        """Host-side lookup: string -> code, or -1 if absent."""
+        return self.index().get(value, -1)
+
+    def index(self) -> dict:
+        """Cached str -> code map."""
+        if self._index is None:
+            self._index = {v: i for i, v in enumerate(self.values)}
+        return self._index
+
+    def decode(self, codes: np.ndarray) -> np.ndarray:
+        out = np.empty(len(codes), dtype=object)
+        valid = (codes >= 0) & (codes < len(self.values))
+        out[valid] = self.values[codes[valid]]
+        out[~valid] = None
+        return out
+
+    def is_sorted(self) -> bool:
+        v = self.values
+        return all(v[i] <= v[i + 1] for i in range(len(v) - 1))
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Dictionary) and other.dict_id == self.dict_id
+
+    def __hash__(self) -> int:
+        return hash(("Dictionary", self.dict_id))
+
+    def __repr__(self) -> str:
+        return f"Dictionary(id={self.dict_id}, n={len(self.values)})"
+
+
+def get_dictionary(dict_id: int) -> Dictionary:
+    return _DICT_REGISTRY[dict_id]
+
+
+def build_sorted_dictionary(values: Iterable[str]) -> tuple[Dictionary, dict]:
+    """Build a sorted dictionary from unique values; returns (dict, str->code)."""
+    uniq = sorted(set(values))
+    d = Dictionary.from_strings(uniq)
+    return d, {v: i for i, v in enumerate(uniq)}
+
+
+# ---------------------------------------------------------------------------
+# Column
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Column:
+    """A single padded device column.
+
+    ``data``: [capacity] jnp array (dtype per DataType; strings = int32 codes)
+    ``validity``: [capacity] bool jnp array, or None when non-nullable.
+    ``dtype``/``dictionary``: static metadata (pytree aux).
+    """
+
+    data: jnp.ndarray
+    validity: Optional[jnp.ndarray]
+    dtype: DataType
+    dictionary: Optional[Dictionary] = None
+
+    # -- pytree protocol ----------------------------------------------------
+    def tree_flatten(self):
+        return (self.data, self.validity), (self.dtype, self.dictionary)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        data, validity = children
+        dtype, dictionary = aux
+        return cls(data=data, validity=validity, dtype=dtype, dictionary=dictionary)
+
+    # -- constructors -------------------------------------------------------
+    @staticmethod
+    def from_numpy(
+        values: np.ndarray,
+        dtype: DataType,
+        capacity: int,
+        validity: Optional[np.ndarray] = None,
+        dictionary: Optional[Dictionary] = None,
+    ) -> "Column":
+        n = len(values)
+        if n > capacity:
+            raise ValueError(f"{n} values > capacity {capacity}")
+        buf = np.zeros(capacity, dtype=dtype.np_dtype)
+        buf[:n] = values
+        col_validity = None
+        if validity is not None:
+            v = np.zeros(capacity, dtype=np.bool_)
+            v[:n] = validity
+            col_validity = jnp.asarray(v)
+        return Column(jnp.asarray(buf), col_validity, dtype, dictionary)
+
+    @property
+    def capacity(self) -> int:
+        return int(self.data.shape[0])
+
+    def valid_mask(self, capacity: Optional[int] = None) -> jnp.ndarray:
+        """Per-row null mask (True = non-null). Does NOT account for num_rows."""
+        if self.validity is not None:
+            return self.validity
+        return jnp.ones(capacity or self.capacity, dtype=jnp.bool_)
+
+    def gather(self, idx: jnp.ndarray) -> "Column":
+        data = jnp.take(self.data, idx, axis=0)
+        validity = (
+            jnp.take(self.validity, idx, axis=0) if self.validity is not None else None
+        )
+        return Column(data, validity, self.dtype, self.dictionary)
+
+    def with_validity(self, validity: Optional[jnp.ndarray]) -> "Column":
+        return Column(self.data, validity, self.dtype, self.dictionary)
+
+
+jax.tree_util.register_pytree_node(
+    Column,
+    lambda c: c.tree_flatten(),
+    Column.tree_unflatten,
+)
+
+
+# ---------------------------------------------------------------------------
+# Table
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Table:
+    """A padded columnar batch: named columns + traced live-row count."""
+
+    names: tuple[str, ...]
+    columns: tuple[Column, ...]
+    num_rows: jnp.ndarray  # traced int32 scalar
+
+    # -- pytree protocol ----------------------------------------------------
+    def tree_flatten(self):
+        return (self.columns, self.num_rows), self.names
+
+    @classmethod
+    def tree_unflatten(cls, names, children):
+        columns, num_rows = children
+        return cls(names=names, columns=tuple(columns), num_rows=num_rows)
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    def make(columns: dict[str, Column], num_rows) -> "Table":
+        names = tuple(columns.keys())
+        cols = tuple(columns.values())
+        caps = {c.capacity for c in cols}
+        if len(caps) > 1:
+            raise ValueError(f"column capacities differ: {caps}")
+        return Table(names, cols, jnp.asarray(num_rows, dtype=jnp.int32))
+
+    @staticmethod
+    def from_numpy(
+        data: dict[str, np.ndarray],
+        schema: Schema,
+        capacity: Optional[int] = None,
+        validity: Optional[dict[str, np.ndarray]] = None,
+        dictionaries: Optional[dict[str, Dictionary]] = None,
+    ) -> "Table":
+        """Build a device Table from host arrays (string columns must already
+        be int32 codes with a matching entry in ``dictionaries``)."""
+        if not data:
+            raise ValueError("from_numpy needs at least one column")
+        n = len(next(iter(data.values())))
+        cap = capacity if capacity is not None else max(1, _round_up(n))
+        cols: dict[str, Column] = {}
+        for f in schema.fields:
+            vals = data[f.name]
+            if len(vals) != n:
+                raise ValueError(f"column {f.name} length {len(vals)} != {n}")
+            v = validity.get(f.name) if validity else None
+            d = dictionaries.get(f.name) if dictionaries else None
+            if f.dtype == DataType.STRING and d is None:
+                raise ValueError(f"string column {f.name} needs a dictionary")
+            cols[f.name] = Column.from_numpy(vals, f.dtype, cap, v, d)
+        return Table.make(cols, n)
+
+    @staticmethod
+    def empty(schema: Schema, capacity: int, dictionaries=None) -> "Table":
+        cols = {}
+        for f in schema.fields:
+            d = dictionaries.get(f.name) if dictionaries else None
+            cols[f.name] = Column(
+                jnp.zeros(capacity, dtype=f.dtype.np_dtype),
+                jnp.zeros(capacity, dtype=jnp.bool_) if f.nullable else None,
+                f.dtype,
+                d,
+            )
+        return Table.make(cols, 0)
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return self.columns[0].capacity if self.columns else 0
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def column(self, name: str) -> Column:
+        try:
+            return self.columns[self.names.index(name)]
+        except ValueError:
+            raise KeyError(f"no column {name!r}; have {list(self.names)}") from None
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.names
+
+    def as_dict(self) -> dict[str, Column]:
+        return dict(zip(self.names, self.columns))
+
+    def schema(self) -> Schema:
+        return Schema(
+            [
+                Field(n, c.dtype, nullable=c.validity is not None)
+                for n, c in zip(self.names, self.columns)
+            ]
+        )
+
+    def row_mask(self) -> jnp.ndarray:
+        """[capacity] bool: True for live (non-padding) rows."""
+        return jnp.arange(self.capacity, dtype=jnp.int32) < self.num_rows
+
+    # -- transforms (all jit-safe) ------------------------------------------
+    def select(self, names: Sequence[str]) -> "Table":
+        return Table(
+            tuple(names), tuple(self.column(n) for n in names), self.num_rows
+        )
+
+    def rename(self, mapping: dict[str, str]) -> "Table":
+        names = tuple(mapping.get(n, n) for n in self.names)
+        return Table(names, self.columns, self.num_rows)
+
+    def with_column(self, name: str, col: Column) -> "Table":
+        d = self.as_dict()
+        d[name] = col
+        return Table(tuple(d.keys()), tuple(d.values()), self.num_rows)
+
+    def gather(self, idx: jnp.ndarray, num_rows) -> "Table":
+        cols = tuple(c.gather(idx) for c in self.columns)
+        return Table(self.names, cols, jnp.asarray(num_rows, dtype=jnp.int32))
+
+    def compact(self, keep: jnp.ndarray) -> "Table":
+        """Select rows where ``keep`` is True, packed to the front (jit-safe).
+
+        ``keep`` is a [capacity] bool mask; padding rows must already be False
+        in it. This is the TPU analogue of Arrow's ``filter`` kernel: a
+        static-size ``nonzero`` + gather keeps shapes fixed while num_rows
+        becomes the popcount.
+        """
+        keep = keep & self.row_mask()
+        (idx,) = jnp.nonzero(keep, size=self.capacity, fill_value=0)
+        n = jnp.sum(keep, dtype=jnp.int32)
+        t = self.gather(idx, n)
+        # Rows past n were filled from index 0; mark them invalid via validity
+        # where present (data beyond num_rows is garbage by contract anyway).
+        return t
+
+    def head(self, limit: int | jnp.ndarray) -> "Table":
+        n = jnp.minimum(self.num_rows, jnp.asarray(limit, dtype=jnp.int32))
+        return Table(self.names, self.columns, n)
+
+    # -- host materialization (NOT jit-safe) --------------------------------
+    def to_numpy(self, decode_strings: bool = True) -> dict[str, np.ndarray]:
+        n = int(self.num_rows)
+        out: dict[str, np.ndarray] = {}
+        for name, col in zip(self.names, self.columns):
+            vals = np.asarray(col.data[:n])
+            if col.dtype == DataType.STRING and decode_strings:
+                assert col.dictionary is not None
+                vals = col.dictionary.decode(vals)
+            if col.validity is not None:
+                mask = np.asarray(col.validity[:n])
+                if vals.dtype == object:
+                    vals = vals.copy()
+                    vals[~mask] = None
+                elif np.issubdtype(vals.dtype, np.floating):
+                    vals = vals.astype(np.float64, copy=True)
+                    vals[~mask] = np.nan
+                else:
+                    vals = np.ma.masked_array(vals, mask=~mask)
+            out[name] = vals
+        return out
+
+    def to_pandas(self):
+        import pandas as pd
+
+        n = int(self.num_rows)
+        cols = {}
+        for name, col in zip(self.names, self.columns):
+            vals = np.asarray(col.data[:n])
+            if col.dtype == DataType.STRING:
+                assert col.dictionary is not None
+                vals = col.dictionary.decode(vals)
+            s = pd.Series(vals)
+            if col.validity is not None:
+                mask = np.asarray(col.validity[:n])
+                s = s.where(pd.Series(mask), other=None)
+            cols[name] = s
+        return pd.DataFrame(cols)
+
+    def __repr__(self) -> str:
+        cols = ", ".join(
+            f"{n}:{c.dtype.value}" for n, c in zip(self.names, self.columns)
+        )
+        return f"Table(capacity={self.capacity}, cols=[{cols}])"
+
+
+jax.tree_util.register_pytree_node(
+    Table,
+    lambda t: t.tree_flatten(),
+    Table.tree_unflatten,
+)
+
+
+def _round_up(n: int, multiple: int = 8) -> int:
+    """Round up to a TPU-lane-friendly size (min sublane granularity)."""
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def round_up_pow2(n: int, minimum: int = 8) -> int:
+    cap = minimum
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+def concat_tables(tables: Sequence[Table], capacity: Optional[int] = None) -> Table:
+    """Concatenate same-schema tables into one padded table (jit-safe when
+    ``capacity`` is given; rows are packed via cumulative offsets)."""
+    if not tables:
+        raise ValueError("concat of zero tables")
+    first = tables[0]
+    total_cap = capacity or sum(t.capacity for t in tables)
+    names = first.names
+    for t in tables[1:]:
+        if t.names != names:
+            raise ValueError(f"concat schema mismatch: {t.names} vs {names}")
+        for ci in range(len(names)):
+            a, b = first.columns[ci], t.columns[ci]
+            if a.dtype != b.dtype:
+                raise ValueError(
+                    f"concat dtype mismatch on {names[ci]!r}: {a.dtype} vs {b.dtype}"
+                )
+            if a.dictionary != b.dictionary:
+                # Codes are only comparable under a shared dictionary; loaders
+                # must unify dictionaries (io.catalog does) before concat.
+                raise ValueError(
+                    f"concat dictionary mismatch on {names[ci]!r}; re-encode "
+                    "against a unified dictionary first"
+                )
+    # Overflow check when row counts are concrete (host path); under jit the
+    # caller owns capacity sizing, as everywhere else in the engine.
+    concrete = [t.num_rows for t in tables if not isinstance(t.num_rows, jax.core.Tracer)]
+    if len(concrete) == len(tables):
+        total = int(sum(int(n) for n in concrete))
+        if total > total_cap:
+            raise ValueError(f"concat overflow: {total} rows > capacity {total_cap}")
+    out_cols = []
+    # Destination index for each source row: offset of its table + local idx.
+    offsets = []
+    acc = jnp.asarray(0, dtype=jnp.int32)
+    for t in tables:
+        offsets.append(acc)
+        acc = acc + t.num_rows
+    total_rows = acc
+    for ci, name in enumerate(names):
+        src_dtype = first.columns[ci].dtype
+        dictionary = first.columns[ci].dictionary
+        has_validity = any(t.columns[ci].validity is not None for t in tables)
+        data = jnp.zeros(total_cap, dtype=src_dtype.np_dtype)
+        validity = jnp.zeros(total_cap, dtype=jnp.bool_) if has_validity else None
+        for t, off in zip(tables, offsets):
+            col = t.columns[ci]
+            live = t.row_mask()
+            dst = jnp.where(live, off + jnp.arange(t.capacity, dtype=jnp.int32), total_cap)
+            data = data.at[dst].set(col.data, mode="drop")
+            if has_validity:
+                v = col.valid_mask()
+                validity = validity.at[dst].set(v, mode="drop")
+        out_cols.append(Column(data, validity, src_dtype, dictionary))
+    return Table(names, tuple(out_cols), total_rows)

@@ -1,0 +1,27 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's fake-cluster strategy (SURVEY.md §4: the whole TPC
+suite runs against `InMemoryChannelResolver` — a cluster faked inside one
+process). Here the fake cluster is 8 virtual XLA CPU devices, which exercises
+the same `jax.sharding.Mesh` + collective code paths as a real TPU pod slice.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# The environment's TPU-tunnel plugin ("axon") force-selects
+# jax_platforms="axon,cpu" at registration time, which makes backends() try to
+# initialize the (single-client) TPU tunnel from every test process. Tests run
+# on the virtual CPU mesh only, so pin the platform list back to cpu.
+jax.config.update("jax_platforms", "cpu")
