@@ -88,19 +88,22 @@ def arrow_to_host_columns(
             if pa.types.is_dictionary(col.type):
                 col = col.cast(pa.string())
             values = np.asarray(col.to_numpy(zero_copy_only=False), dtype=object)
+            strs = np.where(null_mask, values, "").astype(str)
             provided = dictionaries.get(f.name) if dictionaries else None
             if provided is not None:
                 d = provided
-                idx = d.index()
             else:
-                d = Dictionary.from_strings(
-                    sorted({v for v in values if v is not None})
-                )
-                idx = d.index()
-            codes = np.asarray(
-                [idx.get(v, -1) if v is not None else -1 for v in values],
-                dtype=np.int32,
-            )
+                d = Dictionary(np.unique(strs[null_mask]).astype(object))
+            # Vectorized encode: dictionary is sorted, so searchsorted gives
+            # candidate codes; an equality check catches absent values.
+            sorted_vals = d.values.astype(str)
+            if len(sorted_vals):
+                pos = np.searchsorted(sorted_vals, strs)
+                pos_c = np.clip(pos, 0, len(sorted_vals) - 1).astype(np.int32)
+                found = sorted_vals[pos_c] == strs
+                codes = np.where(found, pos_c, -1).astype(np.int32)
+            else:
+                codes = np.full(len(strs), -1, dtype=np.int32)
             null_mask = null_mask & (codes >= 0)
             codes = np.where(codes < 0, 0, codes)
             data[f.name] = codes

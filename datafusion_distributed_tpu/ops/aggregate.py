@@ -1,0 +1,318 @@
+"""Hash GROUP BY aggregation, fully vectorized for the TPU.
+
+The reference relies on DataFusion's `AggregateExec` (Partial / Final /
+PartialReduce modes — the PartialReduce shuffle-volume optimization is
+`/root/reference/src/distributed_planner/partial_reduce_below_network_shuffles.rs`).
+A row-wise hash table doesn't map to a SIMD machine, so this kernel builds the
+group table with *vectorized claim rounds* instead of per-row probing:
+
+  round := every unresolved row scatter-mins its row-id into its candidate
+  slot ("claim"); winners write their keys; every row gathers its slot's keys
+  and either resolves (match) or advances to the next probe slot (linear
+  probing). Each round is O(N) scatter/gather on the VPU; the number of rounds
+  is bounded by the longest probe chain, so for a table sized >= 2x NDV it
+  converges in a handful of rounds (cf. "Global Hash Tables Strike Back!",
+  PAPERS.md).
+
+Aggregates then reduce by slot id with `segment_sum` / scatter-min/max, which
+XLA lowers to deterministic TPU scatters — giving run-to-run identical float
+results (the bit-parity requirement of SURVEY.md §7 hard part (d)).
+
+Group keys may be any fixed-width device dtype (dict codes included); nulls
+group together (SQL semantics), tracked via a folded-in validity lane.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from datafusion_distributed_tpu.ops.hash import hash_columns
+from datafusion_distributed_tpu.ops.table import Column, Table
+from datafusion_distributed_tpu.schema import DataType
+
+@dataclass(frozen=True)
+class AggSpec:
+    """One aggregate: func in {sum,count,count_star,min,max,avg}."""
+
+    func: str
+    input_name: Optional[str]  # None for count_star
+    output_name: str
+
+
+@dataclass
+class GroupTable:
+    """Result of the claim loop: per-row group ids + per-slot key columns."""
+
+    group_ids: jnp.ndarray  # [N] int32 slot index per row (garbage for dead rows)
+    slot_used: jnp.ndarray  # [H] bool
+    slot_keys: list[jnp.ndarray]  # per key column: [H] values
+    slot_key_valid: list[Optional[jnp.ndarray]]  # per key column: [H] bool or None
+    num_groups: jnp.ndarray  # scalar int32
+    overflow: jnp.ndarray  # scalar bool: table too small, results invalid
+
+
+def build_group_table(
+    key_cols: Sequence[jnp.ndarray],
+    key_valids: Sequence[Optional[jnp.ndarray]],
+    live: jnp.ndarray,
+    num_slots: int,
+    max_rounds: int = 64,
+) -> GroupTable:
+    """Assign each live row a group id (a slot in a power-of-two table)."""
+    assert num_slots & (num_slots - 1) == 0, "num_slots must be a power of two"
+    n = key_cols[0].shape[0]
+    k = len(key_cols)
+    mask = np.uint32(num_slots - 1)
+
+    # Keys folded to int64 payloads. Nullability is an explicit extra lane in
+    # the compare matrix (not an in-band sentinel, which a real key value
+    # could collide with): nullable column i contributes lanes
+    # [payload-with-nulls-zeroed, is_valid].
+    keys64 = []
+    valid_lane_of: list[Optional[int]] = []  # per key col: its validity lane idx
+    for c, v in zip(key_cols, key_valids):
+        payload = c.astype(jnp.int64) if c.dtype != jnp.float64 else c.view(jnp.int64)
+        if c.dtype == jnp.float32:
+            payload = c.view(jnp.int32).astype(jnp.int64)
+        if v is not None:
+            payload = jnp.where(v, payload, 0)
+        keys64.append(payload)
+        valid_lane_of.append(None)
+    for i, v in enumerate(key_valids):
+        if v is not None:
+            valid_lane_of[i] = len(keys64)
+            keys64.append(v.astype(jnp.int64))
+
+    h0 = hash_columns(list(key_cols), list(key_valids))
+    slot0 = (h0 & mask).astype(jnp.int32)
+
+    n_lanes = len(keys64)
+    slot_keys0 = jnp.zeros((num_slots, n_lanes), dtype=jnp.int64)
+    slot_used0 = jnp.zeros(num_slots, dtype=jnp.bool_)
+    keys_mat = jnp.stack(keys64, axis=1)  # [N, k]
+
+    # Dead rows are born resolved and never claim a slot.
+    resolved0 = ~live
+    gid0 = jnp.zeros(n, dtype=jnp.int32)
+
+    def cond(state):
+        resolved, *_ , rounds = state
+        return (~jnp.all(resolved)) & (rounds < max_rounds)
+
+    def body(state):
+        resolved, slot, gid, slot_keys, slot_used, rounds = state
+        # 1. unresolved rows claim their candidate slot (min row-id wins)
+        claim_slot = jnp.where(resolved, num_slots, slot)  # drop resolved
+        owner = jnp.full(num_slots, n, dtype=jnp.int32)
+        owner = owner.at[claim_slot].min(
+            jnp.arange(n, dtype=jnp.int32), mode="drop"
+        )
+        # Only claims on EMPTY slots count; occupied slots keep their keys.
+        claimable = ~slot_used
+        winner = (~resolved) & (owner[slot] == jnp.arange(n, dtype=jnp.int32)) & (
+            claimable[slot]
+        )
+        # 2. winners write their keys and mark slots used
+        wslot = jnp.where(winner, slot, num_slots)
+        slot_keys = slot_keys.at[wslot].set(keys_mat, mode="drop")
+        slot_used = slot_used.at[wslot].set(True, mode="drop")
+        # 3. everyone gathers; match -> resolve, mismatch on used slot -> probe
+        mine = slot_keys[slot]  # [N, k]
+        used = slot_used[slot]
+        match = used & jnp.all(mine == keys_mat, axis=1)
+        newly = (~resolved) & match
+        gid = jnp.where(newly, slot, gid)
+        resolved = resolved | newly
+        advance = (~resolved) & used & ~match
+        slot = jnp.where(
+            advance, ((slot + 1).astype(jnp.uint32) & mask).astype(jnp.int32), slot
+        )
+        return resolved, slot, gid, slot_keys, slot_used, rounds + 1
+
+    state = (resolved0, slot0, gid0, slot_keys0, slot_used0, jnp.asarray(0))
+    resolved, slot, gid, slot_keys, slot_used, _ = jax.lax.while_loop(
+        cond, body, state
+    )
+    overflow = ~jnp.all(resolved)
+
+    out_keys = []
+    out_valid = []
+    for i, (c, v) in enumerate(zip(key_cols, key_valids)):
+        payload = slot_keys[:, i]
+        lane = valid_lane_of[i]
+        if lane is not None:
+            key_valid = slot_keys[:, lane] != 0
+            out_valid.append(key_valid)
+        else:
+            out_valid.append(None)
+        if c.dtype == jnp.float64:
+            out_keys.append(payload.view(jnp.float64))
+        elif c.dtype == jnp.float32:
+            out_keys.append(payload.astype(jnp.int32).view(jnp.float32))
+        else:
+            out_keys.append(payload.astype(c.dtype))
+    return GroupTable(
+        group_ids=gid,
+        slot_used=slot_used,
+        slot_keys=out_keys,
+        slot_key_valid=out_valid,
+        num_groups=jnp.sum(slot_used, dtype=jnp.int32),
+        overflow=overflow,
+    )
+
+
+def hash_aggregate(
+    table: Table,
+    group_names: Sequence[str],
+    aggs: Sequence[AggSpec],
+    num_slots: int,
+    mode: str = "single",  # "single" | "partial" | "final"
+) -> tuple[Table, jnp.ndarray]:
+    """GROUP BY aggregation. Returns (result table, overflow flag).
+
+    Modes mirror DataFusion's AggregateMode as used by the reference planner:
+      partial -> emits sum/count/min/max accumulator columns per agg
+      final   -> consumes accumulator columns (re-groups, merges)
+      single  -> full aggregation in one step
+    The result table has capacity == num_slots, groups packed to the front.
+    """
+    live = table.row_mask()
+    key_cols = [table.column(g).data for g in group_names]
+    key_valids = [table.column(g).validity for g in group_names]
+    gt = build_group_table(key_cols, key_valids, live, num_slots)
+    gid = jnp.where(live, gt.group_ids, num_slots)  # dead rows drop out
+
+    out_cols: dict[str, Column] = {}
+    for g, keys, kv in zip(group_names, gt.slot_keys, gt.slot_key_valid):
+        src = table.column(g)
+        out_cols[g] = Column(keys, kv, src.dtype, src.dictionary)
+
+    def seg_sum(vals, dtype=None):
+        z = jnp.zeros(num_slots, dtype=dtype or vals.dtype)
+        return z.at[gid].add(vals, mode="drop")
+
+    for spec in aggs:
+        out_cols.update(
+            _eval_agg(spec, table, gid, live, num_slots, mode, seg_sum)
+        )
+
+    # Pack used slots to the front.
+    packed = Table.make(out_cols, gt.num_groups)
+    keep = gt.slot_used
+    (idx,) = jnp.nonzero(keep, size=num_slots, fill_value=0)
+    packed = packed.gather(idx, gt.num_groups)
+    return packed, gt.overflow
+
+
+def _eval_agg(spec, table, gid, live, num_slots, mode, seg_sum):
+    """Produce the output column(s) for one AggSpec in the given mode."""
+    name = spec.output_name
+    if spec.func == "count_star":
+        if mode == "final":
+            acc = table.column(f"{name}")
+            vals = jnp.where(live, acc.data, 0)
+            return {name: Column(seg_sum(vals), None, DataType.INT64)}
+        cnt = seg_sum(jnp.where(live, 1, 0).astype(jnp.int64))
+        return {name: Column(cnt, None, DataType.INT64)}
+
+    if mode == "final" and spec.func in ("sum", "count", "min", "max"):
+        # merge accumulator column produced by a partial stage
+        acc = table.column(name)
+        valid = acc.valid_mask() & live
+        if spec.func in ("sum", "count"):
+            vals = jnp.where(valid, acc.data, 0)
+            merged = seg_sum(vals)
+        elif spec.func == "min":
+            init = jnp.full(num_slots, _dtype_max(acc.data.dtype), acc.data.dtype)
+            merged = init.at[jnp.where(valid, gid, num_slots)].min(
+                acc.data, mode="drop"
+            )
+        else:
+            init = jnp.full(num_slots, _dtype_min(acc.data.dtype), acc.data.dtype)
+            merged = init.at[jnp.where(valid, gid, num_slots)].max(
+                acc.data, mode="drop"
+            )
+        nonempty = seg_sum(jnp.where(valid, 1, 0).astype(jnp.int64))
+        if spec.func == "count":
+            return {name: Column(merged, None, DataType.INT64)}
+        out_valid = nonempty > 0
+        return {name: Column(merged, out_valid, _col_dtype(acc), acc.dictionary)}
+
+    if mode == "final" and spec.func == "avg":
+        s = table.column(f"{name}__sum")
+        c = table.column(f"{name}__count")
+        valid = live & s.valid_mask()
+        ssum = seg_sum(jnp.where(valid, s.data, 0.0))
+        scnt = seg_sum(jnp.where(live, c.data, 0))
+        out_valid = scnt > 0
+        avg = ssum / jnp.where(scnt == 0, 1, scnt)
+        return {name: Column(avg, out_valid, DataType.FLOAT64)}
+
+    # partial/single over raw input
+    col = table.column(spec.input_name)
+    valid = col.valid_mask() & live
+    vgid = jnp.where(valid, gid, num_slots)
+
+    if spec.func == "count":
+        cnt = seg_sum(jnp.where(valid, 1, 0).astype(jnp.int64))
+        return {name: Column(cnt, None, DataType.INT64)}
+
+    if spec.func == "sum" or (spec.func == "avg" and mode == "partial"):
+        acc_dtype = (
+            jnp.float64 if col.dtype.is_float else jnp.int64
+        )
+        vals = jnp.where(valid, col.data, 0).astype(acc_dtype)
+        s = seg_sum(vals)
+        nonempty = seg_sum(jnp.where(valid, 1, 0).astype(jnp.int64))
+        sum_dtype = DataType.FLOAT64 if col.dtype.is_float else DataType.INT64
+        if spec.func == "sum":
+            return {name: Column(s, nonempty > 0, sum_dtype)}
+        # partial avg: emit sum + count pair
+        return {
+            f"{name}__sum": Column(
+                s.astype(jnp.float64), nonempty > 0, DataType.FLOAT64
+            ),
+            f"{name}__count": Column(nonempty, None, DataType.INT64),
+        }
+
+    if spec.func == "avg":  # single
+        vals = jnp.where(valid, col.data, 0).astype(jnp.float64)
+        s = seg_sum(vals)
+        cnt = seg_sum(jnp.where(valid, 1, 0).astype(jnp.int64))
+        avg = s / jnp.where(cnt == 0, 1, cnt)
+        return {name: Column(avg, cnt > 0, DataType.FLOAT64)}
+
+    if spec.func in ("min", "max"):
+        if spec.func == "min":
+            init = jnp.full(num_slots, _dtype_max(col.data.dtype), col.data.dtype)
+            red = init.at[vgid].min(col.data, mode="drop")
+        else:
+            init = jnp.full(num_slots, _dtype_min(col.data.dtype), col.data.dtype)
+            red = init.at[vgid].max(col.data, mode="drop")
+        nonempty = seg_sum(jnp.where(valid, 1, 0).astype(jnp.int64))
+        return {
+            name: Column(red, nonempty > 0, col.dtype, col.dictionary)
+        }
+
+    raise NotImplementedError(f"aggregate function {spec.func}")
+
+
+def _col_dtype(col: Column) -> DataType:
+    return col.dtype
+
+
+def _dtype_max(dt):
+    if jnp.issubdtype(dt, jnp.floating):
+        return np.inf
+    return np.iinfo(np.dtype(dt)).max
+
+
+def _dtype_min(dt):
+    if jnp.issubdtype(dt, jnp.floating):
+        return -np.inf
+    return np.iinfo(np.dtype(dt)).min

@@ -1,0 +1,57 @@
+"""Vectorized hashing utilities for group-by / join / shuffle partitioning.
+
+The reference gets hashing from DataFusion's `create_hashes` (ahash over Arrow
+arrays) for both `RepartitionExec(Hash)` and the hash join/aggregate operators
+(SURVEY.md L0). The TPU analogue below is a branch-free 32-bit multiply-xor
+mixer evaluated on the VPU over whole columns at once; multi-column keys are
+combined with a distinct odd multiplier per column.
+
+All functions operate on [capacity]-shaped int arrays and are jit-safe.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# murmur3-style finalizer constants (public domain)
+_C1 = np.uint32(0x85EBCA6B)
+_C2 = np.uint32(0xC2B2AE35)
+
+
+def _mix32(h: jnp.ndarray) -> jnp.ndarray:
+    """murmur3 fmix32: avalanche a uint32 lane."""
+    h = h ^ (h >> 16)
+    h = h * _C1
+    h = h ^ (h >> 13)
+    h = h * _C2
+    h = h ^ (h >> 16)
+    return h
+
+
+def fold_to_u32(col: jnp.ndarray) -> jnp.ndarray:
+    """Fold an int/bool/date column to uint32 lanes (hi^lo for 64-bit)."""
+    if col.dtype in (jnp.int64, jnp.uint64):
+        u = col.astype(jnp.uint64)
+        return (u ^ (u >> np.uint64(32))).astype(jnp.uint32)
+    if col.dtype in (jnp.float64,):
+        u = col.view(jnp.uint64)
+        return (u ^ (u >> np.uint64(32))).astype(jnp.uint32)
+    if col.dtype in (jnp.float32,):
+        return col.view(jnp.uint32)
+    return col.astype(jnp.uint32)
+
+
+def hash_columns(cols: list[jnp.ndarray], valids: list[jnp.ndarray | None]) -> jnp.ndarray:
+    """Combined uint32 hash of multiple key columns (nulls hash as a fixed
+    tag so SQL's null-equal-null grouping works)."""
+    assert cols
+    h = jnp.full(cols[0].shape, np.uint32(0x9E3779B9), dtype=jnp.uint32)
+    for i, (c, v) in enumerate(zip(cols, valids)):
+        lane = fold_to_u32(c)
+        if v is not None:
+            lane = jnp.where(v, lane, np.uint32(0xDEADBEEF))
+        # distinct odd multiplier per column index keeps (a,b) != (b,a)
+        mult = np.uint32(0x01000193 + 2 * i)
+        h = (h ^ _mix32(lane)) * mult
+    return _mix32(h)

@@ -1,0 +1,562 @@
+"""Physical expression IR, evaluated to device arrays.
+
+The reference delegates expression evaluation to DataFusion's `PhysicalExpr`
+kernels over Arrow arrays (SURVEY.md L0). Here expressions are a small tree IR
+that *traces* to jnp operations over the padded device columns — so a whole
+filter/projection pipeline fuses into one XLA computation, with no
+per-expression materialization (the XLA analogue of Arrow kernel fusion).
+
+Key TPU-first choices:
+- SQL three-valued logic is carried as an explicit (data, validity) pair; the
+  VPU evaluates both lanes in parallel.
+- String comparisons never touch strings on device: dictionaries are sorted,
+  so `col op literal` compiles to an int32 code comparison against a host-side
+  `searchsorted` of the literal (exact, even for literals absent from the
+  dictionary).
+- LIKE / IN on strings evaluate the predicate over the *dictionary* on the
+  host at trace time and become a boolean lookup-table gather by code — O(NDV)
+  host work, O(rows) device work.
+"""
+
+from __future__ import annotations
+
+import datetime
+import re
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from datafusion_distributed_tpu.ops.table import Column, Dictionary, Table
+from datafusion_distributed_tpu.schema import DataType, Field, Schema
+
+
+# ---------------------------------------------------------------------------
+# Evaluation result: device data + optional validity (None = all valid)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ExprValue:
+    data: jnp.ndarray
+    validity: Optional[jnp.ndarray]  # bool array or None (= all valid)
+    dtype: DataType
+    dictionary: Optional[Dictionary] = None
+
+    def valid_mask(self) -> jnp.ndarray:
+        if self.validity is None:
+            return jnp.ones(self.data.shape, dtype=jnp.bool_)
+        return self.validity
+
+
+def _merge_validity(*vs: Optional[jnp.ndarray]) -> Optional[jnp.ndarray]:
+    present = [v for v in vs if v is not None]
+    if not present:
+        return None
+    out = present[0]
+    for v in present[1:]:
+        out = out & v
+    return out
+
+
+def parse_date(s: str) -> int:
+    """'YYYY-MM-DD' -> int32 days since epoch."""
+    d = datetime.date.fromisoformat(s)
+    return (d - datetime.date(1970, 1, 1)).days
+
+
+# ---------------------------------------------------------------------------
+# Expression nodes
+# ---------------------------------------------------------------------------
+
+
+class PhysicalExpr:
+    """Base class. ``evaluate(table)`` returns an ExprValue whose arrays have
+    the table's capacity; garbage rows (>= num_rows) may hold anything."""
+
+    def evaluate(self, table: Table) -> ExprValue:
+        raise NotImplementedError
+
+    def output_field(self, schema: Schema) -> Field:
+        raise NotImplementedError
+
+    def children(self) -> list["PhysicalExpr"]:
+        return []
+
+    def display(self) -> str:
+        return repr(self)
+
+
+@dataclass
+class Col(PhysicalExpr):
+    name: str
+
+    def evaluate(self, table: Table) -> ExprValue:
+        c = table.column(self.name)
+        return ExprValue(c.data, c.validity, c.dtype, c.dictionary)
+
+    def output_field(self, schema: Schema) -> Field:
+        return schema.field(self.name)
+
+    def display(self) -> str:
+        return self.name
+
+
+@dataclass
+class Literal(PhysicalExpr):
+    value: Any  # python scalar: int/float/bool/str/None; dates pre-parsed int
+    dtype: DataType
+
+    def evaluate(self, table: Table) -> ExprValue:
+        cap = table.capacity
+        if self.value is None:
+            data = jnp.zeros(cap, dtype=self.dtype.np_dtype)
+            return ExprValue(data, jnp.zeros(cap, dtype=jnp.bool_), self.dtype)
+        if self.dtype == DataType.STRING:
+            # Bare string literal with no column context: keep as dtype STRING
+            # with a private single-entry dictionary. Comparisons against
+            # columns resolve via the column's dictionary (see Cmp).
+            d = Dictionary.from_strings([self.value])
+            data = jnp.zeros(cap, dtype=np.int32)
+            return ExprValue(data, None, self.dtype, d)
+        val = np.asarray(self.value, dtype=self.dtype.np_dtype)
+        data = jnp.full(cap, val, dtype=self.dtype.np_dtype)
+        return ExprValue(data, None, self.dtype)
+
+    def output_field(self, schema: Schema) -> Field:
+        return Field(str(self.value), self.dtype, nullable=self.value is None)
+
+    def display(self) -> str:
+        return repr(self.value)
+
+
+_ARITH_OPS = {"+", "-", "*", "/", "%"}
+_CMP_OPS = {"==", "!=", "<", "<=", ">", ">="}
+
+
+def _promote(a: DataType, b: DataType) -> DataType:
+    order = [
+        DataType.BOOL,
+        DataType.INT32,
+        DataType.DATE32,
+        DataType.INT64,
+        DataType.FLOAT32,
+        DataType.FLOAT64,
+    ]
+    if a == b:
+        return a
+    if a == DataType.STRING or b == DataType.STRING:
+        return DataType.STRING
+    return max(a, b, key=order.index)
+
+
+@dataclass
+class BinaryOp(PhysicalExpr):
+    """Arithmetic/comparison. String comparisons compile to code comparisons
+    against the column dictionary (sorted => order-preserving)."""
+
+    op: str
+    left: PhysicalExpr
+    right: PhysicalExpr
+
+    def children(self):
+        return [self.left, self.right]
+
+    def evaluate(self, table: Table) -> ExprValue:
+        l = self.left.evaluate(table)
+        r = self.right.evaluate(table)
+        validity = _merge_validity(l.validity, r.validity)
+        if self.op in _CMP_OPS:
+            data = self._compare(l, r, table)
+            return ExprValue(data, validity, DataType.BOOL)
+        # arithmetic
+        out_dtype = _promote(l.dtype, r.dtype)
+        if self.op == "/" and out_dtype.is_integer:
+            out_dtype = DataType.FLOAT64
+        ldata = l.data.astype(out_dtype.np_dtype)
+        rdata = r.data.astype(out_dtype.np_dtype)
+        if self.op == "+":
+            data = ldata + rdata
+        elif self.op == "-":
+            data = ldata - rdata
+        elif self.op == "*":
+            data = ldata * rdata
+        elif self.op == "/":
+            data = ldata / jnp.where(rdata == 0, 1, rdata)
+            validity = _merge_validity(validity, r.data != 0)
+        elif self.op == "%":
+            data = jnp.where(rdata == 0, 0, ldata % jnp.where(rdata == 0, 1, rdata))
+            validity = _merge_validity(validity, r.data != 0)
+        else:
+            raise NotImplementedError(self.op)
+        return ExprValue(data, validity, out_dtype)
+
+    def _compare(self, l: ExprValue, r: ExprValue, table: Table) -> jnp.ndarray:
+        # String vs string-literal comparison: resolve via sorted dictionary.
+        if l.dtype == DataType.STRING or r.dtype == DataType.STRING:
+            return self._compare_strings(l, r)
+        common = _promote(l.dtype, r.dtype)
+        a = l.data.astype(common.np_dtype)
+        b = r.data.astype(common.np_dtype)
+        return _apply_cmp(self.op, a, b)
+
+    def _compare_strings(self, l: ExprValue, r: ExprValue) -> jnp.ndarray:
+        lit_side = None
+        col_side = None
+        if isinstance(self.right, Literal) and self.right.dtype == DataType.STRING:
+            lit_side, col_side, op = self.right, l, self.op
+        elif isinstance(self.left, Literal) and self.left.dtype == DataType.STRING:
+            lit_side, col_side, op = self.left, r, _flip_cmp(self.op)
+        if lit_side is not None:
+            d = col_side.dictionary
+            if d is None:
+                raise ValueError("string column missing dictionary")
+            lit = lit_side.value
+            codes = col_side.data
+            if op in ("==", "!="):
+                code = d.code_of(lit)
+                if code < 0:
+                    same = jnp.zeros(codes.shape, dtype=jnp.bool_)
+                else:
+                    same = codes == code
+                return same if op == "==" else ~same
+            # Order comparison: sorted dictionary => searchsorted boundary.
+            pos_left = int(np.searchsorted(d.values.astype(str), lit, side="left"))
+            pos_right = int(np.searchsorted(d.values.astype(str), lit, side="right"))
+            if op == "<":
+                return codes < pos_left
+            if op == "<=":
+                return codes < pos_right
+            if op == ">":
+                return codes >= pos_right
+            if op == ">=":
+                return codes >= pos_left
+            raise NotImplementedError(op)
+        # column vs column: only valid when dictionaries are unified
+        if l.dictionary != r.dictionary:
+            raise ValueError(
+                "string column comparison requires a unified dictionary"
+            )
+        return _apply_cmp(self.op, l.data, r.data)
+
+    def output_field(self, schema: Schema) -> Field:
+        lf = self.left.output_field(schema)
+        rf = self.right.output_field(schema)
+        nullable = lf.nullable or rf.nullable or self.op in ("/", "%")
+        if self.op in _CMP_OPS:
+            return Field(self.display(), DataType.BOOL, nullable)
+        out = _promote(lf.dtype, rf.dtype)
+        if self.op == "/" and out.is_integer:
+            out = DataType.FLOAT64
+        return Field(self.display(), out, nullable)
+
+    def display(self) -> str:
+        return f"({self.left.display()} {self.op} {self.right.display()})"
+
+
+def _apply_cmp(op: str, a, b):
+    if op == "==":
+        return a == b
+    if op == "!=":
+        return a != b
+    if op == "<":
+        return a < b
+    if op == "<=":
+        return a <= b
+    if op == ">":
+        return a > b
+    if op == ">=":
+        return a >= b
+    raise NotImplementedError(op)
+
+
+def _flip_cmp(op: str) -> str:
+    return {"==": "==", "!=": "!=", "<": ">", "<=": ">=", ">": "<", ">=": "<="}[op]
+
+
+@dataclass
+class BooleanOp(PhysicalExpr):
+    """AND/OR with SQL Kleene three-valued logic."""
+
+    op: str  # "and" | "or"
+    left: PhysicalExpr
+    right: PhysicalExpr
+
+    def children(self):
+        return [self.left, self.right]
+
+    def evaluate(self, table: Table) -> ExprValue:
+        l = self.left.evaluate(table)
+        r = self.right.evaluate(table)
+        lv, rv = l.valid_mask(), r.valid_mask()
+        ld = l.data.astype(jnp.bool_)
+        rd = r.data.astype(jnp.bool_)
+        if self.op == "and":
+            data = ld & rd
+            # null AND true = null; null AND false = false
+            validity = (lv & rv) | (lv & ~ld) | (rv & ~rd)
+        elif self.op == "or":
+            data = ld | rd
+            validity = (lv & rv) | (lv & ld) | (rv & rd)
+        else:
+            raise NotImplementedError(self.op)
+        if l.validity is None and r.validity is None:
+            validity = None
+        return ExprValue(data, validity, DataType.BOOL)
+
+    def output_field(self, schema: Schema) -> Field:
+        return Field(self.display(), DataType.BOOL, True)
+
+    def display(self) -> str:
+        return f"({self.left.display()} {self.op.upper()} {self.right.display()})"
+
+
+@dataclass
+class Not(PhysicalExpr):
+    child: PhysicalExpr
+
+    def children(self):
+        return [self.child]
+
+    def evaluate(self, table: Table) -> ExprValue:
+        c = self.child.evaluate(table)
+        return ExprValue(~c.data.astype(jnp.bool_), c.validity, DataType.BOOL)
+
+    def output_field(self, schema: Schema) -> Field:
+        return Field(self.display(), DataType.BOOL, True)
+
+    def display(self) -> str:
+        return f"NOT {self.child.display()}"
+
+
+@dataclass
+class IsNull(PhysicalExpr):
+    child: PhysicalExpr
+    negated: bool = False
+
+    def children(self):
+        return [self.child]
+
+    def evaluate(self, table: Table) -> ExprValue:
+        c = self.child.evaluate(table)
+        isnull = (
+            ~c.valid_mask() if c.validity is not None
+            else jnp.zeros(c.data.shape, dtype=jnp.bool_)
+        )
+        return ExprValue(~isnull if self.negated else isnull, None, DataType.BOOL)
+
+    def output_field(self, schema: Schema) -> Field:
+        return Field(self.display(), DataType.BOOL, False)
+
+    def display(self) -> str:
+        return f"{self.child.display()} IS {'NOT ' if self.negated else ''}NULL"
+
+
+@dataclass
+class Cast(PhysicalExpr):
+    child: PhysicalExpr
+    to: DataType
+
+    def children(self):
+        return [self.child]
+
+    def evaluate(self, table: Table) -> ExprValue:
+        c = self.child.evaluate(table)
+        if c.dtype == self.to:
+            return c
+        if c.dtype == DataType.STRING or self.to == DataType.STRING:
+            raise NotImplementedError("string casts happen at plan time")
+        return ExprValue(c.data.astype(self.to.np_dtype), c.validity, self.to)
+
+    def output_field(self, schema: Schema) -> Field:
+        f = self.child.output_field(schema)
+        return Field(f.name, self.to, f.nullable)
+
+    def display(self) -> str:
+        return f"CAST({self.child.display()} AS {self.to.value})"
+
+
+def _sql_like_to_regex(pattern: str) -> str:
+    out = []
+    i = 0
+    while i < len(pattern):
+        ch = pattern[i]
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        elif ch == "\\" and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 1
+        else:
+            out.append(re.escape(ch))
+        i += 1
+    return "".join(out)
+
+
+@dataclass
+class Like(PhysicalExpr):
+    """LIKE on a dictionary column: regex over the host dictionary at trace
+    time -> boolean LUT -> device gather by code."""
+
+    child: PhysicalExpr
+    pattern: str
+    negated: bool = False
+
+    def children(self):
+        return [self.child]
+
+    def evaluate(self, table: Table) -> ExprValue:
+        c = self.child.evaluate(table)
+        if c.dtype != DataType.STRING or c.dictionary is None:
+            raise ValueError("LIKE requires a dictionary string column")
+        rx = re.compile(_sql_like_to_regex(self.pattern), re.DOTALL)
+        lut = np.asarray(
+            [bool(rx.fullmatch(v)) for v in c.dictionary.values], dtype=np.bool_
+        )
+        if self.negated:
+            lut = ~lut
+        if len(lut) == 0:
+            data = jnp.full(c.data.shape, bool(self.negated))
+        else:
+            data = jnp.asarray(lut)[jnp.clip(c.data, 0, len(lut) - 1)]
+        return ExprValue(data, c.validity, DataType.BOOL)
+
+    def output_field(self, schema: Schema) -> Field:
+        return Field(self.display(), DataType.BOOL, True)
+
+    def display(self) -> str:
+        return (
+            f"{self.child.display()} {'NOT ' if self.negated else ''}"
+            f"LIKE {self.pattern!r}"
+        )
+
+
+@dataclass
+class InList(PhysicalExpr):
+    child: PhysicalExpr
+    values: tuple
+    negated: bool = False
+
+    def children(self):
+        return [self.child]
+
+    def evaluate(self, table: Table) -> ExprValue:
+        c = self.child.evaluate(table)
+        if c.dtype == DataType.STRING:
+            if c.dictionary is None:
+                raise ValueError("IN on string requires dictionary")
+            codes = [c.dictionary.code_of(v) for v in self.values]
+            codes = [x for x in codes if x >= 0]
+            if not codes:
+                data = jnp.zeros(c.data.shape, dtype=jnp.bool_)
+            else:
+                data = jnp.isin(c.data, jnp.asarray(codes, dtype=c.data.dtype))
+        else:
+            vals = np.asarray(list(self.values), dtype=c.dtype.np_dtype)
+            data = jnp.isin(c.data, jnp.asarray(vals))
+        if self.negated:
+            data = ~data
+        return ExprValue(data, c.validity, DataType.BOOL)
+
+    def output_field(self, schema: Schema) -> Field:
+        return Field(self.display(), DataType.BOOL, True)
+
+    def display(self) -> str:
+        neg = "NOT " if self.negated else ""
+        return f"{self.child.display()} {neg}IN {self.values!r}"
+
+
+@dataclass
+class Case(PhysicalExpr):
+    """CASE WHEN ... THEN ... [ELSE ...] END (searched form)."""
+
+    branches: tuple  # tuple[(cond PhysicalExpr, value PhysicalExpr), ...]
+    otherwise: Optional[PhysicalExpr] = None
+
+    def children(self):
+        out = []
+        for c, v in self.branches:
+            out += [c, v]
+        if self.otherwise:
+            out.append(self.otherwise)
+        return out
+
+    def evaluate(self, table: Table) -> ExprValue:
+        results = [(c.evaluate(table), v.evaluate(table)) for c, v in self.branches]
+        out_dtype = results[0][1].dtype
+        for _, v in results[1:]:
+            out_dtype = _promote(out_dtype, v.dtype)
+        if self.otherwise is not None:
+            else_v = self.otherwise.evaluate(table)
+            out_dtype = _promote(out_dtype, else_v.dtype)
+            data = else_v.data.astype(out_dtype.np_dtype)
+            validity = else_v.valid_mask()
+        else:
+            cap = table.capacity
+            data = jnp.zeros(cap, dtype=out_dtype.np_dtype)
+            validity = jnp.zeros(cap, dtype=jnp.bool_)
+        # Apply branches in reverse so the FIRST matching branch wins.
+        for cond, val in reversed(results):
+            take = cond.data.astype(jnp.bool_) & cond.valid_mask()
+            data = jnp.where(take, val.data.astype(out_dtype.np_dtype), data)
+            validity = jnp.where(take, val.valid_mask(), validity)
+        return ExprValue(data, validity, out_dtype)
+
+    def output_field(self, schema: Schema) -> Field:
+        out = self.branches[0][1].output_field(schema).dtype
+        for _, v in self.branches[1:]:
+            out = _promote(out, v.output_field(schema).dtype)
+        if self.otherwise is not None:
+            out = _promote(out, self.otherwise.output_field(schema).dtype)
+        return Field(self.display(), out, True)
+
+    def display(self) -> str:
+        parts = " ".join(
+            f"WHEN {c.display()} THEN {v.display()}" for c, v in self.branches
+        )
+        e = f" ELSE {self.otherwise.display()}" if self.otherwise else ""
+        return f"CASE {parts}{e} END"
+
+
+@dataclass
+class Alias(PhysicalExpr):
+    child: PhysicalExpr
+    name: str
+
+    def children(self):
+        return [self.child]
+
+    def evaluate(self, table: Table) -> ExprValue:
+        return self.child.evaluate(table)
+
+    def output_field(self, schema: Schema) -> Field:
+        f = self.child.output_field(schema)
+        return Field(self.name, f.dtype, f.nullable)
+
+    def display(self) -> str:
+        return f"{self.child.display()} AS {self.name}"
+
+
+@dataclass
+class Negate(PhysicalExpr):
+    child: PhysicalExpr
+
+    def children(self):
+        return [self.child]
+
+    def evaluate(self, table: Table) -> ExprValue:
+        c = self.child.evaluate(table)
+        return ExprValue(-c.data, c.validity, c.dtype)
+
+    def output_field(self, schema: Schema) -> Field:
+        f = self.child.output_field(schema)
+        return Field(f"(- {f.name})", f.dtype, f.nullable)
+
+    def display(self) -> str:
+        return f"(- {self.child.display()})"
+
+
+def expr_to_column(value: ExprValue) -> Column:
+    return Column(value.data, value.validity, value.dtype, value.dictionary)

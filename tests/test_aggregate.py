@@ -1,0 +1,145 @@
+"""Hash aggregate kernel golden tests vs pandas groupby."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from datafusion_distributed_tpu.io.parquet import arrow_to_table
+from datafusion_distributed_tpu.ops.aggregate import AggSpec, hash_aggregate
+
+
+def _run(table, groups, aggs, slots=64, mode="single"):
+    out, overflow = jax.jit(
+        lambda t: hash_aggregate(t, groups, aggs, slots, mode),
+        static_argnames=(),
+    )(table)
+    assert not bool(overflow)
+    return out.to_pandas()
+
+
+def test_groupby_sum_count():
+    rng = np.random.default_rng(0)
+    k = rng.integers(0, 10, 1000)
+    v = rng.normal(size=1000)
+    t = arrow_to_table(pa.table({"k": k, "v": v}))
+    got = _run(
+        t, ["k"],
+        [AggSpec("sum", "v", "sv"), AggSpec("count_star", None, "n")],
+    ).sort_values("k").reset_index(drop=True)
+    exp = (
+        pd.DataFrame({"k": k, "v": v})
+        .groupby("k")
+        .agg(sv=("v", "sum"), n=("v", "size"))
+        .reset_index()
+    )
+    np.testing.assert_array_equal(got["k"], exp["k"])
+    np.testing.assert_allclose(got["sv"], exp["sv"], rtol=1e-12)
+    np.testing.assert_array_equal(got["n"], exp["n"])
+
+
+def test_groupby_min_max_avg():
+    rng = np.random.default_rng(1)
+    k = rng.integers(0, 7, 500)
+    v = rng.integers(-1000, 1000, 500)
+    t = arrow_to_table(pa.table({"k": k, "v": v}))
+    got = _run(
+        t, ["k"],
+        [AggSpec("min", "v", "mn"), AggSpec("max", "v", "mx"),
+         AggSpec("avg", "v", "av")],
+    ).sort_values("k").reset_index(drop=True)
+    exp = (
+        pd.DataFrame({"k": k, "v": v})
+        .groupby("k")
+        .agg(mn=("v", "min"), mx=("v", "max"), av=("v", "mean"))
+        .reset_index()
+    )
+    np.testing.assert_array_equal(got["mn"], exp["mn"])
+    np.testing.assert_array_equal(got["mx"], exp["mx"])
+    np.testing.assert_allclose(got["av"], exp["av"], rtol=1e-12)
+
+
+def test_multi_key_with_strings_and_nulls():
+    t = arrow_to_table(
+        pa.table(
+            {
+                "a": pa.array(["x", "y", "x", None, "y", None]),
+                "b": pa.array([1, 1, 1, 2, None, 2], type=pa.int64()),
+                "v": pa.array([10.0, 20.0, 30.0, 40.0, 50.0, 60.0]),
+            }
+        )
+    )
+    got = _run(
+        t, ["a", "b"],
+        [AggSpec("sum", "v", "sv"), AggSpec("count", "v", "cv")],
+        slots=16,
+    )
+    got = got.sort_values(["a", "b"], na_position="last").reset_index(drop=True)
+    # groups: (x,1)->40, (y,1)->20, (y,null)->50, (null,2)->100
+    assert len(got) == 4
+    gx1 = got[(got["a"] == "x") & (got["b"] == 1)]
+    assert float(gx1["sv"].iloc[0]) == 40.0 and int(gx1["cv"].iloc[0]) == 2
+    gnull2 = got[got["a"].isna()]
+    assert float(gnull2["sv"].iloc[0]) == 100.0
+
+
+def test_partial_then_final_equals_single():
+    """The distributed contract: partial on shards + final == single-node."""
+    rng = np.random.default_rng(2)
+    k = rng.integers(0, 20, 2000)
+    v = rng.normal(size=2000)
+    full = arrow_to_table(pa.table({"k": k, "v": v}))
+    aggs = [
+        AggSpec("sum", "v", "sv"),
+        AggSpec("count", "v", "cv"),
+        AggSpec("min", "v", "mn"),
+        AggSpec("max", "v", "mx"),
+        AggSpec("avg", "v", "av"),
+    ]
+    single = _run(full, ["k"], aggs, slots=128).sort_values("k").reset_index(drop=True)
+
+    # shard into two halves, partial-aggregate each, concat, final-aggregate
+    from datafusion_distributed_tpu.ops.table import concat_tables
+
+    h1 = arrow_to_table(pa.table({"k": k[:1000], "v": v[:1000]}), capacity=2048)
+    h2 = arrow_to_table(pa.table({"k": k[1000:], "v": v[1000:]}), capacity=2048)
+    p1, o1 = hash_aggregate(h1, ["k"], aggs, 128, "partial")
+    p2, o2 = hash_aggregate(h2, ["k"], aggs, 128, "partial")
+    assert not bool(o1) and not bool(o2)
+    merged = concat_tables([p1, p2], capacity=256)
+    fin, o3 = hash_aggregate(merged, ["k"], aggs, 128, "final")
+    assert not bool(o3)
+    fin = fin.to_pandas().sort_values("k").reset_index(drop=True)
+    np.testing.assert_array_equal(fin["k"], single["k"])
+    np.testing.assert_allclose(fin["sv"], single["sv"], rtol=1e-12)
+    np.testing.assert_array_equal(fin["cv"], single["cv"])
+    np.testing.assert_array_equal(fin["mn"], single["mn"])
+    np.testing.assert_array_equal(fin["mx"], single["mx"])
+    np.testing.assert_allclose(fin["av"], single["av"], rtol=1e-12)
+
+
+def test_overflow_flag():
+    k = np.arange(100)  # 100 distinct groups
+    t = arrow_to_table(pa.table({"k": k, "v": k * 1.0}))
+    _, overflow = hash_aggregate(
+        t, ["k"], [AggSpec("sum", "v", "s")], num_slots=32
+    )
+    assert bool(overflow)
+
+
+def test_high_collision_pressure():
+    """num_slots barely above NDV: linear probing must still resolve."""
+    rng = np.random.default_rng(3)
+    k = rng.integers(0, 120, 4000)
+    t = arrow_to_table(pa.table({"k": k, "v": np.ones(4000)}))
+    out, overflow = hash_aggregate(
+        t, ["k"], [AggSpec("count_star", None, "n")], num_slots=128,
+        mode="single",
+    )
+    assert not bool(overflow)
+    got = out.to_pandas().sort_values("k").reset_index(drop=True)
+    exp = pd.Series(k).value_counts().sort_index()
+    np.testing.assert_array_equal(got["k"], exp.index)
+    np.testing.assert_array_equal(got["n"], exp.values)

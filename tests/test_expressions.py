@@ -1,0 +1,195 @@
+"""Expression IR golden tests vs numpy semantics (incl. SQL null logic)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from datafusion_distributed_tpu.io.parquet import arrow_to_table
+from datafusion_distributed_tpu.plan.expressions import (
+    Alias,
+    BinaryOp,
+    BooleanOp,
+    Case,
+    Cast,
+    Col,
+    InList,
+    IsNull,
+    Like,
+    Literal,
+    Negate,
+    Not,
+    parse_date,
+)
+from datafusion_distributed_tpu.schema import DataType
+
+
+def t_numbers():
+    return arrow_to_table(
+        pa.table(
+            {
+                "x": pa.array([1, 2, None, 4, 5], type=pa.int64()),
+                "y": pa.array([10.0, 0.5, 3.0, None, 2.0]),
+                "s": pa.array(["apple", "banana", "cherry", "apple", None]),
+            }
+        )
+    )
+
+
+def _eval(expr, table):
+    v = expr.evaluate(table)
+    n = int(table.num_rows)
+    data = np.asarray(v.data[:n])
+    valid = (
+        np.asarray(v.valid_mask()[:n]) if v.validity is not None else np.ones(n, bool)
+    )
+    return data, valid
+
+
+def test_arithmetic_and_promotion():
+    t = t_numbers()
+    expr = BinaryOp("+", Col("x"), Col("y"))
+    data, valid = _eval(expr, t)
+    np.testing.assert_allclose(data[[0, 1]], [11.0, 2.5])
+    assert not valid[2] and not valid[3]  # null propagation both sides
+
+
+def test_division_by_zero_yields_null():
+    t = arrow_to_table(pa.table({"a": [10, 20], "b": [2, 0]}))
+    data, valid = _eval(BinaryOp("/", Col("a"), Col("b")), t)
+    assert data[0] == 5.0
+    assert not valid[1]
+
+
+def test_comparison_and_kleene_logic():
+    t = t_numbers()
+    # (x > 1) AND (y > 1): row2 x null -> null AND true = null;
+    gt = BooleanOp("and", BinaryOp(">", Col("x"), Literal(1, DataType.INT64)),
+                   BinaryOp(">", Col("y"), Literal(1.0, DataType.FLOAT64)))
+    data, valid = _eval(gt, t)
+    # row0: (1>1)=F AND (10>1)=T -> false, valid
+    assert valid[0] and not data[0]
+    # row1: (2>1)=T AND (0.5>1)=F -> false, valid
+    assert valid[1] and not data[1]
+    assert not valid[2]  # null AND true -> null
+    # row3: 4>1 true AND null -> null
+    assert not valid[3]
+    # null AND false -> false (valid): row2 with y>100
+    f = BooleanOp("and", BinaryOp(">", Col("x"), Literal(1, DataType.INT64)),
+                  BinaryOp(">", Col("y"), Literal(100.0, DataType.FLOAT64)))
+    data, valid = _eval(f, t)
+    assert valid[2] and not data[2]
+
+
+def test_or_kleene():
+    t = t_numbers()
+    # null OR true = true
+    e = BooleanOp("or", BinaryOp(">", Col("x"), Literal(1, DataType.INT64)),
+                  BinaryOp(">", Col("y"), Literal(1.0, DataType.FLOAT64)))
+    data, valid = _eval(e, t)
+    assert valid[2] and data[2]  # null OR (3>1 true) = true
+
+
+def test_string_equality_and_order():
+    t = t_numbers()
+    eq = BinaryOp("==", Col("s"), Literal("apple", DataType.STRING))
+    data, valid = _eval(eq, t)
+    assert list(data[:4]) == [True, False, False, True]
+    assert not valid[4]
+    # absent literal -> all false
+    eq2 = BinaryOp("==", Col("s"), Literal("zzz", DataType.STRING))
+    data, _ = _eval(eq2, t)
+    assert not data[:4].any()
+    # order: s < 'b' matches only 'apple'
+    lt = BinaryOp("<", Col("s"), Literal("b", DataType.STRING))
+    data, _ = _eval(lt, t)
+    assert list(data[:4]) == [True, False, False, True]
+    # s <= 'banana'
+    le = BinaryOp("<=", Col("s"), Literal("banana", DataType.STRING))
+    data, _ = _eval(le, t)
+    assert list(data[:4]) == [True, True, False, True]
+    # flipped literal side: 'banana' >= s  === s <= 'banana'
+    ge = BinaryOp(">=", Literal("banana", DataType.STRING), Col("s"))
+    data2, _ = _eval(ge, t)
+    assert list(data2[:4]) == list(data[:4])
+
+
+def test_like_on_dictionary():
+    t = t_numbers()
+    e = Like(Col("s"), "%an%")
+    data, _ = _eval(e, t)
+    assert list(data[:4]) == [False, True, False, False]
+    e = Like(Col("s"), "a%", negated=True)
+    data, _ = _eval(e, t)
+    assert list(data[:4]) == [False, True, True, False]
+
+
+def test_in_list():
+    t = t_numbers()
+    e = InList(Col("s"), ("apple", "cherry"))
+    data, _ = _eval(e, t)
+    assert list(data[:4]) == [True, False, True, True]
+    e = InList(Col("x"), (1, 4), negated=True)
+    data, valid = _eval(e, t)
+    assert list(data[[0, 1, 3]]) == [False, True, False]
+
+
+def test_case_expr():
+    t = t_numbers()
+    e = Case(
+        branches=(
+            (BinaryOp(">", Col("y"), Literal(5.0, DataType.FLOAT64)),
+             Literal(100, DataType.INT64)),
+            (BinaryOp(">", Col("y"), Literal(1.0, DataType.FLOAT64)),
+             Literal(50, DataType.INT64)),
+        ),
+        otherwise=Literal(0, DataType.INT64),
+    )
+    data, valid = _eval(e, t)
+    assert list(data[:3]) == [100, 0, 50]
+
+
+def test_is_null_not_negate_cast():
+    t = t_numbers()
+    data, valid = _eval(IsNull(Col("x")), t)
+    assert list(data) == [False, False, True, False, False]
+    data, _ = _eval(IsNull(Col("x"), negated=True), t)
+    assert list(data) == [True, True, False, True, True]
+    data, _ = _eval(Not(BinaryOp(">", Col("x"), Literal(2, DataType.INT64))), t)
+    assert list(data[[0, 1, 3]]) == [True, True, False]
+    data, _ = _eval(Negate(Col("x")), t)
+    assert data[0] == -1
+    data, _ = _eval(Cast(Col("x"), DataType.FLOAT64), t)
+    assert data.dtype == np.float64
+
+
+def test_date_literal_comparison():
+    t = arrow_to_table(
+        pa.table({"d": pa.array(
+            np.array(["1998-01-01", "1998-12-31"], dtype="datetime64[D]")
+        )})
+    )
+    e = BinaryOp("<=", Col("d"), Literal(parse_date("1998-09-02"), DataType.DATE32))
+    data, _ = _eval(e, t)
+    assert list(data) == [True, False]
+
+
+def test_expression_fuses_under_jit():
+    t = t_numbers()
+    expr = BooleanOp(
+        "and",
+        BinaryOp(">", BinaryOp("*", Col("y"), Literal(2.0, DataType.FLOAT64)),
+                 Literal(1.0, DataType.FLOAT64)),
+        IsNull(Col("x"), negated=True),
+    )
+
+    @jax.jit
+    def run(table):
+        v = expr.evaluate(table)
+        return table.compact(v.data & v.valid_mask())
+
+    out = run(t)
+    # y*2>1: rows 0,2,4; x not null: rows 0,1,3,4 -> intersection rows 0,4
+    assert int(out.num_rows) == 2
+    got = out.to_numpy()["x"]
+    np.testing.assert_array_equal(got, [1, 5])
