@@ -94,16 +94,22 @@ def arrow_to_host_columns(
                 d = provided
             else:
                 d = Dictionary(np.unique(strs[null_mask]).astype(object))
-            # Vectorized encode: dictionary is sorted, so searchsorted gives
-            # candidate codes; an equality check catches absent values.
-            sorted_vals = d.values.astype(str)
-            if len(sorted_vals):
+            # Vectorized encode: a sorted dictionary admits searchsorted with
+            # an equality check for absent values; unsorted (caller-provided)
+            # dictionaries fall back to the exact hash-map path.
+            if len(d.values) == 0:
+                codes = np.full(len(strs), -1, dtype=np.int32)
+            elif d.is_sorted():
+                sorted_vals = d.values.astype(str)
                 pos = np.searchsorted(sorted_vals, strs)
                 pos_c = np.clip(pos, 0, len(sorted_vals) - 1).astype(np.int32)
                 found = sorted_vals[pos_c] == strs
                 codes = np.where(found, pos_c, -1).astype(np.int32)
             else:
-                codes = np.full(len(strs), -1, dtype=np.int32)
+                idx = d.index()
+                codes = np.asarray(
+                    [idx.get(v, -1) for v in strs], dtype=np.int32
+                )
             null_mask = null_mask & (codes >= 0)
             codes = np.where(codes < 0, 0, codes)
             data[f.name] = codes

@@ -209,6 +209,25 @@ def hash_aggregate(
     return packed, gt.overflow
 
 
+def global_aggregate(table: Table, aggs: Sequence[AggSpec], mode: str = "single") -> Table:
+    """Aggregation with no GROUP BY: one output row (capacity 8 keeps the
+    result TPU-lane-friendly). Shares the per-aggregate evaluation with
+    hash_aggregate, with every live row mapped to group 0."""
+    live = table.row_mask()
+    cap = 8
+    gid = jnp.zeros(table.capacity, dtype=jnp.int32)
+
+    def seg_sum(vals, dtype=None):
+        z = jnp.zeros(cap, dtype=dtype or vals.dtype)
+        return z.at[gid].add(vals, mode="drop")
+
+    cols: dict[str, Column] = {}
+    for spec in aggs:
+        cols.update(_eval_agg(spec, table, gid, live, cap, mode, seg_sum))
+    return Table(tuple(cols.keys()), tuple(cols.values()),
+                 jnp.asarray(1, dtype=jnp.int32))
+
+
 def _eval_agg(spec, table, gid, live, num_slots, mode, seg_sum):
     """Produce the output column(s) for one AggSpec in the given mode."""
     name = spec.output_name

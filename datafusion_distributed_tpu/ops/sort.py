@@ -1,0 +1,71 @@
+"""Sorting kernels: multi-key lexicographic sort, limit, top-k.
+
+The reference uses DataFusion's `SortExec`/`SortPreservingMergeExec`
+(SURVEY.md L0; the distributed planner treats a sort above a stage as a
+coalesce point, `inject_network_boundaries.rs` sort/coalesce case). XLA has a
+high-quality parallel sort, so the TPU design is: stable argsort per key from
+least- to most-significant (radix-style composition), with dead/padding rows
+forced to the tail so `num_rows` semantics survive.
+
+String keys sort by dictionary code (dictionaries are sorted => code order is
+lexicographic). Nulls order via a separate flag pass (no in-band sentinel).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from datafusion_distributed_tpu.ops.table import Table
+
+
+@dataclass(frozen=True)
+class SortKey:
+    name: str
+    ascending: bool = True
+    nulls_first: bool = False
+
+
+def sort_permutation(table: Table, keys: list[SortKey]) -> jnp.ndarray:
+    """[capacity] permutation: live rows in key order first, dead rows last."""
+    cap = table.capacity
+    perm = jnp.arange(cap, dtype=jnp.int32)
+    # Least-significant key first; stable sorts compose lexicographically.
+    for key in reversed(keys):
+        col = table.column(key.name)
+        vals = col.data
+        if vals.dtype == jnp.bool_:
+            vals = vals.astype(jnp.int32)
+        if not key.ascending:
+            if jnp.issubdtype(vals.dtype, jnp.floating):
+                vals = -vals
+            else:
+                # avoid signed overflow on INT_MIN: flip via complement
+                vals = ~vals if jnp.issubdtype(vals.dtype, jnp.integer) else -vals
+        perm = perm[jnp.argsort(vals[perm], stable=True)]
+        if col.validity is not None:
+            # null-flag pass dominates the value pass for this key
+            flag = (
+                col.validity if key.nulls_first else ~col.validity
+            )  # False sorts first
+            perm = perm[jnp.argsort(flag[perm].astype(jnp.int32), stable=True)]
+    # Dead rows to the tail (most significant pass of all).
+    dead = ~table.row_mask()
+    perm = perm[jnp.argsort(dead[perm].astype(jnp.int32), stable=True)]
+    return perm
+
+
+def sort_table(table: Table, keys: list[SortKey]) -> Table:
+    return table.gather(sort_permutation(table, keys), table.num_rows)
+
+
+def limit_table(table: Table, fetch, skip=0) -> Table:
+    """LIMIT fetch OFFSET skip over an ordered table (jit-safe)."""
+    cap = table.capacity
+    skip = jnp.asarray(skip, dtype=jnp.int32)
+    fetch = jnp.asarray(fetch, dtype=jnp.int32)
+    remaining = jnp.maximum(table.num_rows - skip, 0)
+    n = jnp.minimum(remaining, fetch)
+    idx = jnp.clip(jnp.arange(cap, dtype=jnp.int32) + skip, 0, cap - 1)
+    return table.gather(idx, n)

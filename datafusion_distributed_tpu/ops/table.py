@@ -91,8 +91,10 @@ class Dictionary:
         return out
 
     def is_sorted(self) -> bool:
-        v = self.values
-        return all(v[i] <= v[i + 1] for i in range(len(v) - 1))
+        if len(self.values) < 2:
+            return True
+        v = self.values.astype(str)
+        return bool(np.all(v[:-1] <= v[1:]))
 
     def __eq__(self, other: object) -> bool:
         return isinstance(other, Dictionary) and other.dict_id == self.dict_id

@@ -1,0 +1,568 @@
+"""Physical plan IR: the ExecutionPlan tree.
+
+The reference builds on DataFusion's `ExecutionPlan` trait (async per-partition
+`RecordBatch` streams; SURVEY.md L0) and inserts its distributed operators into
+that tree (`/root/reference/src/execution_plans/`). The TPU re-design keeps
+the *tree* (the planner passes need it) but changes the execution contract:
+
+- an operator's `execute(ctx)` does not stream; it **traces** the whole
+  per-task pipeline into one XLA computation over padded Tables. XLA fusion
+  replaces the volcano pipeline — filter+project+partial-agg become one fused
+  kernel on the device.
+- per-task intra-operator partitions collapse to 1: on a TPU the chip's
+  parallelism comes from XLA, not operator threads. The reference's
+  partition-level parallelism maps to *tasks* (devices) instead; see
+  parallel/ for the exchange operators.
+- leaf scans run on the host (Parquet decode) *before* tracing; the executor
+  passes their Tables in as pytree arguments so the traced function is
+  shape-stable and cacheable across batches of the same capacity.
+
+Every node computes a static `output_capacity` — the padded row bound that
+makes XLA shapes static (SURVEY.md §7 hard part (c)).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from datafusion_distributed_tpu.ops.aggregate import AggSpec, hash_aggregate
+from datafusion_distributed_tpu.ops.sort import SortKey, limit_table, sort_table
+from datafusion_distributed_tpu.ops.table import (
+    Column,
+    Table,
+    concat_tables,
+    round_up_pow2,
+)
+from datafusion_distributed_tpu.plan.expressions import (
+    PhysicalExpr,
+    expr_to_column,
+)
+from datafusion_distributed_tpu.schema import DataType, Field, Schema
+
+
+# ---------------------------------------------------------------------------
+# Task context
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DistributedTaskContext:
+    """Which task of a stage this execution is (reference:
+    `src/stage.rs` DistributedTaskContext)."""
+
+    task_index: int = 0
+    task_count: int = 1
+
+
+@dataclass
+class ExecContext:
+    """Carried through `execute` tracing."""
+
+    task: DistributedTaskContext
+    inputs: dict[int, Table]  # leaf node_id -> loaded device Table
+    overflow_flags: list = dc_field(default_factory=list)
+    config: dict = dc_field(default_factory=dict)
+
+    def record_overflow(self, node: "ExecutionPlan", flag) -> None:
+        self.overflow_flags.append((node.label(), flag))
+
+
+_NODE_COUNTER = itertools.count()
+
+
+# ---------------------------------------------------------------------------
+# Base node
+# ---------------------------------------------------------------------------
+
+
+class ExecutionPlan:
+    """Base of the physical plan tree."""
+
+    def __init__(self) -> None:
+        self.node_id = next(_NODE_COUNTER)
+
+    # -- tree ---------------------------------------------------------------
+    def children(self) -> list["ExecutionPlan"]:
+        raise NotImplementedError
+
+    def with_new_children(self, children: list["ExecutionPlan"]) -> "ExecutionPlan":
+        raise NotImplementedError
+
+    # -- properties ---------------------------------------------------------
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    def output_capacity(self) -> int:
+        raise NotImplementedError
+
+    # -- execution ----------------------------------------------------------
+    def execute(self, ctx: ExecContext) -> Table:
+        raise NotImplementedError
+
+    # -- display ------------------------------------------------------------
+    def label(self) -> str:
+        return type(self).__name__.removesuffix("Exec")
+
+    def display(self) -> str:
+        return self.label()
+
+    def display_tree(self, indent: int = 0) -> str:
+        lines = ["  " * indent + self.display()]
+        for c in self.children():
+            lines.append(c.display_tree(indent + 1))
+        return "\n".join(lines)
+
+    # -- traversal helpers --------------------------------------------------
+    def transform_up(self, f: Callable[["ExecutionPlan"], "ExecutionPlan"]):
+        new_children = [c.transform_up(f) for c in self.children()]
+        node = self.with_new_children(new_children) if new_children else self
+        return f(node)
+
+    def transform_down(self, f: Callable[["ExecutionPlan"], "ExecutionPlan"]):
+        node = f(self)
+        children = [c.transform_down(f) for c in node.children()]
+        return node.with_new_children(children) if children else node
+
+    def collect(self, pred: Callable[["ExecutionPlan"], bool]) -> list["ExecutionPlan"]:
+        out = [self] if pred(self) else []
+        for c in self.children():
+            out.extend(c.collect(pred))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Leaves
+# ---------------------------------------------------------------------------
+
+
+class MemoryScanExec(ExecutionPlan):
+    """Scan over pre-loaded per-task device Tables.
+
+    The reference's `DistributedLeafExec` holds per-task variants of a leaf
+    and picks by `task_index` (`src/execution_plans/distributed_leaf.rs`);
+    here each task's slice is one padded Table in `tasks`.
+    """
+
+    def __init__(self, tasks: Sequence[Table], schema: Schema):
+        super().__init__()
+        self.tasks = list(tasks)
+        self._schema = schema
+
+    def children(self):
+        return []
+
+    def with_new_children(self, children):
+        assert not children
+        return self
+
+    def schema(self):
+        return self._schema
+
+    def output_capacity(self):
+        return max(t.capacity for t in self.tasks)
+
+    def load(self, task: DistributedTaskContext) -> Table:
+        if task.task_index >= len(self.tasks):
+            # Tasks beyond the data slices read nothing (the reference's
+            # short coalesce groups yield empty streams the same way).
+            ref = self.tasks[0]
+            return Table.empty(self._schema, ref.capacity, _dicts_of(ref))
+        return self.tasks[task.task_index]
+
+    def execute(self, ctx: ExecContext) -> Table:
+        return ctx.inputs[self.node_id]
+
+    def display(self):
+        return f"MemoryScan tasks={len(self.tasks)} cap={self.output_capacity()}"
+
+
+class ParquetScanExec(ExecutionPlan):
+    """Parquet leaf: per-task file groups decoded on the host, uploaded padded.
+
+    Mirrors the role of DataFusion's `DataSourceExec` + the reference's
+    task-specialized file-group slicing (`task_estimator.rs` scale_up path).
+    """
+
+    def __init__(
+        self,
+        file_groups: Sequence[Sequence[str]],  # one list of files per task
+        schema: Schema,
+        capacity: int,
+        projection: Optional[Sequence[str]] = None,
+        dictionaries: Optional[dict] = None,
+    ):
+        super().__init__()
+        self.file_groups = [list(g) for g in file_groups]
+        self._schema = schema if projection is None else schema.select(projection)
+        self.projection = list(projection) if projection else None
+        self.capacity = capacity
+        self.dictionaries = dictionaries
+
+    def children(self):
+        return []
+
+    def with_new_children(self, children):
+        assert not children
+        return self
+
+    def schema(self):
+        return self._schema
+
+    def output_capacity(self):
+        return self.capacity
+
+    def load(self, task: DistributedTaskContext) -> Table:
+        from datafusion_distributed_tpu.io.parquet import read_parquet
+
+        files = (
+            self.file_groups[task.task_index]
+            if task.task_index < len(self.file_groups)
+            else []
+        )
+        if not files:
+            return Table.empty(self._schema, self.capacity, self.dictionaries)
+        return read_parquet(
+            files,
+            columns=self.projection,
+            capacity=self.capacity,
+            dictionaries=self.dictionaries,
+        )
+
+    def execute(self, ctx: ExecContext) -> Table:
+        return ctx.inputs[self.node_id]
+
+    def display(self):
+        nfiles = sum(len(g) for g in self.file_groups)
+        return (
+            f"ParquetScan tasks={len(self.file_groups)} files={nfiles} "
+            f"cap={self.capacity}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Unary operators
+# ---------------------------------------------------------------------------
+
+
+class FilterExec(ExecutionPlan):
+    def __init__(self, predicate: PhysicalExpr, child: ExecutionPlan):
+        super().__init__()
+        self.predicate = predicate
+        self.child = child
+
+    def children(self):
+        return [self.child]
+
+    def with_new_children(self, children):
+        return FilterExec(self.predicate, children[0])
+
+    def schema(self):
+        return self.child.schema()
+
+    def output_capacity(self):
+        return self.child.output_capacity()
+
+    def execute(self, ctx: ExecContext) -> Table:
+        t = self.child.execute(ctx)
+        v = self.predicate.evaluate(t)
+        keep = v.data.astype(jnp.bool_) & v.valid_mask()
+        return t.compact(keep)
+
+    def display(self):
+        return f"Filter: {self.predicate.display()}"
+
+
+class ProjectionExec(ExecutionPlan):
+    def __init__(self, exprs: Sequence[tuple[PhysicalExpr, str]], child: ExecutionPlan):
+        super().__init__()
+        self.exprs = list(exprs)
+        self.child = child
+
+    def children(self):
+        return [self.child]
+
+    def with_new_children(self, children):
+        return ProjectionExec(self.exprs, children[0])
+
+    def schema(self):
+        child_schema = self.child.schema()
+        fields = []
+        for expr, name in self.exprs:
+            f = expr.output_field(child_schema)
+            fields.append(Field(name, f.dtype, f.nullable))
+        return Schema(fields)
+
+    def output_capacity(self):
+        return self.child.output_capacity()
+
+    def execute(self, ctx: ExecContext) -> Table:
+        t = self.child.execute(ctx)
+        cols = {}
+        for expr, name in self.exprs:
+            cols[name] = expr_to_column(expr.evaluate(t))
+        return Table(tuple(cols.keys()), tuple(cols.values()), t.num_rows)
+
+    def display(self):
+        inner = ", ".join(f"{e.display()} AS {n}" for e, n in self.exprs)
+        return f"Projection: {inner}"
+
+
+class HashAggregateExec(ExecutionPlan):
+    """GROUP BY over named columns (planner materializes expressions below
+    via a ProjectionExec). Modes: single | partial | final, as in the
+    reference's use of DataFusion AggregateMode (+ PartialReduce analogue to
+    come with the distributed planner)."""
+
+    def __init__(
+        self,
+        mode: str,
+        group_names: Sequence[str],
+        aggs: Sequence[AggSpec],
+        child: ExecutionPlan,
+        num_slots: Optional[int] = None,
+    ):
+        super().__init__()
+        assert mode in ("single", "partial", "final")
+        self.mode = mode
+        self.group_names = list(group_names)
+        self.aggs = list(aggs)
+        self.child = child
+        # Default table size: 2x the input bound keeps the load factor <= 0.5
+        # even in the all-rows-distinct worst case, so the claim loop
+        # converges well inside max_rounds (see ops/aggregate.py docstring).
+        self.num_slots = num_slots or min(
+            round_up_pow2(2 * max(child.output_capacity(), 16)), 1 << 20
+        )
+
+    def children(self):
+        return [self.child]
+
+    def with_new_children(self, children):
+        return HashAggregateExec(
+            self.mode, self.group_names, self.aggs, children[0], self.num_slots
+        )
+
+    def schema(self):
+        child_schema = self.child.schema()
+        fields = [child_schema.field(g) for g in self.group_names]
+        for a in self.aggs:
+            fields.extend(_agg_output_fields(a, child_schema, self.mode))
+        return Schema(fields)
+
+    def output_capacity(self):
+        return self.num_slots
+
+    def execute(self, ctx: ExecContext) -> Table:
+        t = self.child.execute(ctx)
+        if not self.group_names:
+            from datafusion_distributed_tpu.ops.aggregate import global_aggregate
+
+            return global_aggregate(t, self.aggs, self.mode)
+        out, overflow = hash_aggregate(
+            t, self.group_names, self.aggs, self.num_slots, self.mode
+        )
+        ctx.record_overflow(self, overflow)
+        return out
+
+    def display(self):
+        aggs = ", ".join(f"{a.func}({a.input_name or '*'})" for a in self.aggs)
+        return (
+            f"HashAggregate mode={self.mode} gby=[{', '.join(self.group_names)}] "
+            f"aggs=[{aggs}] slots={self.num_slots}"
+        )
+
+
+def _agg_output_fields(a: AggSpec, child_schema: Schema, mode: str) -> list[Field]:
+    if a.func == "count_star" or a.func == "count":
+        return [Field(a.output_name, DataType.INT64, nullable=False)]
+    if a.func == "avg":
+        if mode == "partial":
+            return [
+                Field(f"{a.output_name}__sum", DataType.FLOAT64, True),
+                Field(f"{a.output_name}__count", DataType.INT64, False),
+            ]
+        return [Field(a.output_name, DataType.FLOAT64, True)]
+    if mode == "final":
+        # Final mode consumes the partial stage's accumulator column, which
+        # already carries the merged dtype under the output name.
+        src = child_schema.field(a.output_name)
+        return [Field(a.output_name, src.dtype, True)]
+    src = child_schema.field(a.input_name) if a.input_name else None
+    if a.func == "sum":
+        dt = DataType.FLOAT64 if src.dtype.is_float else DataType.INT64
+        return [Field(a.output_name, dt, True)]
+    # min/max keep input type
+    return [Field(a.output_name, src.dtype, True)]
+
+
+class SortExec(ExecutionPlan):
+    def __init__(self, keys: Sequence[SortKey], child: ExecutionPlan,
+                 fetch: Optional[int] = None):
+        super().__init__()
+        self.keys = list(keys)
+        self.child = child
+        self.fetch = fetch
+
+    def children(self):
+        return [self.child]
+
+    def with_new_children(self, children):
+        return SortExec(self.keys, children[0], self.fetch)
+
+    def schema(self):
+        return self.child.schema()
+
+    def output_capacity(self):
+        return self.child.output_capacity()
+
+    def execute(self, ctx: ExecContext) -> Table:
+        t = sort_table(self.child.execute(ctx), self.keys)
+        if self.fetch is not None:
+            t = t.head(self.fetch)
+        return t
+
+    def display(self):
+        ks = ", ".join(
+            f"{k.name} {'ASC' if k.ascending else 'DESC'}" for k in self.keys
+        )
+        fetch = f" fetch={self.fetch}" if self.fetch is not None else ""
+        return f"Sort: [{ks}]{fetch}"
+
+
+class LimitExec(ExecutionPlan):
+    def __init__(self, child: ExecutionPlan, fetch: int, skip: int = 0):
+        super().__init__()
+        self.child = child
+        self.fetch = fetch
+        self.skip = skip
+
+    def children(self):
+        return [self.child]
+
+    def with_new_children(self, children):
+        return LimitExec(children[0], self.fetch, self.skip)
+
+    def schema(self):
+        return self.child.schema()
+
+    def output_capacity(self):
+        return self.child.output_capacity()
+
+    def execute(self, ctx: ExecContext) -> Table:
+        return limit_table(self.child.execute(ctx), self.fetch, self.skip)
+
+    def display(self):
+        skip = f" skip={self.skip}" if self.skip else ""
+        return f"Limit: fetch={self.fetch}{skip}"
+
+
+class CoalescePartitionsExec(ExecutionPlan):
+    """N input partitions -> 1. In the per-task model a task's plan already
+    yields one Table, so locally this is identity; it exists as the planner's
+    stage-head marker (the reference wraps plans in CoalescePartitionsExec
+    before staging, `distributed_query_planner.rs` shape pass)."""
+
+    def __init__(self, child: ExecutionPlan):
+        super().__init__()
+        self.child = child
+
+    def children(self):
+        return [self.child]
+
+    def with_new_children(self, children):
+        return CoalescePartitionsExec(children[0])
+
+    def schema(self):
+        return self.child.schema()
+
+    def output_capacity(self):
+        return self.child.output_capacity()
+
+    def execute(self, ctx: ExecContext) -> Table:
+        return self.child.execute(ctx)
+
+
+# ---------------------------------------------------------------------------
+# Executor
+# ---------------------------------------------------------------------------
+
+
+def collect_leaves(plan: ExecutionPlan) -> list[ExecutionPlan]:
+    return plan.collect(lambda n: not n.children())
+
+
+def execute_plan(
+    plan: ExecutionPlan,
+    task: Optional[DistributedTaskContext] = None,
+    config: Optional[dict] = None,
+    check_overflow: bool = True,
+    donate: bool = False,
+) -> Table:
+    """Run a (single-task) plan: host-load leaves, trace+jit the rest once.
+
+    The jit cache key is the plan object identity plus input shapes, so
+    repeated execution over same-capacity batches reuses the compiled
+    executable (the analogue of the reference's task re-execution against the
+    cached plan in `TaskData`).
+    """
+    task = task or DistributedTaskContext()
+    leaves = collect_leaves(plan)
+    inputs = {}
+    for leaf in leaves:
+        if hasattr(leaf, "load"):
+            inputs[leaf.node_id] = leaf.load(task)
+
+    overflow_box: list = []
+
+    def run(inp):
+        ctx = ExecContext(task=task, inputs=inp, config=config or {})
+        out = ctx_out = plan.execute(ctx)
+        overflow_box.clear()
+        overflow_box.extend(ctx.overflow_flags)
+        flags = [f for _, f in ctx.overflow_flags]
+        any_overflow = (
+            jnp.any(jnp.stack(flags)) if flags else jnp.asarray(False)
+        )
+        return out, any_overflow
+
+    cache_key = (
+        plan.node_id,
+        task.task_index,
+        task.task_count,
+        tuple(sorted((config or {}).items())),
+    )
+    fn = _get_compiled(plan, run, cache_key)
+    out, any_overflow = fn(inputs)
+    if check_overflow and bool(any_overflow):
+        raise RuntimeError(
+            f"hash table overflow in plan (nodes: "
+            f"{[name for name, _ in overflow_box]}); re-plan with more slots"
+        )
+    return out
+
+
+_COMPILE_CACHE: dict = {}
+_COMPILE_CACHE_MAX = 512
+
+
+def _get_compiled(plan: ExecutionPlan, run: Callable, cache_key) -> Callable:
+    fn = _COMPILE_CACHE.get(cache_key)
+    if fn is None:
+        if len(_COMPILE_CACHE) >= _COMPILE_CACHE_MAX:
+            _COMPILE_CACHE.clear()
+        fn = jax.jit(run)
+        _COMPILE_CACHE[cache_key] = fn
+    return fn
+
+
+def _dicts_of(table: Table) -> dict:
+    return {
+        n: c.dictionary
+        for n, c in zip(table.names, table.columns)
+        if c.dictionary is not None
+    }

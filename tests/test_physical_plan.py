@@ -1,0 +1,194 @@
+"""Physical plan IR: single-task execution, operator composition."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from datafusion_distributed_tpu.io.parquet import arrow_to_table
+from datafusion_distributed_tpu.ops.aggregate import AggSpec
+from datafusion_distributed_tpu.ops.sort import SortKey
+from datafusion_distributed_tpu.plan.expressions import (
+    BinaryOp,
+    Col,
+    Literal,
+)
+from datafusion_distributed_tpu.plan.physical import (
+    DistributedTaskContext,
+    FilterExec,
+    HashAggregateExec,
+    LimitExec,
+    MemoryScanExec,
+    ParquetScanExec,
+    ProjectionExec,
+    SortExec,
+    execute_plan,
+)
+from datafusion_distributed_tpu.schema import DataType
+
+
+def sample_table(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    return pa.table(
+        {
+            "k": rng.integers(0, 8, n),
+            "v": rng.normal(size=n),
+            "w": rng.integers(-50, 50, n),
+        }
+    )
+
+
+def test_scan_filter_project_aggregate_sort_limit():
+    arrow = sample_table()
+    t = arrow_to_table(arrow)
+    scan = MemoryScanExec([t], t.schema())
+    filt = FilterExec(
+        BinaryOp(">", Col("w"), Literal(0, DataType.INT64)), scan
+    )
+    proj = ProjectionExec(
+        [(Col("k"), "k"),
+         (BinaryOp("*", Col("v"), Literal(2.0, DataType.FLOAT64)), "v2")],
+        filt,
+    )
+    agg = HashAggregateExec(
+        "single", ["k"], [AggSpec("sum", "v2", "s"), AggSpec("count_star", None, "n")],
+        proj, num_slots=32,
+    )
+    sort = SortExec([SortKey("s", ascending=False)], agg)
+    lim = LimitExec(sort, fetch=3)
+    out = execute_plan(lim).to_pandas()
+
+    df = arrow.to_pandas()
+    df = df[df.w > 0]
+    df["v2"] = df.v * 2
+    exp = (
+        df.groupby("k").agg(s=("v2", "sum"), n=("v2", "size")).reset_index()
+        .sort_values("s", ascending=False).head(3).reset_index(drop=True)
+    )
+    np.testing.assert_array_equal(out["k"], exp["k"])
+    np.testing.assert_allclose(out["s"], exp["s"], rtol=1e-12)
+    np.testing.assert_array_equal(out["n"], exp["n"])
+
+
+def test_global_aggregate_no_groups():
+    arrow = sample_table()
+    t = arrow_to_table(arrow)
+    scan = MemoryScanExec([t], t.schema())
+    agg = HashAggregateExec(
+        "single", [],
+        [AggSpec("sum", "w", "sw"), AggSpec("count_star", None, "n"),
+         AggSpec("min", "w", "mn"), AggSpec("avg", "v", "av")],
+        scan,
+    )
+    out = execute_plan(agg).to_pandas()
+    df = arrow.to_pandas()
+    assert len(out) == 1
+    assert int(out["sw"][0]) == int(df.w.sum())
+    assert int(out["n"][0]) == len(df)
+    assert int(out["mn"][0]) == int(df.w.min())
+    np.testing.assert_allclose(out["av"][0], df.v.mean(), rtol=1e-12)
+
+
+def test_sort_multi_key_with_nulls():
+    arrow = pa.table(
+        {
+            "a": pa.array([2, 1, 2, None, 1], type=pa.int64()),
+            "b": pa.array([1.0, 5.0, 0.5, 9.9, None]),
+        }
+    )
+    t = arrow_to_table(arrow)
+    scan = MemoryScanExec([t], t.schema())
+    sort = SortExec([SortKey("a", True, nulls_first=False),
+                     SortKey("b", False, nulls_first=False)], scan)
+    out = execute_plan(sort).to_pandas()
+    # expect a asc (nulls last), b desc (nulls last) within groups
+    exp = (
+        arrow.to_pandas()
+        .sort_values(["a", "b"], ascending=[True, False],
+                     na_position="last", kind="stable")
+        # pandas sorts nulls-last per column but sorts 'a' nulls after;
+        .reset_index(drop=True)
+    )
+    # row order: a=1:(b=5.0, b=null), a=2:(b=1.0, 0.5), a=null
+    assert list(out["a"].fillna(-1)) == [1, 1, 2, 2, -1]
+    assert out["b"][0] == 5.0 and pd.isna(out["b"][1])
+    assert out["b"][2] == 1.0 and out["b"][3] == 0.5
+
+
+def test_limit_offset():
+    arrow = pa.table({"x": list(range(10))})
+    t = arrow_to_table(arrow)
+    plan = LimitExec(MemoryScanExec([t], t.schema()), fetch=3, skip=4)
+    out = execute_plan(plan).to_pandas()
+    assert list(out["x"]) == [4, 5, 6]
+
+
+def test_parquet_scan_multi_task(tmp_path):
+    files = []
+    for i in range(3):
+        p = tmp_path / f"f{i}.parquet"
+        pq.write_table(pa.table({"x": [i * 10 + j for j in range(5)]}), p)
+        files.append(str(p))
+    from datafusion_distributed_tpu.io.parquet import schema_from_arrow
+
+    schema = schema_from_arrow(pq.read_schema(files[0]))
+    scan = ParquetScanExec(
+        file_groups=[[files[0], files[1]], [files[2]]],
+        schema=schema,
+        capacity=16,
+    )
+    t0 = execute_plan(scan, DistributedTaskContext(0, 2)).to_pandas()
+    t1 = execute_plan(scan, DistributedTaskContext(1, 2)).to_pandas()
+    assert list(t0["x"]) == [0, 1, 2, 3, 4, 10, 11, 12, 13, 14]
+    assert list(t1["x"]) == [20, 21, 22, 23, 24]
+
+
+def test_overflow_raises_at_executor():
+    rng = np.random.default_rng(5)
+    arrow = pa.table({"k": rng.integers(0, 1000, 2000), "v": np.ones(2000)})
+    t = arrow_to_table(arrow)
+    agg = HashAggregateExec(
+        "single", ["k"], [AggSpec("count_star", None, "n")],
+        MemoryScanExec([t], t.schema()), num_slots=64,
+    )
+    with pytest.raises(RuntimeError, match="overflow"):
+        execute_plan(agg)
+
+
+def test_display_tree():
+    arrow = sample_table(10)
+    t = arrow_to_table(arrow)
+    plan = LimitExec(
+        FilterExec(BinaryOp(">", Col("w"), Literal(0, DataType.INT64)),
+                   MemoryScanExec([t], t.schema())),
+        fetch=5,
+    )
+    s = plan.display_tree()
+    assert "Limit" in s and "Filter" in s and "MemoryScan" in s
+
+
+def test_final_mode_schema_after_partial():
+    arrow = sample_table(50)
+    t = arrow_to_table(arrow)
+    scan = MemoryScanExec([t], t.schema())
+    partial = HashAggregateExec(
+        "partial", ["k"],
+        [AggSpec("sum", "v", "sv"), AggSpec("avg", "v", "av"),
+         AggSpec("min", "w", "mn")],
+        scan, num_slots=32,
+    )
+    fin = HashAggregateExec(
+        "final", ["k"],
+        [AggSpec("sum", "v", "sv"), AggSpec("avg", "v", "av"),
+         AggSpec("min", "w", "mn")],
+        partial, num_slots=32,
+    )
+    s = fin.schema()  # must not KeyError on raw input names
+    assert s.names == ["k", "sv", "av", "mn"]
+    out = execute_plan(fin).to_pandas().sort_values("k").reset_index(drop=True)
+    df = arrow.to_pandas().groupby("k").agg(
+        sv=("v", "sum"), av=("v", "mean"), mn=("w", "min")).reset_index()
+    np.testing.assert_allclose(out["sv"], df["sv"], rtol=1e-12)
+    np.testing.assert_allclose(out["av"], df["av"], rtol=1e-12)
+    np.testing.assert_array_equal(out["mn"], df["mn"])
