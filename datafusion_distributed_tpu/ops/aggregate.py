@@ -61,17 +61,25 @@ def build_group_table(
     key_valids: Sequence[Optional[jnp.ndarray]],
     live: jnp.ndarray,
     num_slots: int,
-    max_rounds: int = 64,
+    max_rounds: int = 512,
+    lane_plan: Optional[Sequence[bool]] = None,
 ) -> GroupTable:
-    """Assign each live row a group id (a slot in a power-of-two table)."""
+    """Assign each live row a group id (a slot in a power-of-two table).
+
+    ``lane_plan`` fixes which key columns carry a validity lane (True).
+    Joins pass the union of build+probe nullability so both sides fold to
+    identical compare-matrix shapes; by default it mirrors ``key_valids``.
+    """
     assert num_slots & (num_slots - 1) == 0, "num_slots must be a power of two"
     n = key_cols[0].shape[0]
     k = len(key_cols)
     mask = np.uint32(num_slots - 1)
+    if lane_plan is None:
+        lane_plan = [v is not None for v in key_valids]
 
     # Keys folded to int64 payloads. Nullability is an explicit extra lane in
     # the compare matrix (not an in-band sentinel, which a real key value
-    # could collide with): nullable column i contributes lanes
+    # could collide with): column i with lane_plan[i] contributes lanes
     # [payload-with-nulls-zeroed, is_valid].
     keys64 = []
     valid_lane_of: list[Optional[int]] = []  # per key col: its validity lane idx
@@ -83,10 +91,13 @@ def build_group_table(
             payload = jnp.where(v, payload, 0)
         keys64.append(payload)
         valid_lane_of.append(None)
-    for i, v in enumerate(key_valids):
-        if v is not None:
+    for i, (v, want) in enumerate(zip(key_valids, lane_plan)):
+        if want:
             valid_lane_of[i] = len(keys64)
-            keys64.append(v.astype(jnp.int64))
+            keys64.append(
+                v.astype(jnp.int64) if v is not None
+                else jnp.ones(n, dtype=jnp.int64)
+            )
 
     h0 = hash_columns(list(key_cols), list(key_valids))
     slot0 = (h0 & mask).astype(jnp.int32)

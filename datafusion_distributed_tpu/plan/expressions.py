@@ -193,6 +193,19 @@ class BinaryOp(PhysicalExpr):
         return ExprValue(data, validity, out_dtype)
 
     def _compare(self, l: ExprValue, r: ExprValue, table: Table) -> jnp.ndarray:
+        # SQL coercion: DATE <op> 'yyyy-mm-dd' parses the string literal.
+        if l.dtype == DataType.DATE32 and isinstance(self.right, Literal) and (
+            self.right.dtype == DataType.STRING
+        ):
+            days = parse_date(self.right.value)
+            return _apply_cmp(self.op, l.data, jnp.asarray(days, dtype=jnp.int32))
+        if r.dtype == DataType.DATE32 and isinstance(self.left, Literal) and (
+            self.left.dtype == DataType.STRING
+        ):
+            days = parse_date(self.left.value)
+            return _apply_cmp(
+                self.op, jnp.asarray(days, dtype=jnp.int32), r.data
+            )
         # String vs string-literal comparison: resolve via sorted dictionary.
         if l.dtype == DataType.STRING or r.dtype == DataType.STRING:
             return self._compare_strings(l, r)
@@ -518,6 +531,91 @@ class Case(PhysicalExpr):
         )
         e = f" ELSE {self.otherwise.display()}" if self.otherwise else ""
         return f"CASE {parts}{e} END"
+
+
+def _civil_from_days(z: jnp.ndarray):
+    """days-since-epoch -> (year, month, day), vectorized (Howard Hinnant's
+    public-domain civil_from_days algorithm, integer-only so it runs on the
+    VPU)."""
+    z = z.astype(jnp.int32) + 719468
+    era = jnp.floor_divide(z, 146097)
+    doe = z - era * 146097
+    yoe = jnp.floor_divide(
+        doe - doe // 1460 + doe // 36524 - doe // 146096, 365
+    )
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + jnp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y, m, d
+
+
+@dataclass
+class Extract(PhysicalExpr):
+    """EXTRACT(year|month|day FROM date_col)."""
+
+    part: str
+    child: PhysicalExpr
+
+    def children(self):
+        return [self.child]
+
+    def evaluate(self, table: Table) -> ExprValue:
+        c = self.child.evaluate(table)
+        y, m, d = _civil_from_days(c.data)
+        out = {"year": y, "month": m, "day": d}[self.part]
+        return ExprValue(out.astype(jnp.int64), c.validity, DataType.INT64)
+
+    def output_field(self, schema: Schema) -> Field:
+        f = self.child.output_field(schema)
+        return Field(self.display(), DataType.INT64, f.nullable)
+
+    def display(self) -> str:
+        return f"EXTRACT({self.part} FROM {self.child.display()})"
+
+
+@dataclass
+class Substring(PhysicalExpr):
+    """SUBSTRING on a dictionary string column: transforms the dictionary on
+    the host at trace time and remaps codes (derived dictionary)."""
+
+    child: PhysicalExpr
+    start: int  # 1-based, SQL semantics
+    length: Optional[int]
+
+    def children(self):
+        return [self.child]
+
+    def evaluate(self, table: Table) -> ExprValue:
+        c = self.child.evaluate(table)
+        if c.dtype != DataType.STRING or c.dictionary is None:
+            raise ValueError("SUBSTRING requires a dictionary string column")
+        vals = c.dictionary.values
+        s = self.start - 1
+        if self.length is None:
+            derived = np.asarray([v[s:] for v in vals], dtype=object)
+        else:
+            derived = np.asarray(
+                [v[s : s + self.length] for v in vals], dtype=object
+            )
+        uniq, inverse = np.unique(derived.astype(str), return_inverse=True)
+        new_dict = Dictionary(uniq.astype(object))
+        lut = jnp.asarray(inverse.astype(np.int32))
+        if len(vals) == 0:
+            codes = c.data
+        else:
+            codes = lut[jnp.clip(c.data, 0, len(vals) - 1)]
+        return ExprValue(codes, c.validity, DataType.STRING, new_dict)
+
+    def output_field(self, schema: Schema) -> Field:
+        f = self.child.output_field(schema)
+        return Field(self.display(), DataType.STRING, f.nullable)
+
+    def display(self) -> str:
+        ln = f" FOR {self.length}" if self.length is not None else ""
+        return f"SUBSTRING({self.child.display()} FROM {self.start}{ln})"
 
 
 @dataclass

@@ -1,0 +1,217 @@
+"""SessionContext: the user-facing API (the DataFusion `SessionContext`
+analogue the reference extends via `DistributedExt`,
+`/root/reference/src/distributed_ext.rs`).
+
+    ctx = SessionContext()
+    ctx.register_parquet("lineitem", "lineitem.parquet")
+    df = ctx.sql("select l_returnflag, sum(l_quantity) from lineitem group by 1")
+    df.collect()        # -> pyarrow Table
+    df.to_pandas()
+    df.explain()
+
+Tables are decoded to padded device Tables at registration (host Parquet
+decode happens once; every query then runs device-side). String dictionaries
+are unified per table column at load.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Optional, Sequence
+
+from datafusion_distributed_tpu.io.parquet import (
+    arrow_to_table,
+    schema_from_arrow,
+    table_to_arrow,
+)
+from datafusion_distributed_tpu.ops.table import Table
+from datafusion_distributed_tpu.plan.physical import (
+    ExecutionPlan,
+    MemoryScanExec,
+    execute_plan,
+)
+from datafusion_distributed_tpu.schema import Schema
+from datafusion_distributed_tpu.sql import parser as ast
+from datafusion_distributed_tpu.sql.logical import Binder, LogicalPlan
+from datafusion_distributed_tpu.sql.parser import (
+    CreateView,
+    DropView,
+    parse_statements,
+)
+from datafusion_distributed_tpu.sql.planner import PhysicalPlanner, PlannerConfig
+
+
+class Catalog:
+    """Named tables (device-resident) + views."""
+
+    def __init__(self) -> None:
+        self.tables: dict[str, Table] = {}
+        self.views: dict[str, LogicalPlan] = {}
+
+    def register_table(self, name: str, table: Table) -> None:
+        self.tables[name.lower()] = table
+
+    def has_table(self, name: str) -> bool:
+        return name.lower() in self.tables
+
+    def table_schema(self, name: str) -> Schema:
+        return self.tables[name.lower()].schema()
+
+    def table_rows(self, name: str) -> int:
+        return int(self.tables[name.lower()].num_rows)
+
+    def scan_exec(self, name: str, columns: Sequence[str]) -> ExecutionPlan:
+        t = self.tables[name.lower()]
+        return MemoryScanExec([t.select(columns)], t.schema().select(columns))
+
+
+@dataclass
+class SessionConfig:
+    planner: PlannerConfig = None  # type: ignore[assignment]
+    overflow_retries: int = 3
+
+    def __post_init__(self):
+        if self.planner is None:
+            self.planner = PlannerConfig()
+
+
+class DataFrame:
+    """A planned (but unexecuted) query."""
+
+    def __init__(self, ctx: "SessionContext", logical: LogicalPlan):
+        self.ctx = ctx
+        self.logical = logical
+        self._physical: Optional[ExecutionPlan] = None
+
+    def physical_plan(self, config: Optional[PlannerConfig] = None) -> ExecutionPlan:
+        cfg = config or self.ctx.config.planner
+        planner = PhysicalPlanner(self.ctx.catalog, cfg)
+        return planner.plan(self.logical)
+
+    def collect_table(self) -> Table:
+        """Execute, with automatic re-plan on hash/join capacity overflow —
+        the static-shape analogue of the reference's pending->ready two-phase
+        planning: capacities are planned optimistically and revised on
+        overflow."""
+        cfg = self.ctx.config.planner
+        last_err: Optional[Exception] = None
+        for _attempt in range(self.ctx.config.overflow_retries + 1):
+            try:
+                # planning is inside the try: scalar subqueries execute at
+                # plan time and their overflows must trigger the same retry
+                plan = self.physical_plan(cfg)
+                return execute_plan(plan)
+            except RuntimeError as e:
+                if "overflow" not in str(e):
+                    raise
+                last_err = e
+                cfg = replace(
+                    cfg,
+                    join_expansion_factor=cfg.join_expansion_factor * 4,
+                    agg_slot_factor=cfg.agg_slot_factor * 4,
+                )
+        raise last_err  # type: ignore[misc]
+
+    def collect(self):
+        """-> pyarrow Table with user-facing column names."""
+        return table_to_arrow(self._strip_quals(self.collect_table()))
+
+    def to_pandas(self):
+        return self._strip_quals(self.collect_table()).to_pandas()
+
+    @staticmethod
+    def _strip_quals(t: Table) -> Table:
+        names = tuple(n.split(".")[-1] if "." in n else n for n in t.names)
+        return Table(names, t.columns, t.num_rows)
+
+    def explain(self) -> str:
+        return self.physical_plan().display_tree()
+
+    def logical_display(self) -> str:
+        return self.logical.display_tree()
+
+
+class SessionContext:
+    def __init__(self, config: Optional[SessionConfig] = None):
+        self.catalog = Catalog()
+        self.config = config or SessionConfig()
+
+    # -- registration ---------------------------------------------------------
+    def register_parquet(self, name: str, paths, capacity: Optional[int] = None):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        if isinstance(paths, (str,)):
+            paths = [paths]
+        tables = [pq.read_table(p) for p in paths]
+        arrow = pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+        self.catalog.register_table(name, arrow_to_table(arrow, capacity=capacity))
+
+    def register_arrow(self, name: str, arrow_table, capacity=None):
+        self.catalog.register_table(name, arrow_to_table(arrow_table, capacity))
+
+    def register_table(self, name: str, table: Table):
+        self.catalog.register_table(name, table)
+
+    # -- SQL ------------------------------------------------------------------
+    def sql(self, query: str) -> DataFrame:
+        stmts = parse_statements(query)
+        result: Optional[DataFrame] = None
+        views: dict[str, LogicalPlan] = dict(self.catalog.views)
+        for stmt in stmts:
+            if isinstance(stmt, CreateView):
+                binder = Binder(_ViewCatalog(self.catalog, views), views)
+                plan = binder.bind(stmt.query)
+                if stmt.column_aliases:
+                    from datafusion_distributed_tpu.plan import expressions as pe
+                    from datafusion_distributed_tpu.sql.logical import LProject
+
+                    fields = plan.schema().fields
+                    if len(stmt.column_aliases) != len(fields):
+                        raise ValueError("view column alias arity mismatch")
+                    plan = LProject(
+                        [(pe.Col(f.name), n)
+                         for f, n in zip(fields, stmt.column_aliases)],
+                        plan,
+                    )
+                views[stmt.name.lower()] = plan
+                self.catalog.views[stmt.name.lower()] = plan
+            elif isinstance(stmt, DropView):
+                views.pop(stmt.name.lower(), None)
+                self.catalog.views.pop(stmt.name.lower(), None)
+            else:
+                binder = Binder(_ViewCatalog(self.catalog, views), views)
+                result = DataFrame(self, binder.bind(stmt))
+        if result is None:
+            raise ValueError("no SELECT statement in input")
+        return result
+
+
+class _ViewCatalog:
+    """Catalog facade that also resolves registered views (as CTEs)."""
+
+    def __init__(self, catalog: Catalog, views: dict):
+        self.catalog = catalog
+        self.views = views
+
+    def has_table(self, name: str) -> bool:
+        return self.catalog.has_table(name) or name.lower() in self.views
+
+    def table_schema(self, name: str) -> Schema:
+        if name.lower() in self.views:
+            s = self.views[name.lower()].schema()
+            from datafusion_distributed_tpu.schema import Field
+
+            return Schema(
+                [Field(f.name.split(".")[-1], f.dtype, f.nullable)
+                 for f in s.fields]
+            )
+        return self.catalog.table_schema(name)
+
+    def table_rows(self, name: str) -> int:
+        if name.lower() in self.views:
+            return 1000
+        return self.catalog.table_rows(name)
+
+    def scan_exec(self, name: str, columns):
+        return self.catalog.scan_exec(name, columns)

@@ -1,0 +1,1613 @@
+"""Logical plan + binder (AST -> resolved logical tree).
+
+The reference gets logical planning from DataFusion (SURVEY.md L0). This is an
+original binder covering what the TPC suites need:
+
+- name resolution over qualified scopes (columns get flat names
+  ``alias.column`` so self-joins like TPC-H q21's lineitem l1/l2/l3 stay
+  unambiguous all the way into the physical Table),
+- implicit comma joins: WHERE conjuncts are classified into single-relation
+  filters (pushed down), equi-join edges (drive a greedy left-deep join
+  order), and residual post-join filters,
+- aggregate extraction (SELECT/HAVING/ORDER BY aggregate calls become
+  LAggregate outputs; COUNT(DISTINCT x) rewrites to a two-level aggregate),
+- subquery handling: uncorrelated scalar subqueries become lazily-executed
+  scalar expressions; correlated scalar-aggregate subqueries decorrelate into
+  GROUP BY + LEFT JOIN (TPC-H q2/q17/q20 shape); [NOT] EXISTS and [NOT] IN
+  become semi/anti joins with optional residual predicates (q4/q21/q22).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Callable, Optional, Sequence
+
+from datafusion_distributed_tpu.plan import expressions as pe
+from datafusion_distributed_tpu.schema import DataType, Field, Schema
+from datafusion_distributed_tpu.sql import parser as ast
+
+
+# ---------------------------------------------------------------------------
+# Logical nodes
+# ---------------------------------------------------------------------------
+
+
+class LogicalPlan:
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    def children(self) -> list["LogicalPlan"]:
+        raise NotImplementedError
+
+    def display_tree(self, indent=0) -> str:
+        lines = ["  " * indent + self.display()]
+        for c in self.children():
+            lines.append(c.display_tree(indent + 1))
+        return "\n".join(lines)
+
+    def display(self) -> str:
+        return type(self).__name__
+
+
+@dataclass
+class LScan(LogicalPlan):
+    table: str
+    alias: str
+    table_schema: Schema  # original column names
+    flat_schema: Schema  # alias.column names
+
+    def schema(self):
+        return self.flat_schema
+
+    def children(self):
+        return []
+
+    def display(self):
+        return f"Scan {self.table} AS {self.alias}"
+
+
+@dataclass
+class LFilter(LogicalPlan):
+    predicate: pe.PhysicalExpr
+    child: LogicalPlan
+
+    def schema(self):
+        return self.child.schema()
+
+    def children(self):
+        return [self.child]
+
+    def display(self):
+        return f"Filter {self.predicate.display()}"
+
+
+@dataclass
+class LProject(LogicalPlan):
+    exprs: list  # [(PhysicalExpr, out_name)]
+    child: LogicalPlan
+
+    def schema(self):
+        cs = self.child.schema()
+        return Schema(
+            [Field(n, e.output_field(cs).dtype, e.output_field(cs).nullable)
+             for e, n in self.exprs]
+        )
+
+    def children(self):
+        return [self.child]
+
+    def display(self):
+        return "Project " + ", ".join(n for _, n in self.exprs)
+
+
+@dataclass
+class AggCall:
+    func: str  # sum|count|count_star|min|max|avg
+    arg: Optional[pe.PhysicalExpr]
+    name: str
+    distinct: bool = False
+
+
+@dataclass
+class LAggregate(LogicalPlan):
+    groups: list  # [(PhysicalExpr, name)]
+    aggs: list  # [AggCall]
+    child: LogicalPlan
+
+    def schema(self):
+        cs = self.child.schema()
+        fields = []
+        for e, n in self.groups:
+            f = e.output_field(cs)
+            fields.append(Field(n, f.dtype, f.nullable))
+        for a in self.aggs:
+            fields.append(Field(a.name, _agg_dtype(a, cs), True))
+        return Schema(fields)
+
+    def children(self):
+        return [self.child]
+
+    def display(self):
+        gs = ", ".join(n for _, n in self.groups)
+        as_ = ", ".join(f"{a.func}({a.arg.display() if a.arg else '*'})"
+                        for a in self.aggs)
+        return f"Aggregate gby=[{gs}] aggs=[{as_}]"
+
+
+def _agg_dtype(a: AggCall, cs: Schema) -> DataType:
+    if a.func in ("count", "count_star"):
+        return DataType.INT64
+    if a.func == "avg":
+        return DataType.FLOAT64
+    f = a.arg.output_field(cs)
+    if a.func == "sum":
+        return DataType.FLOAT64 if f.dtype.is_float else DataType.INT64
+    return f.dtype
+
+
+@dataclass
+class LJoin(LogicalPlan):
+    left: LogicalPlan
+    right: LogicalPlan
+    how: str  # inner|left|semi|anti|mark|cross
+    left_keys: list  # [PhysicalExpr]
+    right_keys: list
+    residual: Optional[pe.PhysicalExpr] = None  # evaluated on joined schema
+    mark_name: Optional[str] = None
+
+    def schema(self):
+        if self.how in ("semi", "anti"):
+            return self.left.schema()
+        if self.how == "mark":
+            return Schema(
+                list(self.left.schema().fields)
+                + [Field(self.mark_name or "__mark", DataType.BOOL, False)]
+            )
+        left = self.left.schema().fields
+        right = [
+            Field(f.name, f.dtype, True if self.how == "left" else f.nullable)
+            for f in self.right.schema().fields
+        ]
+        return Schema(list(left) + right)
+
+    def children(self):
+        return [self.left, self.right]
+
+    def display(self):
+        ks = ", ".join(
+            f"{l.display()}={r.display()}"
+            for l, r in zip(self.left_keys, self.right_keys)
+        )
+        res = f" residual={self.residual.display()}" if self.residual else ""
+        return f"Join {self.how} on [{ks}]{res}"
+
+
+@dataclass
+class LSort(LogicalPlan):
+    keys: list  # [(PhysicalExpr, ascending, nulls_first|None)]
+    child: LogicalPlan
+    fetch: Optional[int] = None
+
+    def schema(self):
+        return self.child.schema()
+
+    def children(self):
+        return [self.child]
+
+    def display(self):
+        ks = ", ".join(
+            f"{e.display()} {'ASC' if asc else 'DESC'}" for e, asc, _ in self.keys
+        )
+        return f"Sort [{ks}]" + (f" fetch={self.fetch}" if self.fetch else "")
+
+
+@dataclass
+class LLimit(LogicalPlan):
+    child: LogicalPlan
+    fetch: Optional[int]
+    skip: int = 0
+
+    def schema(self):
+        return self.child.schema()
+
+    def children(self):
+        return [self.child]
+
+    def display(self):
+        return f"Limit fetch={self.fetch} skip={self.skip}"
+
+
+@dataclass
+class LDistinct(LogicalPlan):
+    child: LogicalPlan
+
+    def schema(self):
+        return self.child.schema()
+
+    def children(self):
+        return [self.child]
+
+
+@dataclass
+class LSetOp(LogicalPlan):
+    op: str  # union|intersect|except
+    all: bool
+    left: LogicalPlan
+    right: LogicalPlan
+
+    def schema(self):
+        return self.left.schema()
+
+    def children(self):
+        return [self.left, self.right]
+
+    def display(self):
+        return f"{self.op.upper()}{' ALL' if self.all else ''}"
+
+
+# ---------------------------------------------------------------------------
+# Catalog protocol
+# ---------------------------------------------------------------------------
+
+
+class CatalogProtocol:
+    """What the binder needs: schema lookup + view/CTE resolution."""
+
+    def table_schema(self, name: str) -> Schema:
+        raise NotImplementedError
+
+    def has_table(self, name: str) -> bool:
+        raise NotImplementedError
+
+    def table_rows(self, name: str) -> int:
+        """Row-count estimate for join ordering; override when known."""
+        return 1000
+
+
+# ---------------------------------------------------------------------------
+# Binder
+# ---------------------------------------------------------------------------
+
+_ANON = itertools.count()
+
+
+class BindError(ValueError):
+    pass
+
+
+@dataclass
+class Scope:
+    """In-scope relations: [(alias, original Schema)] resolving to flat names."""
+
+    entries: list  # [(alias, Schema)]
+    parent: Optional["Scope"] = None
+
+    def resolve(self, ident: ast.Ident) -> tuple[str, Field, int]:
+        """-> (flat_name, field, depth); depth 0 = local, 1+ = outer scope."""
+        depth = 0
+        scope: Optional[Scope] = self
+        while scope is not None:
+            hits = []
+            for alias, schema in scope.entries:
+                if ident.qualifier is not None and ident.qualifier != alias:
+                    continue
+                if ident.name in schema:
+                    hits.append((alias, schema.field(ident.name)))
+            if len(hits) > 1:
+                raise BindError(f"ambiguous column {ident.key()!r}")
+            if hits:
+                alias, f = hits[0]
+                flat = f"{alias}.{ident.name}" if alias else ident.name
+                return flat, f, depth
+            scope = scope.parent
+            depth += 1
+        raise BindError(f"unknown column {ident.key()!r}")
+
+
+@dataclass
+class OuterRef:
+    """Recorded reference from a subquery into an enclosing scope."""
+
+    flat_name: str
+    field: Field
+
+
+class Binder:
+    def __init__(self, catalog: CatalogProtocol, ctes: Optional[dict] = None):
+        self.catalog = catalog
+        self.ctes: dict[str, LogicalPlan] = dict(ctes or {})
+
+    # -- public -------------------------------------------------------------
+    def bind(self, q) -> LogicalPlan:
+        return self._bind_query(q, parent_scope=None)
+
+    # -- query --------------------------------------------------------------
+    def _bind_query(self, q, parent_scope: Optional[Scope]) -> LogicalPlan:
+        if isinstance(q, ast.SetOp):
+            return self._bind_setop(q, parent_scope)
+        saved_ctes = dict(self.ctes)
+        for name, sub in q.ctes:
+            self.ctes[name] = self._bind_query(sub, parent_scope)
+        try:
+            return self._bind_select(q, parent_scope)
+        finally:
+            self.ctes = saved_ctes
+
+    def _bind_setop(self, q: ast.SetOp, parent_scope) -> LogicalPlan:
+        saved = dict(self.ctes)
+        for name, sub in q.ctes:
+            self.ctes[name] = self._bind_query(sub, parent_scope)
+        try:
+            left = self._bind_query(q.left, parent_scope)
+            right = self._bind_query(q.right, parent_scope)
+        finally:
+            self.ctes = saved
+        if len(left.schema()) != len(right.schema()):
+            raise BindError("set operation arity mismatch")
+        # align right's column names to left's
+        rs = right.schema()
+        right = LProject(
+            [(pe.Col(rf.name), lf.name)
+             for rf, lf in zip(rs.fields, left.schema().fields)],
+            right,
+        )
+        plan: LogicalPlan = LSetOp(q.op, q.all, left, right)
+        if q.op == "union" and not q.all:
+            plan = LDistinct(plan)
+        if q.order_by:
+            scope = Scope([("", plan.schema())])
+            keys = []
+            for o in q.order_by:
+                if isinstance(o.expr, ast.NumberLit) and isinstance(
+                    o.expr.value, int
+                ):
+                    e: pe.PhysicalExpr = pe.Col(
+                        plan.schema().fields[o.expr.value - 1].name
+                    )
+                else:
+                    e = self._bind_expr(o.expr, scope, None)
+                keys.append((e, o.ascending, o.nulls_first))
+            plan = LSort(keys, plan, fetch=q.limit)
+        if q.limit is not None or q.offset is not None:
+            plan = LLimit(plan, q.limit, q.offset or 0)
+        return plan
+
+    # -- FROM / joins ---------------------------------------------------------
+    def _bind_relation(self, ref, parent_scope) -> tuple[LogicalPlan, str, Schema]:
+        """-> (plan with flat names, alias, original-name schema)."""
+        if isinstance(ref, ast.SubqueryRef):
+            sub = self._bind_query(ref.query, parent_scope)
+            names = [f.name.split(".")[-1] for f in sub.schema().fields]
+            if ref.column_aliases:
+                if len(ref.column_aliases) != len(names):
+                    raise BindError("derived table column alias arity mismatch")
+                names = list(ref.column_aliases)
+            orig = Schema(
+                [Field(n, f.dtype, f.nullable)
+                 for n, f in zip(names, sub.schema().fields)]
+            )
+            flat = LProject(
+                [(pe.Col(f.name), f"{ref.alias}.{n}")
+                 for n, f in zip(names, sub.schema().fields)],
+                sub,
+            )
+            return flat, ref.alias, orig
+        assert isinstance(ref, ast.TableRef)
+        alias = ref.alias or ref.name
+        if ref.name in self.ctes:
+            sub = self.ctes[ref.name]
+            names = [f.name.split(".")[-1] for f in sub.schema().fields]
+            orig = Schema(
+                [Field(n, f.dtype, f.nullable)
+                 for n, f in zip(names, sub.schema().fields)]
+            )
+            flat = LProject(
+                [(pe.Col(f.name), f"{alias}.{n}")
+                 for n, f in zip(names, sub.schema().fields)],
+                sub,
+            )
+            return flat, alias, orig
+        if not self.catalog.has_table(ref.name):
+            raise BindError(f"unknown table {ref.name!r}")
+        schema = self.catalog.table_schema(ref.name)
+        flat_schema = Schema(
+            [Field(f"{alias}.{f.name}", f.dtype, f.nullable) for f in schema.fields]
+        )
+        return LScan(ref.name, alias, schema, flat_schema), alias, schema
+
+    # -- SELECT ---------------------------------------------------------------
+    def _bind_select(self, q: ast.Query, parent_scope) -> LogicalPlan:
+        # 1. relations. A from_ref group with outer joins is folded in its
+        # written order into a single "unit" (outer joins are not freely
+        # reorderable); inner/cross-only groups flatten into the greedy pool.
+        relations: list[tuple[LogicalPlan, str, Schema]] = []  # (plan, alias, orig)
+        groups: list = []  # ("rel", alias) | ("outer", base_alias, [(jc, ralias)])
+        inner_on_conjuncts: list = []
+        if not q.from_refs:
+            raise BindError("SELECT without FROM is not supported yet")
+        protected: set = set()  # null-supplying sides: no WHERE pushdown
+        for base, joins in q.from_refs:
+            triple = self._bind_relation(base, parent_scope)
+            relations.append(triple)
+            if not joins:
+                groups.append(("rel", triple[1]))
+                continue
+            kinds = {jc.kind for jc in joins}
+            rtriples = []
+            for jc in joins:
+                rt = self._bind_relation(jc.right, parent_scope)
+                relations.append(rt)
+                rtriples.append(rt)
+            if kinds <= {"inner", "cross"}:
+                groups.append(("rel", triple[1]))
+                for jc, rt in zip(joins, rtriples):
+                    groups.append(("rel", rt[1]))
+                    if jc.on is not None:
+                        inner_on_conjuncts.extend(_split_conjuncts(jc.on))
+            else:
+                groups.append(
+                    ("outer", triple[1], list(zip(joins, [t[1] for t in rtriples])))
+                )
+                for jc, rt in zip(joins, rtriples):
+                    if jc.kind == "left":
+                        protected.add(rt[1])
+                    elif jc.kind == "right":
+                        protected.add(triple[1])
+                    elif jc.kind == "full":
+                        protected.add(rt[1])
+                        protected.add(triple[1])
+
+        scope = Scope([(alias, orig) for _, alias, orig in relations],
+                      parent=parent_scope)
+        outer_refs: list[OuterRef] = []
+
+        # 2. classify WHERE conjuncts (+ inner-join ON conjuncts)
+        conjuncts = _split_conjuncts(q.where) if q.where is not None else []
+        conjuncts = conjuncts + inner_on_conjuncts
+
+        per_rel: dict[str, list] = {alias: [] for _, alias, _ in relations}
+        equi_edges: list = []  # (alias_a, expr_a, alias_b, expr_b)
+        residuals: list = []  # bound later against joined scope
+        subquery_preds: list = []  # AST conjuncts containing subqueries
+
+        # q19 shape: a top-level OR where every branch repeats the same
+        # equi-join conjunct — hoist the common conjuncts so the pair of
+        # relations joins hash-wise instead of as a cross product.
+        hoisted: list = []
+        for c in conjuncts:
+            if isinstance(c, ast.Binary) and c.op == "or":
+                common = _common_or_conjuncts(c)
+                hoisted.extend(common)
+        conjuncts = conjuncts + hoisted
+
+        for c in conjuncts:
+            if _contains_subquery(c):
+                subquery_preds.append(c)
+                continue
+            aliases = self._aliases_of(c, scope)
+            if len(aliases) == 1 and not (aliases & protected):
+                per_rel[next(iter(aliases))].append(c)
+            elif (
+                len(aliases) == 2
+                and isinstance(c, ast.Binary)
+                and c.op == "=="
+                and not (aliases & protected)
+            ):
+                la = self._aliases_of(c.left, scope)
+                ra = self._aliases_of(c.right, scope)
+                if len(la) == 1 and len(ra) == 1 and la != ra:
+                    equi_edges.append((next(iter(la)), c.left,
+                                       next(iter(ra)), c.right))
+                else:
+                    residuals.append(c)
+            else:
+                residuals.append(c)
+
+        # 3. apply per-relation filters
+        rel_plans: dict[str, LogicalPlan] = {}
+        rel_rows: dict[str, int] = {}
+        for plan, alias, orig in relations:
+            rel_rows[alias] = self._relation_rows(alias, plan)
+            for c in per_rel[alias]:
+                pred = self._bind_expr(c, scope, outer_refs)
+                plan = LFilter(pred, plan)
+                rel_rows[alias] = max(rel_rows[alias] // 3, 1)
+            rel_plans[alias] = plan
+
+        # 3b. fold outer-join groups into unit plans (written order)
+        units: list = []  # [plan, alias_set, rows]
+        for g in groups:
+            if g[0] == "rel":
+                alias = g[1]
+                units.append([rel_plans[alias], {alias}, rel_rows[alias]])
+            else:
+                _, base_alias, jpairs = g
+                uplan = rel_plans[base_alias]
+                ualiases = {base_alias}
+                urows = rel_rows[base_alias]
+                for jc, ralias in jpairs:
+                    uplan = self._fold_explicit_join(
+                        uplan, ualiases, jc, ralias, rel_plans[ralias],
+                        scope, outer_refs,
+                    )
+                    ualiases.add(ralias)
+                    urows = max(urows, rel_rows[ralias])
+                units.append([uplan, ualiases, urows])
+
+        # 4. greedy left-deep join order over units connected by equi edges
+        plan = self._order_joins(units, equi_edges, scope, outer_refs)
+
+        # 5. residual predicates after joins
+        for c in residuals:
+            plan = LFilter(self._bind_expr(c, scope, outer_refs), plan)
+
+        # 6. subquery predicates (EXISTS/IN/scalar comparisons)
+        for c in subquery_preds:
+            plan = self._apply_subquery_pred(c, plan, scope, outer_refs)
+
+        # 7. aggregates
+        plan = self._bind_projection_and_aggregates(q, plan, scope, outer_refs)
+
+        if outer_refs and parent_scope is None:
+            raise BindError(
+                f"unresolved outer references: {[r.flat_name for r in outer_refs]}"
+            )
+        return plan
+
+    # -- join ordering --------------------------------------------------------
+    def _fold_explicit_join(self, uplan, ualiases, jc, ralias, rplan, scope,
+                            outer_refs):
+        """Fold one explicit [OUTER] JOIN clause in written order (outer joins
+        must not be reordered; the preserved side is the accumulated left)."""
+        if jc.kind == "cross":
+            return LJoin(uplan, rplan, "cross", [], [])
+        on_conjuncts = _split_conjuncts(jc.on) if jc.on is not None else []
+        lkeys, rkeys = [], []
+        post: list = []
+        for c in on_conjuncts:
+            aliases = self._aliases_of(c, scope)
+            if (
+                isinstance(c, ast.Binary) and c.op == "=="
+                and len(aliases) == 2
+            ):
+                la = self._aliases_of(c.left, scope)
+                ra = self._aliases_of(c.right, scope)
+                if la <= ualiases and ra == {ralias}:
+                    lkeys.append(self._bind_expr(c.left, scope, outer_refs))
+                    rkeys.append(self._bind_expr(c.right, scope, outer_refs))
+                    continue
+                if ra <= ualiases and la == {ralias}:
+                    lkeys.append(self._bind_expr(c.right, scope, outer_refs))
+                    rkeys.append(self._bind_expr(c.left, scope, outer_refs))
+                    continue
+            if aliases == {ralias} and jc.kind in ("left", "inner"):
+                # null-supplying-side-only conjunct: pre-filtering that side
+                # is equivalent for LEFT (and INNER) joins
+                rplan = LFilter(self._bind_expr(c, scope, outer_refs), rplan)
+                continue
+            post.append(c)
+        if post:
+            if jc.kind != "inner":
+                raise BindError(
+                    f"unsupported non-equi ON conjunct for {jc.kind.upper()} "
+                    f"JOIN: {post[0]!r}"
+                )
+        if not lkeys:
+            raise BindError(
+                f"{jc.kind.upper()} JOIN without an equi ON condition"
+            )
+        kind = jc.kind
+        if kind == "right":
+            # preserved side must be the probe: swap
+            out = LJoin(rplan, uplan, "left", rkeys, lkeys)
+        elif kind == "full":
+            raise BindError("FULL OUTER JOIN is not supported yet")
+        else:
+            out = LJoin(uplan, rplan, kind, lkeys, rkeys)
+        for c in post:
+            out = LFilter(self._bind_expr(c, scope, outer_refs), out)
+        return out
+
+    def _order_joins(self, units, equi_edges, scope, outer_refs):
+        """Greedily join units (relations or pre-folded outer-join groups):
+        probe side = the largest unit (the fact table keeps output
+        cardinality bounded by the probe side, which is what the static
+        output-capacity model wants); attach the smallest connected unit
+        first (dims as build sides, left-deep)."""
+        units = [list(u) for u in units]
+        if len(units) == 1:
+            return units[0][0]
+        start = max(range(len(units)), key=lambda i: units[i][2])
+        plan, joined, _rows = units[start]
+        remaining = [u for i, u in enumerate(units) if i != start]
+        edges = list(equi_edges)
+        while remaining:
+            candidates = []
+            for ui, u in enumerate(remaining):
+                _, ualiases, urows = u
+                for e in edges:
+                    la, _, ra, _ = e
+                    if (la in joined and ra in ualiases) or (
+                        ra in joined and la in ualiases
+                    ):
+                        candidates.append((urows, ui))
+                        break
+            if not candidates:
+                u = remaining.pop(0)
+                plan = LJoin(plan, u[0], "cross", [], [])
+                joined |= u[1]
+                continue
+            candidates.sort()
+            _, ui = candidates[0]
+            u = remaining.pop(ui)
+            _, ualiases, _ = u
+            lkeys, rkeys, rest = [], [], []
+            for e in edges:
+                la, le, ra, re_ = e
+                if la in joined and ra in ualiases:
+                    lkeys.append(self._bind_expr(le, scope, outer_refs))
+                    rkeys.append(self._bind_expr(re_, scope, outer_refs))
+                elif ra in joined and la in ualiases:
+                    lkeys.append(self._bind_expr(re_, scope, outer_refs))
+                    rkeys.append(self._bind_expr(le, scope, outer_refs))
+                else:
+                    rest.append(e)
+            edges = rest
+            plan = LJoin(plan, u[0], "inner", lkeys, rkeys)
+            joined |= ualiases
+        # edges whose endpoints ended up in the same unit: residual filters
+        for la, le, ra, re_ in edges:
+            pred = pe.BinaryOp(
+                "==",
+                self._bind_expr(le, scope, outer_refs),
+                self._bind_expr(re_, scope, outer_refs),
+            )
+            plan = LFilter(pred, plan)
+        return plan
+
+    def _relation_rows(self, alias: str, plan: LogicalPlan) -> int:
+        """Estimate rows under a relation's plan (scan size, filter discount)."""
+        if isinstance(plan, LFilter):
+            return max(self._relation_rows(alias, plan.child) // 3, 1)
+        if isinstance(plan, LScan):
+            try:
+                return self.catalog.table_rows(plan.table)
+            except Exception:
+                return 1000
+        if plan.children():
+            return max(self._relation_rows(alias, c) for c in plan.children())
+        return 1000
+
+    # -- subquery predicates ----------------------------------------------------
+    def _apply_subquery_pred(self, c, plan, scope, outer_refs) -> LogicalPlan:
+        if isinstance(c, ast.Exists):
+            return self._bind_exists(c.query, c.negated, plan, scope)
+        if isinstance(c, ast.Unary) and c.op == "not" and isinstance(
+            c.child, ast.Exists
+        ):
+            return self._bind_exists(c.child.query, not c.child.negated, plan, scope)
+        if isinstance(c, ast.InSubquery):
+            return self._bind_in_subquery(c, plan, scope, outer_refs)
+        # scalar subquery inside a comparison
+        return self._bind_scalar_pred(c, plan, scope, outer_refs)
+
+    def _bind_exists(self, subq: ast.Query, negated: bool, plan, scope):
+        sub_binder = Binder(self.catalog, self.ctes)
+        sub_refs: list[OuterRef] = []
+        sub_plan, corr_pairs, residual = sub_binder._bind_correlated(
+            subq, scope, sub_refs
+        )
+        if not corr_pairs:
+            raise BindError("uncorrelated EXISTS not supported yet")
+        lkeys = [pe.Col(outer) for outer, _ in corr_pairs]
+        rkeys = [inner for _, inner in corr_pairs]
+        how = "anti" if negated else "semi"
+        return LJoin(plan, sub_plan, how, lkeys, rkeys, residual=residual)
+
+    def _bind_in_subquery(self, c: ast.InSubquery, plan, scope, outer_refs):
+        expr = self._bind_expr(c.expr, scope, outer_refs)
+        sub_binder = Binder(self.catalog, self.ctes)
+        sub_refs: list[OuterRef] = []
+        sub_plan, corr_pairs, residual = sub_binder._bind_correlated(
+            c.query, scope, sub_refs
+        )
+        out_cols = sub_plan.schema()
+        if len(out_cols) - len(corr_pairs) != 1 and len(out_cols) != 1:
+            raise BindError("IN subquery must produce one column")
+        value_col = pe.Col(out_cols.fields[0].name)
+        lkeys = [expr] + [pe.Col(outer) for outer, _ in corr_pairs]
+        rkeys = [value_col] + [inner for _, inner in corr_pairs]
+        how = "anti" if c.negated else "semi"
+        return LJoin(plan, sub_plan, how, lkeys, rkeys, residual=residual)
+
+    def _bind_scalar_pred(self, c, plan, scope, outer_refs):
+        """Comparison against a scalar subquery (correlated or not)."""
+        if not (isinstance(c, ast.Binary) and c.op in ("==", "!=", "<", "<=",
+                                                       ">", ">=")):
+            raise BindError(
+                f"unsupported subquery predicate shape: {type(c).__name__}"
+            )
+        if isinstance(c.left, ast.ScalarSubquery):
+            sub_ast, other, flip = c.left, c.right, True
+        elif isinstance(c.right, ast.ScalarSubquery):
+            sub_ast, other, flip = c.right, c.left, False
+        else:
+            raise BindError("expected scalar subquery in comparison")
+
+        sub_binder = Binder(self.catalog, self.ctes)
+        sub_refs: list[OuterRef] = []
+        sub_plan, corr_pairs, residual = sub_binder._bind_correlated(
+            sub_ast.query, scope, sub_refs
+        )
+        if residual is not None:
+            raise BindError("non-equi correlation in scalar subquery")
+        other_bound = self._bind_expr(other, scope, outer_refs)
+        op = pe._flip_cmp(c.op) if flip else c.op
+
+        if not corr_pairs:
+            # uncorrelated: evaluate eagerly at execution time
+            sub_expr = ScalarSubqueryExpr(sub_plan)
+            return LFilter(pe.BinaryOp(op, other_bound, sub_expr), plan)
+
+        # correlated scalar aggregate: sub_plan is Aggregate(groups=corr keys)
+        scalar_col = pe.Col(sub_plan.schema().fields[-1].name)
+        lkeys = [pe.Col(outer) for outer, _ in corr_pairs]
+        rkeys = [inner for _, inner in corr_pairs]
+        joined = LJoin(plan, sub_plan, "left", lkeys, rkeys)
+        filtered = LFilter(pe.BinaryOp(op, other_bound, scalar_col), joined)
+        # project away subquery columns
+        keep = [
+            (pe.Col(f.name), f.name) for f in plan.schema().fields
+        ]
+        return LProject(keep, filtered)
+
+    def _bind_correlated(self, subq: ast.Query, outer_scope, sub_refs):
+        """Bind a subquery that may reference the outer scope.
+
+        Returns (plan, corr_pairs, residual) where corr_pairs are
+        (outer_flat_name, inner key PhysicalExpr) equi correlations hoisted
+        out of the subquery's WHERE, and residual is a bound predicate over
+        the [outer columns joined with subquery output] schema for non-equi
+        correlated conjuncts (EXISTS with <> as in TPC-H q21).
+        """
+        q = subq
+        conjuncts = _split_conjuncts(q.where) if q.where is not None else []
+        corr: list[tuple[str, ast.Ident]] = []  # (outer flat, inner ast)
+        residual_asts: list = []
+        local: list = []
+        probe_scope = self._subquery_scope(q, outer_scope)
+        for c in conjuncts:
+            side = self._correlation_side(c, probe_scope)
+            if side == "local":
+                local.append(c)
+            elif side == "equi":
+                outer_ast, inner_ast = self._split_correlation(c, probe_scope)
+                corr.append((outer_ast, inner_ast))
+            else:  # residual correlated
+                residual_asts.append(c)
+
+        q2 = ast.Query(
+            select_items=q.select_items,
+            from_refs=q.from_refs,
+            where=_join_conjuncts(local),
+            group_by=q.group_by,
+            having=q.having,
+            order_by=q.order_by,
+            limit=q.limit,
+            offset=q.offset,
+            distinct=q.distinct,
+            ctes=q.ctes,
+        )
+
+        if corr and _has_aggregates(q2):
+            # correlated scalar aggregate -> group by correlation keys
+            inner_group_asts = [inner for _, inner in corr]
+            q2 = ast.Query(
+                select_items=list(q2.select_items)
+                + [ast.SelectItem(a, f"__corr{i}") for i, a in
+                   enumerate(inner_group_asts)],
+                from_refs=q2.from_refs,
+                where=q2.where,
+                group_by=list(q2.group_by) + inner_group_asts,
+                having=q2.having,
+                order_by=[],
+                limit=None,
+                offset=None,
+                distinct=False,
+                ctes=q2.ctes,
+            )
+            plan = self._bind_query(q2, None)
+            fields = plan.schema().fields
+            ncorr = len(corr)
+            pairs = []
+            for (outer_flat, _), f in zip(corr, fields[-ncorr:]):
+                pairs.append((outer_flat, pe.Col(f.name)))
+            # keep scalar as last col before corr keys: re-project so schema =
+            # [corr keys..., scalar]
+            scalar_field = fields[-ncorr - 1]
+            proj = [(pe.Col(f.name), f.name) for f in fields[-ncorr:]]
+            proj.append((pe.Col(scalar_field.name), scalar_field.name))
+            plan = LProject(proj, plan)
+            return plan, pairs, None
+
+        plan = self._bind_query(q2, None)
+        pairs = []
+        for outer_flat, inner_ast in corr:
+            inner_scope = self._subquery_scope(q2, None)
+            inner_bound = Binder(self.catalog, self.ctes)._bind_expr(
+                inner_ast, inner_scope, None
+            )
+            # the subquery's output schema must expose the key column; ensure
+            # it by projecting the join keys alongside existing outputs
+            pairs.append((outer_flat, inner_bound))
+        residual = None
+        if residual_asts:
+            # bind residual against outer+inner combined scope
+            combined = self._combined_scope(q2, outer_scope)
+            bound = [self._bind_expr(a, combined, None) for a in residual_asts]
+            residual = bound[0]
+            for b in bound[1:]:
+                residual = pe.BooleanOp("and", residual, b)
+        if pairs or residual is not None:
+            # Expose referenced inner columns through the subquery's output
+            # projection. Outer-side names in the residual stay out — they
+            # resolve against the probe side of the join at execution.
+            inner_aliases = {
+                alias for alias, _ in self._subquery_scope(q2, None).entries
+            }
+            needed = _collect_col_names(
+                [p for _, p in pairs] + ([residual] if residual is not None else [])
+            )
+            existing = set(f.name for f in plan.schema().fields)
+            missing = [
+                n for n in needed
+                if n not in existing and n.split(".")[0] in inner_aliases
+            ]
+            if missing:
+                exprs = [(pe.Col(f.name), f.name) for f in plan.schema().fields]
+                exprs += [(pe.Col(n), n) for n in missing]
+                plan = _project_through(plan, exprs)
+        return plan, pairs, residual
+
+    def _subquery_scope(self, q: ast.Query, outer_scope) -> Scope:
+        entries = []
+        for base, joins in q.from_refs:
+            for ref in [base] + [j.right for j in joins]:
+                if isinstance(ref, ast.TableRef):
+                    alias = ref.alias or ref.name
+                    if ref.name in self.ctes:
+                        sub = self.ctes[ref.name]
+                        names = [f.name.split(".")[-1] for f in sub.schema().fields]
+                        entries.append(
+                            (alias, Schema([Field(n, f.dtype, f.nullable)
+                                            for n, f in zip(names, sub.schema().fields)]))
+                        )
+                    else:
+                        entries.append((alias, self.catalog.table_schema(ref.name)))
+                else:
+                    sub_binder = Binder(self.catalog, self.ctes)
+                    sub = sub_binder._bind_query(ref.query, None)
+                    names = ref.column_aliases or [
+                        f.name.split(".")[-1] for f in sub.schema().fields
+                    ]
+                    entries.append(
+                        (ref.alias, Schema([Field(n, f.dtype, f.nullable)
+                                            for n, f in zip(names, sub.schema().fields)]))
+                    )
+        return Scope(entries, parent=outer_scope)
+
+    def _combined_scope(self, q: ast.Query, outer_scope) -> Scope:
+        inner = self._subquery_scope(q, None)
+        entries = list(inner.entries) + (
+            list(outer_scope.entries) if outer_scope else []
+        )
+        return Scope(entries)
+
+    def _correlation_side(self, c, probe_scope: Scope) -> str:
+        """'local' (no outer refs) | 'equi' (outer = inner) | 'residual'."""
+        refs = self._outer_ref_names(c, probe_scope)
+        if not refs:
+            return "local"
+        if isinstance(c, ast.Binary) and c.op == "==":
+            lrefs = self._outer_ref_names(c.left, probe_scope)
+            rrefs = self._outer_ref_names(c.right, probe_scope)
+            if (
+                isinstance(c.left, ast.Ident)
+                and lrefs
+                and not rrefs
+                or isinstance(c.right, ast.Ident)
+                and rrefs
+                and not lrefs
+            ):
+                return "equi"
+        return "residual"
+
+    def _split_correlation(self, c: ast.Binary, probe_scope: Scope):
+        lrefs = self._outer_ref_names(c.left, probe_scope)
+        if lrefs and isinstance(c.left, ast.Ident):
+            outer_ast, inner_ast = c.left, c.right
+        else:
+            outer_ast, inner_ast = c.right, c.left
+        flat, _, _ = probe_scope.parent.resolve(outer_ast) if probe_scope.parent else (
+            None, None, None
+        )
+        if flat is None:
+            raise BindError("failed to resolve correlation")
+        return flat, inner_ast
+
+    def _outer_ref_names(self, node, probe_scope: Scope) -> list[str]:
+        out = []
+
+        def walk(n):
+            if isinstance(n, ast.Ident):
+                try:
+                    _, _, depth = probe_scope.resolve(n)
+                    if depth > 0:
+                        out.append(n.key())
+                except BindError:
+                    pass
+                return
+            for ch in _ast_children(n):
+                walk(ch)
+
+        walk(node)
+        return out
+
+    def _aliases_of(self, node, scope: Scope) -> set:
+        out: set = set()
+
+        def walk(n):
+            if isinstance(n, ast.Ident):
+                try:
+                    flat, _, depth = scope.resolve(n)
+                    if depth == 0:
+                        out.add(flat.split(".")[0])
+                except BindError:
+                    pass
+                return
+            for ch in _ast_children(n):
+                walk(ch)
+
+        walk(node)
+        return out
+
+    # -- projection & aggregation ------------------------------------------
+    def _bind_projection_and_aggregates(self, q: ast.Query, plan, scope,
+                                        outer_refs) -> LogicalPlan:
+        agg_calls = []
+        for item in q.select_items:
+            _collect_agg_calls(item.expr, agg_calls)
+        if q.having is not None:
+            _collect_agg_calls(q.having, agg_calls)
+        for o in q.order_by:
+            _collect_agg_calls(o.expr, agg_calls)
+
+        has_group = bool(q.group_by)
+        has_aggs = bool(agg_calls)
+
+        select_aliases = {
+            item.alias: item.expr for item in q.select_items if item.alias
+        }
+
+        if has_group or has_aggs:
+            # group expressions: resolve alias/positional references
+            group_asts = []
+            for g in q.group_by:
+                g = self._resolve_output_ref(g, q.select_items, select_aliases)
+                group_asts.append(g)
+            groups = []
+            for i, g in enumerate(group_asts):
+                e = self._bind_expr(g, scope, outer_refs)
+                groups.append((e, f"__g{i}"))
+            # aggregate calls
+            aggs = []
+            agg_map: dict[int, str] = {}
+            distinct_rewrites = []
+            for j, call in enumerate(agg_calls):
+                func, arg_ast, distinct = _agg_parts(call)
+                name = f"__a{j}"
+                if func == "count" and isinstance(arg_ast, ast.Star):
+                    aggs.append(AggCall("count_star", None, name))
+                else:
+                    arg = self._bind_expr(arg_ast, scope, outer_refs)
+                    if distinct and func == "count":
+                        distinct_rewrites.append((j, arg, name))
+                        aggs.append(AggCall("count", arg, name, distinct=True))
+                    else:
+                        aggs.append(AggCall(func, arg, name))
+                agg_map[id(call)] = name
+            agg_plan = LAggregate(groups, aggs, plan)
+
+            # post-aggregation scope: group exprs + agg outputs
+            group_lookup = {
+                _ast_fingerprint(g): f"__g{i}" for i, g in enumerate(group_asts)
+            }
+
+            def rebind(e):
+                return self._bind_post_agg(
+                    e, scope, group_lookup, agg_map, select_aliases
+                )
+
+            out_exprs = []
+            out_names = []
+            for idx, item in enumerate(q.select_items):
+                if isinstance(item.expr, ast.Star):
+                    raise BindError("SELECT * with GROUP BY is not supported")
+                name = item.alias or _display_name(item.expr, idx)
+                out_exprs.append(rebind(item.expr))
+                out_names.append(name)
+            result: LogicalPlan = agg_plan
+            if q.having is not None:
+                result = LFilter(rebind(q.having), result)
+            # structural fingerprints of select items -> output names
+            out_fps = {
+                _ast_fingerprint(item.expr): name
+                for item, name in zip(q.select_items, out_names)
+            }
+            proj_exprs = list(zip(out_exprs, out_names))
+            sort_keys = []
+            hidden: list = []
+            if q.order_by:
+                for o in q.order_by:
+                    e = self._bind_order_expr_agg(
+                        o.expr, scope, group_lookup, agg_map, select_aliases,
+                        proj_exprs, out_fps,
+                    )
+                    # keys referencing agg-internal columns must ride through
+                    # the projection as hidden columns
+                    for cname in _collect_col_names([e]):
+                        if cname not in out_names and cname not in (
+                            n for _, n in hidden
+                        ):
+                            hidden.append((pe.Col(cname), cname))
+                    sort_keys.append((e, o.ascending, o.nulls_first))
+            plan2: LogicalPlan = LProject(proj_exprs + hidden, result)
+            if sort_keys:
+                plan2 = LSort(sort_keys, plan2, fetch=q.limit)
+            if hidden:
+                plan2 = LProject(
+                    [(pe.Col(n), n) for n in out_names], plan2
+                )
+            if q.distinct:
+                plan2 = LDistinct(plan2)
+            if q.limit is not None or q.offset is not None:
+                plan2 = LLimit(plan2, q.limit, q.offset or 0)
+            return plan2
+
+        # no aggregation
+        out = []
+        for idx, item in enumerate(q.select_items):
+            if isinstance(item.expr, ast.Star):
+                for f in plan.schema().fields:
+                    short = f.name.split(".")[-1]
+                    if item.expr.qualifier and not f.name.startswith(
+                        item.expr.qualifier + "."
+                    ):
+                        continue
+                    out.append((pe.Col(f.name), short))
+                continue
+            name = item.alias or _display_name(item.expr, idx)
+            out.append((self._bind_expr(item.expr, scope, outer_refs), name))
+        result = LProject(out, plan)
+        if q.order_by:
+            result = self._bind_order_by(
+                q, result,
+                lambda e: self._bind_order_expr_plain(
+                    e, scope, outer_refs, out, select_aliases
+                ),
+            )
+        if q.distinct:
+            result = LDistinct(result)
+        if q.limit is not None or q.offset is not None:
+            result = LLimit(result, q.limit, q.offset or 0)
+        return result
+
+    def _bind_order_by(self, q, plan, bind_fn) -> LogicalPlan:
+        keys = []
+        for o in q.order_by:
+            e = bind_fn(o.expr)
+            keys.append((e, o.ascending, o.nulls_first))
+        return LSort(keys, plan, fetch=q.limit)
+
+    def _bind_order_expr_plain(self, e, scope, outer_refs, out_exprs,
+                               select_aliases):
+        # positional reference
+        if isinstance(e, ast.NumberLit) and isinstance(e.value, int):
+            expr, name = out_exprs[e.value - 1]
+            return pe.Col(name)
+        if isinstance(e, ast.Ident) and e.qualifier is None:
+            for expr, name in out_exprs:
+                if name == e.name:
+                    return pe.Col(name)
+        return self._bind_expr(e, scope, outer_refs)
+
+    def _bind_order_expr_agg(self, e, scope, group_lookup, agg_map,
+                             select_aliases, out_exprs, out_fps):
+        if isinstance(e, ast.NumberLit) and isinstance(e.value, int):
+            _, name = out_exprs[e.value - 1]
+            return pe.Col(name)
+        if isinstance(e, ast.Ident) and e.qualifier is None:
+            for _, name in out_exprs:
+                if name == e.name:
+                    return pe.Col(name)
+        # structural match against a select item (ORDER BY t.k when SELECT
+        # t.k ... GROUP BY t.k)
+        fp = _ast_fingerprint(e)
+        if fp in out_fps:
+            return pe.Col(out_fps[fp])
+        return self._bind_post_agg(e, scope, group_lookup, agg_map,
+                                   select_aliases)
+
+    def _resolve_output_ref(self, g, select_items, select_aliases):
+        """GROUP BY may reference select aliases or positions."""
+        if isinstance(g, ast.NumberLit) and isinstance(g.value, int):
+            return select_items[g.value - 1].expr
+        if isinstance(g, ast.Ident) and g.qualifier is None and g.name in (
+            select_aliases
+        ):
+            return select_aliases[g.name]
+        return g
+
+    def _bind_post_agg(self, e, scope, group_lookup, agg_map, select_aliases):
+        """Bind an expression over the aggregate's output: aggregate calls map
+        to their output columns, group-expr subtrees map to group columns."""
+        fp = _ast_fingerprint(e)
+        if fp in group_lookup:
+            return pe.Col(group_lookup[fp])
+        if id(e) in agg_map:
+            return pe.Col(agg_map[id(e)])
+        # the same aggregate may appear in several clauses as distinct AST
+        # objects: match structurally
+        matched = self._match_agg_by_fingerprint(e, agg_map)
+        if matched is not None:
+            return pe.Col(matched)
+        if isinstance(e, ast.Ident) and e.qualifier is None and e.name in (
+            select_aliases
+        ):
+            return self._bind_post_agg(
+                select_aliases[e.name], scope, group_lookup, agg_map,
+                select_aliases,
+            )
+        # recurse structurally
+        return self._rebind_children(
+            e, lambda ch: self._bind_post_agg(ch, scope, group_lookup, agg_map,
+                                              select_aliases)
+        )
+
+    def _match_agg_by_fingerprint(self, e, agg_map):
+        if not (isinstance(e, ast.FuncCall) and e.name in _AGG_FUNCS):
+            return None
+        fp = _ast_fingerprint(e)
+        for call_id, name in agg_map.items():
+            call = _AGG_ID_REGISTRY.get(call_id)
+            if call is not None and _ast_fingerprint(call) == fp:
+                return name
+        return None
+
+    def _rebind_children(self, e, f: Callable):
+        """Rebuild an AST expression bottom-up into a PhysicalExpr, using f for
+        sub-expressions. Leaf idents must resolve via group/agg maps (handled
+        in f); anything else binds as scalar structure."""
+        if isinstance(e, ast.NumberLit):
+            return _literal_expr(e.value)
+        if isinstance(e, ast.StringLit):
+            return pe.Literal(e.value, DataType.STRING)
+        if isinstance(e, ast.DateLit):
+            return pe.Literal(e.days, DataType.DATE32)
+        if isinstance(e, ast.Binary):
+            if e.op in ("and", "or"):
+                return pe.BooleanOp(e.op, f(e.left), f(e.right))
+            return pe.BinaryOp(e.op, f(e.left), f(e.right))
+        if isinstance(e, ast.Unary):
+            if e.op == "not":
+                return pe.Not(f(e.child))
+            return pe.Negate(f(e.child))
+        if isinstance(e, ast.CaseAst):
+            branches = tuple((f(c), f(v)) for c, v in e.whens)
+            return pe.Case(branches, f(e.else_) if e.else_ else None)
+        if isinstance(e, ast.Between):
+            lo = pe.BinaryOp(">=", f(e.expr), f(e.low))
+            hi = pe.BinaryOp("<=", f(e.expr), f(e.high))
+            both = pe.BooleanOp("and", lo, hi)
+            return pe.Not(both) if e.negated else both
+        if isinstance(e, ast.CastAst):
+            return pe.Cast(f(e.expr), _cast_type(e.type_name))
+        if isinstance(e, ast.ScalarSubquery):
+            # e.g. HAVING sum(x) > (select ... ) — TPC-H q11
+            sub = Binder(self.catalog, self.ctes)._bind_query(e.query, None)
+            return ScalarSubqueryExpr(sub)
+        raise BindError(
+            f"cannot rebind {type(e).__name__} over aggregate output"
+        )
+
+    # -- expression binding ---------------------------------------------------
+    def _bind_expr(self, e, scope: Scope, outer_refs) -> pe.PhysicalExpr:
+        if isinstance(e, ast.Ident):
+            flat, field, depth = scope.resolve(e)
+            if depth > 0:
+                if outer_refs is None:
+                    raise BindError(f"unexpected outer reference {e.key()}")
+                outer_refs.append(OuterRef(flat, field))
+            return pe.Col(flat)
+        if isinstance(e, ast.NumberLit):
+            return _literal_expr(e.value)
+        if isinstance(e, ast.StringLit):
+            return pe.Literal(e.value, DataType.STRING)
+        if isinstance(e, ast.DateLit):
+            return pe.Literal(e.days, DataType.DATE32)
+        if isinstance(e, ast.IntervalLit):
+            raise BindError("bare interval literal outside date arithmetic")
+        if isinstance(e, ast.Binary):
+            if e.op in ("and", "or"):
+                return pe.BooleanOp(
+                    e.op,
+                    self._bind_expr(e.left, scope, outer_refs),
+                    self._bind_expr(e.right, scope, outer_refs),
+                )
+            # date +/- interval folding
+            folded = _fold_date_arith(e)
+            if folded is not None:
+                return folded if isinstance(folded, pe.PhysicalExpr) else (
+                    self._bind_expr(folded, scope, outer_refs)
+                )
+            return pe.BinaryOp(
+                e.op,
+                self._bind_expr(e.left, scope, outer_refs),
+                self._bind_expr(e.right, scope, outer_refs),
+            )
+        if isinstance(e, ast.Unary):
+            if e.op == "not":
+                return pe.Not(self._bind_expr(e.child, scope, outer_refs))
+            return pe.Negate(self._bind_expr(e.child, scope, outer_refs))
+        if isinstance(e, ast.Between):
+            x = self._bind_expr(e.expr, scope, outer_refs)
+            lo = pe.BinaryOp(">=", x, self._bind_expr(e.low, scope, outer_refs))
+            hi = pe.BinaryOp("<=", x, self._bind_expr(e.high, scope, outer_refs))
+            both = pe.BooleanOp("and", lo, hi)
+            return pe.Not(both) if e.negated else both
+        if isinstance(e, ast.InListAst):
+            x = self._bind_expr(e.expr, scope, outer_refs)
+            values = []
+            for item in e.items:
+                if isinstance(item, ast.StringLit):
+                    values.append(item.value)
+                elif isinstance(item, ast.NumberLit):
+                    values.append(item.value)
+                elif isinstance(item, ast.DateLit):
+                    values.append(item.days)
+                else:
+                    raise BindError("IN list items must be literals")
+            return pe.InList(x, tuple(values), e.negated)
+        if isinstance(e, ast.LikeAst):
+            return pe.Like(
+                self._bind_expr(e.expr, scope, outer_refs), e.pattern, e.negated
+            )
+        if isinstance(e, ast.IsNullAst):
+            return pe.IsNull(
+                self._bind_expr(e.expr, scope, outer_refs), e.negated
+            )
+        if isinstance(e, ast.CaseAst):
+            if e.operand is not None:
+                operand = self._bind_expr(e.operand, scope, outer_refs)
+                branches = tuple(
+                    (
+                        pe.BinaryOp(
+                            "==", operand, self._bind_expr(c, scope, outer_refs)
+                        ),
+                        self._bind_expr(v, scope, outer_refs),
+                    )
+                    for c, v in e.whens
+                )
+            else:
+                branches = tuple(
+                    (
+                        self._bind_expr(c, scope, outer_refs),
+                        self._bind_expr(v, scope, outer_refs),
+                    )
+                    for c, v in e.whens
+                )
+            otherwise = (
+                self._bind_expr(e.else_, scope, outer_refs) if e.else_ else None
+            )
+            return pe.Case(branches, otherwise)
+        if isinstance(e, ast.CastAst):
+            return pe.Cast(
+                self._bind_expr(e.expr, scope, outer_refs), _cast_type(e.type_name)
+            )
+        if isinstance(e, ast.ExtractAst):
+            return pe.Extract(
+                e.part, self._bind_expr(e.expr, scope, outer_refs)
+            )
+        if isinstance(e, ast.SubstringAst):
+            start = e.start.value if isinstance(e.start, ast.NumberLit) else None
+            length = (
+                e.length.value if isinstance(e.length, ast.NumberLit) else None
+            )
+            if start is None:
+                raise BindError("SUBSTRING start must be a literal")
+            return pe.Substring(
+                self._bind_expr(e.expr, scope, outer_refs), start, length
+            )
+        if isinstance(e, ast.ScalarSubquery):
+            sub = Binder(self.catalog, self.ctes)._bind_query(e.query, None)
+            return ScalarSubqueryExpr(sub)
+        if isinstance(e, ast.FuncCall):
+            if e.name in _AGG_FUNCS:
+                raise BindError(
+                    f"aggregate {e.name} not allowed in this context"
+                )
+            raise BindError(f"unknown function {e.name}")
+        raise BindError(f"cannot bind {type(e).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# Scalar subquery expression (executed lazily by the physical layer)
+# ---------------------------------------------------------------------------
+
+
+class ScalarSubqueryExpr(pe.PhysicalExpr):
+    """Placeholder for an uncorrelated scalar subquery; the physical planner
+    replaces it with a literal after executing the subplan (the reference
+    disables DataFusion's uncorrelated-subquery pushdown and relies on plain
+    planning, `session_state_builder_ext.rs:17-27` — here we evaluate it as a
+    prepared constant instead)."""
+
+    def __init__(self, logical: LogicalPlan):
+        self.logical = logical
+        self.physical = None  # filled by the physical planner
+
+    def children(self):
+        return []
+
+    def evaluate(self, table):
+        raise RuntimeError(
+            "ScalarSubqueryExpr must be resolved by the physical planner"
+        )
+
+    def output_field(self, schema):
+        f = self.logical.schema().fields[0]
+        return Field("__scalar_subquery", f.dtype, True)
+
+    def display(self):
+        return "(scalar subquery)"
+
+
+# ---------------------------------------------------------------------------
+# AST utilities
+# ---------------------------------------------------------------------------
+
+_AGG_FUNCS = {"sum", "count", "min", "max", "avg"}
+_AGG_ID_REGISTRY: dict[int, Any] = {}
+
+
+def _agg_parts(call: ast.FuncCall):
+    arg = call.args[0] if call.args else ast.Star()
+    return call.name, arg, call.distinct
+
+
+def _collect_agg_calls(node, out: list) -> None:
+    if isinstance(node, ast.FuncCall) and node.name in _AGG_FUNCS:
+        out.append(node)
+        _AGG_ID_REGISTRY[id(node)] = node
+        return  # nested aggregates are invalid SQL
+    if isinstance(node, (ast.ScalarSubquery, ast.Exists, ast.InSubquery)):
+        return  # subquery aggregates belong to the subquery
+    for ch in _ast_children(node):
+        _collect_agg_calls(ch, out)
+
+
+def _ast_children(node) -> list:
+    if isinstance(node, ast.Binary):
+        return [node.left, node.right]
+    if isinstance(node, ast.Unary):
+        return [node.child]
+    if isinstance(node, ast.Between):
+        return [node.expr, node.low, node.high]
+    if isinstance(node, ast.InListAst):
+        return [node.expr] + list(node.items)
+    if isinstance(node, ast.InSubquery):
+        return [node.expr]
+    if isinstance(node, ast.LikeAst):
+        return [node.expr]
+    if isinstance(node, ast.IsNullAst):
+        return [node.expr]
+    if isinstance(node, ast.CaseAst):
+        out = []
+        if node.operand is not None:
+            out.append(node.operand)
+        for c, v in node.whens:
+            out += [c, v]
+        if node.else_ is not None:
+            out.append(node.else_)
+        return out
+    if isinstance(node, ast.CastAst):
+        return [node.expr]
+    if isinstance(node, ast.ExtractAst):
+        return [node.expr]
+    if isinstance(node, ast.SubstringAst):
+        return [node.expr]
+    if isinstance(node, ast.FuncCall):
+        return list(node.args)
+    return []
+
+
+def _contains_subquery(node) -> bool:
+    if isinstance(node, (ast.ScalarSubquery, ast.Exists, ast.InSubquery)):
+        return True
+    if isinstance(node, ast.Unary) and node.op == "not":
+        return _contains_subquery(node.child)
+    return any(_contains_subquery(ch) for ch in _ast_children(node))
+
+
+def _common_or_conjuncts(node: ast.Binary) -> list:
+    """Conjuncts present (by fingerprint) in every branch of an OR tree."""
+
+    def branches(n):
+        if isinstance(n, ast.Binary) and n.op == "or":
+            return branches(n.left) + branches(n.right)
+        return [n]
+
+    bs = branches(node)
+    if len(bs) < 2:
+        return []
+    sets = []
+    by_fp: dict[str, Any] = {}
+    for b in bs:
+        cs = _split_conjuncts(b)
+        fps = set()
+        for c in cs:
+            fp = _ast_fingerprint(c)
+            fps.add(fp)
+            by_fp.setdefault(fp, c)
+        sets.append(fps)
+    common = set.intersection(*sets)
+    return [by_fp[fp] for fp in sorted(common)]
+
+
+def _split_conjuncts(node) -> list:
+    if isinstance(node, ast.Binary) and node.op == "and":
+        return _split_conjuncts(node.left) + _split_conjuncts(node.right)
+    return [node]
+
+
+def _join_conjuncts(conjuncts: list):
+    if not conjuncts:
+        return None
+    out = conjuncts[0]
+    for c in conjuncts[1:]:
+        out = ast.Binary("and", out, c)
+    return out
+
+
+def _has_aggregates(q: ast.Query) -> bool:
+    out: list = []
+    for item in q.select_items:
+        _collect_agg_calls(item.expr, out)
+    return bool(out) or bool(q.group_by)
+
+
+def _ast_fingerprint(node) -> str:
+    """Structural fingerprint for matching GROUP BY exprs to SELECT exprs."""
+    if isinstance(node, ast.Ident):
+        return f"id:{node.qualifier or ''}.{node.name}"
+    if isinstance(node, ast.NumberLit):
+        return f"n:{node.value}"
+    if isinstance(node, ast.StringLit):
+        return f"s:{node.value}"
+    if isinstance(node, ast.DateLit):
+        return f"d:{node.days}"
+    if isinstance(node, ast.FuncCall):
+        args = ",".join(_ast_fingerprint(a) for a in node.args)
+        return f"f:{node.name}({args}){'D' if node.distinct else ''}"
+    if isinstance(node, ast.Star):
+        return f"*:{node.qualifier or ''}"
+    parts = ",".join(_ast_fingerprint(c) for c in _ast_children(node))
+    op = getattr(node, "op", "")
+    extra = ""
+    if isinstance(node, ast.LikeAst):
+        extra = f":{node.pattern}:{node.negated}"
+    if isinstance(node, ast.CastAst):
+        extra = f":{node.type_name}"
+    if isinstance(node, ast.ExtractAst):
+        extra = f":{node.part}"
+    return f"{type(node).__name__}:{op}{extra}({parts})"
+
+
+def _display_name(e, idx: int) -> str:
+    if isinstance(e, ast.Ident):
+        return e.name
+    return f"col{idx}"
+
+
+def _literal_expr(v):
+    if v is None:
+        return pe.Literal(None, DataType.FLOAT64)
+    if isinstance(v, bool):
+        return pe.Literal(v, DataType.BOOL)
+    if isinstance(v, int):
+        return pe.Literal(v, DataType.INT64)
+    return pe.Literal(float(v), DataType.FLOAT64)
+
+
+def _cast_type(name: str) -> DataType:
+    name = name.strip().lower()
+    mapping = {
+        "int": DataType.INT32,
+        "integer": DataType.INT32,
+        "bigint": DataType.INT64,
+        "smallint": DataType.INT32,
+        "double": DataType.FLOAT64,
+        "double precision": DataType.FLOAT64,
+        "float": DataType.FLOAT32,
+        "real": DataType.FLOAT32,
+        "decimal": DataType.FLOAT64,
+        "numeric": DataType.FLOAT64,
+        "date": DataType.DATE32,
+        "boolean": DataType.BOOL,
+        "varchar": DataType.STRING,
+        "char": DataType.STRING,
+        "text": DataType.STRING,
+        "string": DataType.STRING,
+    }
+    if name in mapping:
+        return mapping[name]
+    raise BindError(f"unsupported cast type {name!r}")
+
+
+def _fold_date_arith(e: ast.Binary):
+    """Fold DATE +/- INTERVAL into a DateLit (TPC-H parameterized dates)."""
+    if e.op not in ("+", "-"):
+        return None
+    l, r = e.left, e.right
+    if isinstance(l, ast.DateLit) and isinstance(r, ast.IntervalLit):
+        sign = 1 if e.op == "+" else -1
+        days = _shift_date(l.days, sign * r.months, sign * r.days)
+        return pe.Literal(days, DataType.DATE32)
+    if isinstance(l, ast.IntervalLit) and isinstance(r, ast.DateLit) and e.op == "+":
+        days = _shift_date(r.days, l.months, l.days)
+        return pe.Literal(days, DataType.DATE32)
+    return None
+
+
+def _shift_date(epoch_days: int, months: int, days: int) -> int:
+    import datetime
+
+    d = datetime.date(1970, 1, 1) + datetime.timedelta(days=epoch_days)
+    if months:
+        total = d.year * 12 + (d.month - 1) + months
+        y, m = divmod(total, 12)
+        import calendar
+
+        day = min(d.day, calendar.monthrange(y, m + 1)[1])
+        d = datetime.date(y, m + 1, day)
+    d = d + datetime.timedelta(days=days)
+    return (d - datetime.date(1970, 1, 1)).days
+
+
+def _collect_col_names(exprs) -> list[str]:
+    out: list[str] = []
+
+    def walk(x):
+        if isinstance(x, pe.Col):
+            out.append(x.name)
+        for c in x.children():
+            walk(c)
+
+    for e in exprs:
+        walk(e)
+    return out
+
+
+def _project_through(plan: LogicalPlan, exprs) -> LogicalPlan:
+    """Append columns to a plan's output by re-projecting through its top
+    projection (used to expose correlation key columns of a subquery)."""
+    if isinstance(plan, LProject):
+        have = {n for _, n in plan.exprs}
+        extra = []
+        cs = plan.child.schema()
+        for e, n in exprs:
+            if n not in have:
+                extra.append((e, n))
+        return LProject(plan.exprs + extra, plan.child)
+    return LProject(exprs, plan)

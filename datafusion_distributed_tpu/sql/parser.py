@@ -1,0 +1,893 @@
+"""SQL lexer + recursive-descent parser.
+
+The reference leans on DataFusion's sqlparser-rs for SQL (SURVEY.md L0); this
+is an original parser covering the dialect the TPC-H / TPC-DS / ClickBench
+suites exercise: SELECT with joins (implicit comma joins and explicit
+[INNER|LEFT|RIGHT|FULL] JOIN ... ON), WHERE/GROUP BY/HAVING/ORDER BY/LIMIT,
+WITH CTEs, scalar/EXISTS/IN subqueries, BETWEEN/LIKE/CASE/CAST/EXTRACT/
+SUBSTRING, date/interval literals and UNION [ALL].
+
+Output is a small AST (dataclasses below); semantic analysis lives in
+sql/logical.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+# ---------------------------------------------------------------------------
+# AST
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Ident:
+    name: str
+    qualifier: Optional[str] = None
+
+    def key(self) -> str:
+        return f"{self.qualifier}.{self.name}" if self.qualifier else self.name
+
+
+@dataclass
+class NumberLit:
+    value: Any  # int or float
+
+
+@dataclass
+class StringLit:
+    value: str
+
+
+@dataclass
+class DateLit:
+    days: int  # days since epoch
+
+
+@dataclass
+class IntervalLit:
+    months: int
+    days: int
+
+
+@dataclass
+class Star:
+    qualifier: Optional[str] = None
+
+
+@dataclass
+class FuncCall:
+    name: str
+    args: list
+    distinct: bool = False
+
+
+@dataclass
+class Binary:
+    op: str
+    left: Any
+    right: Any
+
+
+@dataclass
+class Unary:
+    op: str  # "-" | "not" | "+"
+    child: Any
+
+
+@dataclass
+class Between:
+    expr: Any
+    low: Any
+    high: Any
+    negated: bool = False
+
+
+@dataclass
+class InListAst:
+    expr: Any
+    items: list
+    negated: bool = False
+
+
+@dataclass
+class InSubquery:
+    expr: Any
+    query: "Query"
+    negated: bool = False
+
+
+@dataclass
+class Exists:
+    query: "Query"
+    negated: bool = False
+
+
+@dataclass
+class ScalarSubquery:
+    query: "Query"
+
+
+@dataclass
+class LikeAst:
+    expr: Any
+    pattern: str
+    negated: bool = False
+
+
+@dataclass
+class IsNullAst:
+    expr: Any
+    negated: bool = False
+
+
+@dataclass
+class CaseAst:
+    operand: Optional[Any]
+    whens: list  # [(cond, value)]
+    else_: Optional[Any]
+
+
+@dataclass
+class CastAst:
+    expr: Any
+    type_name: str
+
+
+@dataclass
+class ExtractAst:
+    part: str  # "year" | "month" | "day"
+    expr: Any
+
+
+@dataclass
+class SubstringAst:
+    expr: Any
+    start: Any
+    length: Optional[Any]
+
+
+@dataclass
+class SelectItem:
+    expr: Any
+    alias: Optional[str] = None
+
+
+@dataclass
+class TableRef:
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclass
+class SubqueryRef:
+    query: "Query"
+    alias: str
+    column_aliases: Optional[list] = None
+
+
+@dataclass
+class JoinClause:
+    right: Any  # TableRef | SubqueryRef
+    kind: str  # inner|left|right|full|cross
+    on: Optional[Any]
+
+
+@dataclass
+class OrderItem:
+    expr: Any
+    ascending: bool = True
+    nulls_first: Optional[bool] = None
+
+
+@dataclass
+class Query:
+    select_items: list
+    from_refs: list  # [(TableRef|SubqueryRef, [JoinClause, ...]), ...]
+    where: Optional[Any] = None
+    group_by: list = field(default_factory=list)
+    having: Optional[Any] = None
+    order_by: list = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+    distinct: bool = False
+    ctes: list = field(default_factory=list)  # [(name, Query)]
+
+
+@dataclass
+class SetOp:
+    """UNION/INTERSECT/EXCEPT chain; ORDER BY/LIMIT apply to the result."""
+
+    op: str  # union|intersect|except
+    all: bool
+    left: Any  # Query | SetOp
+    right: Any  # Query
+    order_by: list = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+    ctes: list = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# Lexer
+# ---------------------------------------------------------------------------
+
+_KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "order", "limit",
+    "offset", "as", "and", "or", "not", "in", "exists", "between", "like",
+    "is", "null", "case", "when", "then", "else", "end", "cast", "extract",
+    "substring", "distinct", "join", "inner", "left", "right", "full",
+    "outer", "cross", "on", "union", "all", "intersect", "except", "with",
+    "asc", "desc", "date", "interval", "year", "month", "day", "true",
+    "false", "for", "nulls", "first", "last",
+}
+
+_SYMBOLS = [
+    "<>", "<=", ">=", "!=", "||", "(", ")", ",", "+", "-", "*", "/", "%",
+    "<", ">", "=", ".", ";",
+]
+
+
+@dataclass
+class Token:
+    kind: str  # kw | ident | number | string | sym | eof
+    value: str
+    pos: int
+
+
+class SqlLexError(ValueError):
+    pass
+
+
+class SqlParseError(ValueError):
+    pass
+
+
+def tokenize(sql: str) -> list[Token]:
+    out: list[Token] = []
+    i, n = 0, len(sql)
+    while i < n:
+        c = sql[i]
+        if c.isspace():
+            i += 1
+            continue
+        if sql.startswith("--", i):
+            j = sql.find("\n", i)
+            i = n if j < 0 else j + 1
+            continue
+        if sql.startswith("/*", i):
+            j = sql.find("*/", i + 2)
+            if j < 0:
+                raise SqlLexError(f"unterminated comment at {i}")
+            i = j + 2
+            continue
+        if c == "'":
+            j = i + 1
+            buf = []
+            while j < n:
+                if sql[j] == "'" and j + 1 < n and sql[j + 1] == "'":
+                    buf.append("'")
+                    j += 2
+                elif sql[j] == "'":
+                    break
+                else:
+                    buf.append(sql[j])
+                    j += 1
+            if j >= n:
+                raise SqlLexError(f"unterminated string at {i}")
+            out.append(Token("string", "".join(buf), i))
+            i = j + 1
+            continue
+        if c == '"':
+            j = sql.find('"', i + 1)
+            if j < 0:
+                raise SqlLexError(f"unterminated quoted identifier at {i}")
+            out.append(Token("ident", sql[i + 1 : j], i))
+            i = j + 1
+            continue
+        if c.isdigit() or (c == "." and i + 1 < n and sql[i + 1].isdigit()):
+            j = i
+            seen_dot = False
+            seen_exp = False
+            while j < n:
+                ch = sql[j]
+                if ch.isdigit():
+                    j += 1
+                elif ch == "." and not seen_dot and not seen_exp:
+                    seen_dot = True
+                    j += 1
+                elif ch in "eE" and not seen_exp and j > i:
+                    seen_exp = True
+                    j += 1
+                    if j < n and sql[j] in "+-":
+                        j += 1
+                else:
+                    break
+            out.append(Token("number", sql[i:j], i))
+            i = j
+            continue
+        if c.isalpha() or c == "_":
+            j = i
+            while j < n and (sql[j].isalnum() or sql[j] == "_"):
+                j += 1
+            word = sql[i:j]
+            kind = "kw" if word.lower() in _KEYWORDS else "ident"
+            out.append(Token(kind, word.lower() if kind == "kw" else word, i))
+            i = j
+            continue
+        for sym in _SYMBOLS:
+            if sql.startswith(sym, i):
+                out.append(Token("sym", sym, i))
+                i += len(sym)
+                break
+        else:
+            raise SqlLexError(f"unexpected character {c!r} at {i}")
+    out.append(Token("eof", "", n))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Parser
+# ---------------------------------------------------------------------------
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.sql = sql
+        self.toks = tokenize(sql)
+        self.i = 0
+
+    # -- token helpers ------------------------------------------------------
+    def peek(self, ahead: int = 0) -> Token:
+        return self.toks[min(self.i + ahead, len(self.toks) - 1)]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        if t.kind != "eof":
+            self.i += 1
+        return t
+
+    def at_kw(self, *words: str) -> bool:
+        t = self.peek()
+        return t.kind == "kw" and t.value in words
+
+    def at_sym(self, *syms: str) -> bool:
+        t = self.peek()
+        return t.kind == "sym" and t.value in syms
+
+    def eat_kw(self, *words: str) -> bool:
+        if self.at_kw(*words):
+            self.next()
+            return True
+        return False
+
+    def eat_sym(self, *syms: str) -> bool:
+        if self.at_sym(*syms):
+            self.next()
+            return True
+        return False
+
+    def expect_kw(self, word: str) -> None:
+        if not self.eat_kw(word):
+            self.error(f"expected {word.upper()}")
+
+    def expect_sym(self, sym: str) -> None:
+        if not self.eat_sym(sym):
+            self.error(f"expected {sym!r}")
+
+    def error(self, msg: str):
+        t = self.peek()
+        ctx = self.sql[max(0, t.pos - 20) : t.pos + 20].replace("\n", " ")
+        raise SqlParseError(f"{msg} at position {t.pos} (near ...{ctx}...)")
+
+    # -- entry --------------------------------------------------------------
+    def parse_query(self) -> Query:
+        q = self._query()
+        self.eat_sym(";")
+        if self.peek().kind != "eof":
+            self.error("trailing input")
+        return q
+
+    def _query(self) -> Query:
+        ctes = []
+        if self.eat_kw("with"):
+            while True:
+                name = self._ident_name()
+                self.expect_kw("as") if self.at_kw("as") else self.error(
+                    "expected AS in CTE"
+                )
+                self.expect_sym("(")
+                sub = self._query()
+                self.expect_sym(")")
+                ctes.append((name, sub))
+                if not self.eat_sym(","):
+                    break
+        q = self._select()
+        q.ctes = ctes
+        # set operations
+        while self.at_kw("union", "intersect", "except"):
+            op = self.next().value
+            all_ = self.eat_kw("all")
+            rhs = self._select()
+            q = SetOp(op, all_, q, rhs, ctes=ctes)
+            # a trailing ORDER BY/LIMIT parsed into the last arm belongs to
+            # the whole set-op chain (arms can't carry them without parens)
+            if rhs.order_by or rhs.limit is not None or rhs.offset is not None:
+                q.order_by, rhs.order_by = rhs.order_by, []
+                q.limit, rhs.limit = rhs.limit, None
+                q.offset, rhs.offset = rhs.offset, None
+        # ORDER BY / LIMIT can follow a set op chain
+        if self.at_kw("order"):
+            q.order_by = self._order_by()
+        if self.eat_kw("limit"):
+            q.limit = self._int_literal()
+        if self.eat_kw("offset"):
+            q.offset = self._int_literal()
+        return q
+
+    def _select(self) -> Query:
+        self.expect_kw("select")
+        distinct = self.eat_kw("distinct")
+        items = [self._select_item()]
+        while self.eat_sym(","):
+            items.append(self._select_item())
+        from_refs = []
+        if self.eat_kw("from"):
+            from_refs.append(self._table_with_joins())
+            while self.eat_sym(","):
+                from_refs.append(self._table_with_joins())
+        where = self._expr() if self.eat_kw("where") else None
+        group_by = []
+        if self.eat_kw("group"):
+            self.expect_kw("by")
+            group_by.append(self._expr())
+            while self.eat_sym(","):
+                group_by.append(self._expr())
+        having = self._expr() if self.eat_kw("having") else None
+        order_by = self._order_by() if self.at_kw("order") else []
+        limit = self._int_literal() if self.eat_kw("limit") else None
+        offset = self._int_literal() if self.eat_kw("offset") else None
+        return Query(
+            select_items=items,
+            from_refs=from_refs,
+            where=where,
+            group_by=group_by,
+            having=having,
+            order_by=order_by,
+            limit=limit,
+            offset=offset,
+            distinct=distinct,
+        )
+
+    def _order_by(self) -> list[OrderItem]:
+        self.expect_kw("order")
+        self.expect_kw("by")
+        out = [self._order_item()]
+        while self.eat_sym(","):
+            out.append(self._order_item())
+        return out
+
+    def _order_item(self) -> OrderItem:
+        e = self._expr()
+        asc = True
+        if self.eat_kw("desc"):
+            asc = False
+        else:
+            self.eat_kw("asc")
+        nulls_first = None
+        if self.eat_kw("nulls"):
+            if self.eat_kw("first"):
+                nulls_first = True
+            elif self.eat_kw("last"):
+                nulls_first = False
+            else:
+                self.error("expected FIRST or LAST")
+        return OrderItem(e, asc, nulls_first)
+
+    def _int_literal(self) -> int:
+        t = self.peek()
+        if t.kind != "number":
+            self.error("expected integer literal")
+        self.next()
+        return int(t.value)
+
+    def _select_item(self) -> SelectItem:
+        if self.at_sym("*"):
+            self.next()
+            return SelectItem(Star())
+        # qualified star t.*
+        if (
+            self.peek().kind == "ident"
+            and self.peek(1).kind == "sym"
+            and self.peek(1).value == "."
+            and self.peek(2).kind == "sym"
+            and self.peek(2).value == "*"
+        ):
+            q = self.next().value
+            self.next()
+            self.next()
+            return SelectItem(Star(qualifier=q))
+        e = self._expr()
+        alias = None
+        if self.eat_kw("as"):
+            alias = self._ident_name()
+        elif self.peek().kind == "ident":
+            alias = self.next().value
+        return SelectItem(e, alias)
+
+    def _ident_name(self) -> str:
+        t = self.peek()
+        if t.kind == "ident":
+            self.next()
+            return t.value
+        # permissive: some keywords double as identifiers (e.g. a column
+        # named "year"); accept non-reserved keywords as names.
+        if t.kind == "kw" and t.value in ("year", "month", "day", "date",
+                                          "first", "last"):
+            self.next()
+            return t.value
+        self.error("expected identifier")
+
+    # -- FROM ---------------------------------------------------------------
+    def _table_with_joins(self):
+        base = self._table_ref()
+        joins = []
+        while True:
+            kind = None
+            if self.at_kw("join"):
+                kind = "inner"
+            elif self.at_kw("inner") and self.peek(1).value == "join":
+                kind = "inner"
+                self.next()
+            elif self.at_kw("left"):
+                kind = "left"
+                self.next()
+                self.eat_kw("outer")
+            elif self.at_kw("right"):
+                kind = "right"
+                self.next()
+                self.eat_kw("outer")
+            elif self.at_kw("full"):
+                kind = "full"
+                self.next()
+                self.eat_kw("outer")
+            elif self.at_kw("cross"):
+                kind = "cross"
+                self.next()
+            else:
+                break
+            self.expect_kw("join")
+            right = self._table_ref()
+            on = None
+            if kind != "cross":
+                self.expect_kw("on")
+                on = self._expr()
+            joins.append(JoinClause(right, kind, on))
+        return (base, joins)
+
+    def _table_ref(self):
+        if self.eat_sym("("):
+            sub = self._query()
+            self.expect_sym(")")
+            self.eat_kw("as")
+            alias = self._ident_name()
+            col_aliases = None
+            if self.eat_sym("("):
+                col_aliases = [self._ident_name()]
+                while self.eat_sym(","):
+                    col_aliases.append(self._ident_name())
+                self.expect_sym(")")
+            return SubqueryRef(sub, alias, col_aliases)
+        name = self._ident_name()
+        alias = None
+        if self.eat_kw("as"):
+            alias = self._ident_name()
+        elif self.peek().kind == "ident":
+            alias = self.next().value
+        return TableRef(name, alias)
+
+    # -- expressions (precedence climbing) ----------------------------------
+    def _expr(self):
+        return self._or_expr()
+
+    def _or_expr(self):
+        left = self._and_expr()
+        while self.eat_kw("or"):
+            left = Binary("or", left, self._and_expr())
+        return left
+
+    def _and_expr(self):
+        left = self._not_expr()
+        while self.eat_kw("and"):
+            left = Binary("and", left, self._not_expr())
+        return left
+
+    def _not_expr(self):
+        if self.eat_kw("not"):
+            return Unary("not", self._not_expr())
+        return self._predicate()
+
+    def _predicate(self):
+        if self.at_kw("exists"):
+            self.next()
+            self.expect_sym("(")
+            q = self._query()
+            self.expect_sym(")")
+            return Exists(q)
+        left = self._additive()
+        while True:
+            negated = False
+            if self.at_kw("not") and self.peek(1).value in (
+                "in", "between", "like",
+            ):
+                self.next()
+                negated = True
+            if self.eat_kw("between"):
+                low = self._additive()
+                self.expect_kw("and")
+                high = self._additive()
+                left = Between(left, low, high, negated)
+                continue
+            if self.eat_kw("in"):
+                self.expect_sym("(")
+                if self.at_kw("select", "with"):
+                    q = self._query()
+                    self.expect_sym(")")
+                    left = InSubquery(left, q, negated)
+                else:
+                    items = [self._expr()]
+                    while self.eat_sym(","):
+                        items.append(self._expr())
+                    self.expect_sym(")")
+                    left = InListAst(left, items, negated)
+                continue
+            if self.eat_kw("like"):
+                t = self.peek()
+                if t.kind != "string":
+                    self.error("LIKE pattern must be a string literal")
+                self.next()
+                left = LikeAst(left, t.value, negated)
+                continue
+            if self.eat_kw("is"):
+                neg = self.eat_kw("not")
+                self.expect_kw("null")
+                left = IsNullAst(left, neg)
+                continue
+            if self.peek().kind == "sym" and self.peek().value in (
+                "=", "<>", "!=", "<", "<=", ">", ">=",
+            ):
+                op = self.next().value
+                op = {"=": "==", "<>": "!=", "!=": "!="}.get(op, op)
+                right = self._additive()
+                left = Binary(op, left, right)
+                continue
+            return left
+
+    def _additive(self):
+        left = self._multiplicative()
+        while self.at_sym("+", "-"):
+            op = self.next().value
+            left = Binary(op, left, self._multiplicative())
+        return left
+
+    def _multiplicative(self):
+        left = self._unary()
+        while self.at_sym("*", "/", "%"):
+            op = self.next().value
+            left = Binary(op, left, self._unary())
+        return left
+
+    def _unary(self):
+        if self.at_sym("-"):
+            self.next()
+            return Unary("-", self._unary())
+        if self.at_sym("+"):
+            self.next()
+            return self._unary()
+        return self._primary()
+
+    def _primary(self):
+        t = self.peek()
+        if t.kind == "number":
+            self.next()
+            v = float(t.value) if ("." in t.value or "e" in t.value.lower()) else int(t.value)
+            return NumberLit(v)
+        if t.kind == "string":
+            self.next()
+            return StringLit(t.value)
+        if self.at_kw("true"):
+            self.next()
+            return NumberLit(1)
+        if self.at_kw("false"):
+            self.next()
+            return NumberLit(0)
+        if self.at_kw("null"):
+            self.next()
+            return NumberLit(None)
+        if self.at_kw("date"):
+            # DATE 'yyyy-mm-dd'
+            self.next()
+            s = self.peek()
+            if s.kind != "string":
+                self.error("expected date string literal")
+            self.next()
+            from datafusion_distributed_tpu.plan.expressions import parse_date
+
+            return DateLit(parse_date(s.value))
+        if self.at_kw("interval"):
+            self.next()
+            s = self.peek()
+            if s.kind != "string":
+                self.error("expected interval string literal")
+            self.next()
+            # INTERVAL '90' DAY | INTERVAL '3' MONTH | INTERVAL '1' YEAR
+            qty_str = s.value.strip()
+            unit = None
+            parts = qty_str.split()
+            if len(parts) == 2:
+                qty_str, unit = parts[0], parts[1].lower().rstrip("s")
+            qty = int(qty_str)
+            if unit is None:
+                if self.at_kw("day", "month", "year"):
+                    unit = self.next().value
+                else:
+                    unit = "day"
+            if unit == "day":
+                return IntervalLit(0, qty)
+            if unit == "month":
+                return IntervalLit(qty, 0)
+            if unit == "year":
+                return IntervalLit(12 * qty, 0)
+            self.error(f"unsupported interval unit {unit}")
+        if self.at_kw("case"):
+            return self._case()
+        if self.at_kw("cast"):
+            self.next()
+            self.expect_sym("(")
+            e = self._expr()
+            self.expect_kw("as")
+            # type name: one or two words (e.g. double precision), optional (p,s)
+            words = [self._type_word()]
+            while self.peek().kind in ("ident", "kw") and not self.at_sym(")"):
+                words.append(self._type_word())
+            if self.eat_sym("("):
+                self._int_literal()
+                if self.eat_sym(","):
+                    self._int_literal()
+                self.expect_sym(")")
+            self.expect_sym(")")
+            return CastAst(e, " ".join(words))
+        if self.at_kw("extract"):
+            self.next()
+            self.expect_sym("(")
+            part_tok = self.next()
+            part = part_tok.value.lower()
+            if part not in ("year", "month", "day"):
+                self.error(f"unsupported EXTRACT part {part}")
+            if not self.eat_kw("from"):
+                self.error("expected FROM in EXTRACT")
+            e = self._expr()
+            self.expect_sym(")")
+            return ExtractAst(part, e)
+        if self.at_kw("substring"):
+            self.next()
+            self.expect_sym("(")
+            e = self._expr()
+            if self.eat_kw("from"):
+                start = self._expr()
+                length = self._expr() if self.eat_kw("for") else None
+            else:
+                self.expect_sym(",")
+                start = self._expr()
+                length = self._expr() if self.eat_sym(",") else None
+            self.expect_sym(")")
+            return SubstringAst(e, start, length)
+        if self.eat_sym("("):
+            if self.at_kw("select", "with"):
+                q = self._query()
+                self.expect_sym(")")
+                return ScalarSubquery(q)
+            e = self._expr()
+            self.expect_sym(")")
+            return e
+        if t.kind == "ident" or (t.kind == "kw" and t.value in (
+            "year", "month", "day", "first", "last",
+        )):
+            name = self.next().value
+            # function call?
+            if self.at_sym("(") :
+                self.next()
+                distinct = self.eat_kw("distinct")
+                args: list = []
+                if self.at_sym("*"):
+                    self.next()
+                    args = [Star()]
+                elif not self.at_sym(")"):
+                    args.append(self._expr())
+                    while self.eat_sym(","):
+                        args.append(self._expr())
+                self.expect_sym(")")
+                return FuncCall(name.lower(), args, distinct)
+            # qualified identifier?
+            if self.at_sym(".") :
+                self.next()
+                col = self._ident_name()
+                return Ident(col, qualifier=name)
+            return Ident(name)
+        self.error("unexpected token in expression")
+
+    def _type_word(self) -> str:
+        t = self.next()
+        return t.value.lower()
+
+    def _case(self):
+        self.expect_kw("case")
+        operand = None
+        if not self.at_kw("when"):
+            operand = self._expr()
+        whens = []
+        while self.eat_kw("when"):
+            cond = self._expr()
+            self.expect_kw("then")
+            val = self._expr()
+            whens.append((cond, val))
+        else_ = self._expr() if self.eat_kw("else") else None
+        self.expect_kw("end")
+        return CaseAst(operand, whens, else_)
+
+
+@dataclass
+class CreateView:
+    name: str
+    query: Any  # Query | SetOp
+    column_aliases: Optional[list] = None
+
+
+@dataclass
+class DropView:
+    name: str
+
+
+def parse_sql(sql: str):
+    return Parser(sql).parse_query()
+
+
+def parse_statements(sql: str) -> list:
+    """Parse a script of ;-separated statements: SELECT queries plus
+    CREATE VIEW <name> AS <query> and DROP VIEW <name> (TPC-H q15 shape)."""
+    p = Parser(sql)
+    out: list = []
+    while p.peek().kind != "eof":
+        if p.at_kw("with") or p.at_kw("select"):
+            out.append(p._query())
+        elif p.peek().kind == "ident" and p.peek().value.lower() == "create":
+            p.next()
+            _expect_word(p, "view")
+            name = p._ident_name()
+            col_aliases = None
+            if p.eat_sym("("):
+                col_aliases = [p._ident_name()]
+                while p.eat_sym(","):
+                    col_aliases.append(p._ident_name())
+                p.expect_sym(")")
+            p.expect_kw("as")
+            out.append(CreateView(name, p._query(), col_aliases))
+        elif p.peek().kind == "ident" and p.peek().value.lower() == "drop":
+            p.next()
+            _expect_word(p, "view")
+            out.append(DropView(p._ident_name()))
+        else:
+            p.error("expected statement")
+        while p.eat_sym(";"):
+            pass
+    return out
+
+
+def _expect_word(p: Parser, word: str) -> None:
+    t = p.peek()
+    if t.kind == "ident" and t.value.lower() == word:
+        p.next()
+        return
+    p.error(f"expected {word.upper()}")

@@ -1,0 +1,342 @@
+"""Logical -> physical planning.
+
+The reference defers single-node physical planning to DataFusion and then
+rewrites the tree distributively (SURVEY.md §3.1). Our logical tree lowers to
+the TPU ExecutionPlan IR here; the distributed planner (planner/) then splits
+that physical tree into stages. Responsibilities:
+
+- scan column pruning (only columns referenced anywhere above reach HBM),
+- materializing group/agg/sort/join-key expressions into named columns,
+- COUNT(DISTINCT x) -> two-level aggregate rewrite,
+- resolving uncorrelated scalar subqueries into lazily-executed constants,
+- capacity/slot policy via PlannerConfig (join expansion, agg load factor).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, replace
+from typing import Optional
+
+from datafusion_distributed_tpu.ops.aggregate import AggSpec
+from datafusion_distributed_tpu.ops.sort import SortKey
+from datafusion_distributed_tpu.ops.table import round_up_pow2
+from datafusion_distributed_tpu.plan import expressions as pe
+from datafusion_distributed_tpu.plan.joins import (
+    CrossJoinExec,
+    HashJoinExec,
+    UnionExec,
+)
+from datafusion_distributed_tpu.plan.physical import (
+    ExecutionPlan,
+    FilterExec,
+    HashAggregateExec,
+    LimitExec,
+    ProjectionExec,
+    SortExec,
+)
+from datafusion_distributed_tpu.schema import DataType, Field, Schema
+from datafusion_distributed_tpu.sql import logical as lg
+
+_TMP = itertools.count()
+
+
+@dataclass
+class PlannerConfig:
+    join_expansion_factor: float = 1.0
+    agg_slot_factor: float = 2.0
+    max_slots: int = 1 << 21
+    max_out_capacity: int = 1 << 22
+
+
+class PhysicalPlanner:
+    def __init__(self, catalog, config: Optional[PlannerConfig] = None):
+        self.catalog = catalog
+        self.config = config or PlannerConfig()
+
+    # -- public ---------------------------------------------------------------
+    def plan(self, logical: lg.LogicalPlan) -> ExecutionPlan:
+        used = _collect_used_columns(logical)
+        return self._plan(logical, used)
+
+    # -- dispatch ---------------------------------------------------------------
+    def _plan(self, node: lg.LogicalPlan, used: set) -> ExecutionPlan:
+        if isinstance(node, lg.LScan):
+            return self._plan_scan(node, used)
+        if isinstance(node, lg.LFilter):
+            child = self._plan(node.child, used)
+            self._resolve_subqueries(node.predicate)
+            return FilterExec(node.predicate, child)
+        if isinstance(node, lg.LProject):
+            child = self._plan(node.child, used)
+            for e, _ in node.exprs:
+                self._resolve_subqueries(e)
+            return ProjectionExec([(e, n) for e, n in node.exprs], child)
+        if isinstance(node, lg.LAggregate):
+            return self._plan_aggregate(node, used)
+        if isinstance(node, lg.LJoin):
+            return self._plan_join(node, used)
+        if isinstance(node, lg.LSort):
+            return self._plan_sort(node, used)
+        if isinstance(node, lg.LLimit):
+            child = self._plan(node.child, used)
+            return LimitExec(child, node.fetch if node.fetch is not None else
+                             child.output_capacity(), node.skip)
+        if isinstance(node, lg.LDistinct):
+            child = self._plan(node.child, used)
+            return self._distinct(child)
+        if isinstance(node, lg.LSetOp):
+            return self._plan_setop(node, used)
+        raise NotImplementedError(f"cannot lower {type(node).__name__}")
+
+    # -- scans ------------------------------------------------------------------
+    def _plan_scan(self, node: lg.LScan, used: set) -> ExecutionPlan:
+        needed_orig = []
+        for f in node.table_schema.fields:
+            flat = f"{node.alias}.{f.name}"
+            if flat in used:
+                needed_orig.append(f.name)
+        if not needed_orig:
+            needed_orig = [node.table_schema.fields[0].name]
+        scan = self.catalog.scan_exec(node.table, needed_orig)
+        rename = [
+            (pe.Col(orig), f"{node.alias}.{orig}") for orig in needed_orig
+        ]
+        return ProjectionExec(rename, scan)
+
+    # -- aggregate ----------------------------------------------------------------
+    def _plan_aggregate(self, node: lg.LAggregate, used: set) -> ExecutionPlan:
+        child = self._plan(node.child, used)
+        distinct_aggs = [a for a in node.aggs if a.distinct]
+        regular = [a for a in node.aggs if not a.distinct]
+        if distinct_aggs and regular:
+            raise NotImplementedError(
+                "mixing DISTINCT and plain aggregates in one GROUP BY"
+            )
+
+        # materialize group + agg input expressions
+        mat: list = []
+        group_names = []
+        for e, name in node.groups:
+            self._resolve_subqueries(e)
+            mat.append((e, name))
+            group_names.append(name)
+        specs: list[AggSpec] = []
+        for a in node.aggs:
+            if a.func == "count_star":
+                specs.append(AggSpec("count_star", None, a.name))
+                continue
+            self._resolve_subqueries(a.arg)
+            in_name = f"__in_{a.name}"
+            mat.append((a.arg, in_name))
+            specs.append(AggSpec(a.func, in_name, a.name))
+        proj = ProjectionExec(mat, child) if mat else child
+
+        if distinct_aggs:
+            # COUNT(DISTINCT x): dedup (groups + x), then count per group.
+            inner_groups = group_names + [s.input_name for s in specs]
+            slots = self._agg_slots(proj.output_capacity())
+            dedup = HashAggregateExec("single", inner_groups, [], proj, slots)
+            outer_specs = [
+                AggSpec("count", s.input_name, s.output_name) for s in specs
+            ]
+            slots2 = self._agg_slots(dedup.output_capacity())
+            return HashAggregateExec(
+                "single", group_names, outer_specs, dedup, slots2
+            )
+
+        slots = self._agg_slots(proj.output_capacity())
+        return HashAggregateExec("single", group_names, specs, proj, slots)
+
+    def _agg_slots(self, cap: int) -> int:
+        return min(
+            round_up_pow2(max(int(cap * self.config.agg_slot_factor), 16)),
+            self.config.max_slots,
+        )
+
+    def _distinct(self, child: ExecutionPlan) -> ExecutionPlan:
+        names = child.schema().names
+        return HashAggregateExec(
+            "single", names, [], child, self._agg_slots(child.output_capacity())
+        )
+
+    # -- join -----------------------------------------------------------------------
+    def _plan_join(self, node: lg.LJoin, used: set) -> ExecutionPlan:
+        left = self._plan(node.left, used)
+        right = self._plan(node.right, used)
+        if node.how == "cross":
+            return CrossJoinExec(left, right)
+
+        def materialize_keys(plan, keys, side):
+            names = []
+            extra = []
+            schema = plan.schema()
+            for k in keys:
+                self._resolve_subqueries(k)
+                if isinstance(k, pe.Col):
+                    names.append(k.name)
+                else:
+                    nm = f"__jk{side}{next(_TMP)}"
+                    extra.append((k, nm))
+                    names.append(nm)
+            if extra:
+                passthrough = [(pe.Col(f.name), f.name) for f in schema.fields]
+                plan = ProjectionExec(passthrough + extra, plan)
+            return plan, names
+
+        left, lnames = materialize_keys(left, node.left_keys, "l")
+        right, rnames = materialize_keys(right, node.right_keys, "r")
+        if node.residual is not None:
+            self._resolve_subqueries(node.residual)
+        join = HashJoinExec(
+            left,
+            right,
+            lnames,
+            rnames,
+            node.how,
+            residual=node.residual,
+            mark_name=node.mark_name or "__mark",
+            expansion_factor=self.config.join_expansion_factor,
+        )
+        # strip materialized key columns from inner/left outputs
+        if node.how in ("inner", "left"):
+            want = [f.name for f in node.schema().fields]
+            have = set(join.schema().names)
+            keep = [n for n in want if n in have]
+            if set(keep) != set(join.schema().names):
+                return ProjectionExec([(pe.Col(n), n) for n in keep], join)
+        return join
+
+    # -- sort ------------------------------------------------------------------------
+    def _plan_sort(self, node: lg.LSort, used: set) -> ExecutionPlan:
+        child = self._plan(node.child, used)
+        schema = child.schema()
+        keys = []
+        extra = []
+        for e, asc, nulls_first in node.keys:
+            self._resolve_subqueries(e)
+            if isinstance(e, pe.Col):
+                name = e.name
+            else:
+                name = f"__sk{next(_TMP)}"
+                extra.append((e, name))
+            if nulls_first is None:
+                nulls_first = not asc  # SQL default: NULLS LAST for ASC
+            keys.append(SortKey(name, asc, nulls_first))
+        plan: ExecutionPlan = child
+        if extra:
+            passthrough = [(pe.Col(f.name), f.name) for f in schema.fields]
+            plan = ProjectionExec(passthrough + extra, plan)
+        plan = SortExec(keys, plan, fetch=node.fetch)
+        if extra:
+            plan = ProjectionExec(
+                [(pe.Col(f.name), f.name) for f in schema.fields], plan
+            )
+        return plan
+
+    # -- set ops -----------------------------------------------------------------------
+    def _plan_setop(self, node: lg.LSetOp, used: set) -> ExecutionPlan:
+        left = self._plan(node.left, used)
+        right = self._plan(node.right, used)
+        if node.op == "union":
+            return UnionExec([left, right])
+        # INTERSECT/EXCEPT are DISTINCT semi/anti joins on all columns
+        left_d = self._distinct(left)
+        how = "semi" if node.op == "intersect" else "anti"
+        return HashJoinExec(
+            left_d, right, list(left_d.schema().names),
+            list(right.schema().names), how,
+            expansion_factor=self.config.join_expansion_factor,
+        )
+
+    # -- scalar subqueries ---------------------------------------------------------------
+    def _resolve_subqueries(self, expr: pe.PhysicalExpr) -> None:
+        # no memoization guard: a replan after an overflow must re-plan the
+        # subquery with the widened config too
+        if isinstance(expr, lg.ScalarSubqueryExpr):
+            sub_planner = PhysicalPlanner(self.catalog, self.config)
+            expr.physical = sub_planner.plan(expr.logical)
+            # Execute NOW, at planning time — this must happen outside any
+            # enclosing jit trace (a nested jit during tracing would inline
+            # symbolically and break host-side overflow checks).
+            value, dtype = _exec_scalar(expr.physical)
+            expr.evaluate = _make_scalar_eval(value, dtype)  # type: ignore[method-assign]
+        for c in expr.children():
+            self._resolve_subqueries(c)
+
+
+def _collect_used_columns(plan: lg.LogicalPlan) -> set:
+    """Every flat column name referenced by any expression in the tree (plus
+    subquery trees). Scans prune to this set — the projection-pushdown
+    analogue of DataFusion's physical optimizer."""
+    used: set = set()
+
+    def walk_expr(e: pe.PhysicalExpr):
+        if isinstance(e, pe.Col):
+            used.add(e.name)
+        if isinstance(e, lg.ScalarSubqueryExpr):
+            used.update(_collect_used_columns(e.logical))
+        for c in e.children():
+            walk_expr(c)
+
+    def walk(n: lg.LogicalPlan):
+        if isinstance(n, lg.LFilter):
+            walk_expr(n.predicate)
+        elif isinstance(n, lg.LProject):
+            for e, _ in n.exprs:
+                walk_expr(e)
+        elif isinstance(n, lg.LAggregate):
+            for e, _ in n.groups:
+                walk_expr(e)
+            for a in n.aggs:
+                if a.arg is not None:
+                    walk_expr(a.arg)
+        elif isinstance(n, lg.LJoin):
+            for e in n.left_keys + n.right_keys:
+                walk_expr(e)
+            if n.residual is not None:
+                walk_expr(n.residual)
+        elif isinstance(n, lg.LSort):
+            for e, _, _ in n.keys:
+                walk_expr(e)
+        elif isinstance(n, (lg.LSetOp, lg.LDistinct)):
+            for f in n.schema().fields:
+                used.add(f.name)
+        for c in n.children():
+            walk(c)
+
+    for f in plan.schema().fields:
+        used.add(f.name)
+    walk(plan)
+    return used
+
+
+def _exec_scalar(physical: ExecutionPlan):
+    """Run a scalar subquery plan to completion; -> (python scalar|None, dtype)."""
+    from datafusion_distributed_tpu.plan.physical import execute_plan
+
+    result = execute_plan(physical)
+    col = result.columns[0]
+    n = int(result.num_rows)
+    if n == 0:
+        return None, col.dtype
+    if col.validity is not None and not bool(col.validity[0]):
+        return None, col.dtype
+    return col.data[0].item(), col.dtype
+
+
+def _make_scalar_eval(value, dtype):
+    import jax.numpy as jnp
+
+    def evaluate(table):
+        cap = table.capacity
+        if value is None:
+            return pe.ExprValue(
+                jnp.zeros(cap, dtype=dtype.np_dtype),
+                jnp.zeros(cap, dtype=jnp.bool_),
+                dtype,
+            )
+        data = jnp.full(cap, value, dtype=dtype.np_dtype)
+        return pe.ExprValue(data, None, dtype)
+
+    return evaluate

@@ -1,0 +1,119 @@
+"""Hash join kernel golden tests vs pandas merge."""
+
+import jax
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from datafusion_distributed_tpu.io.parquet import arrow_to_table
+from datafusion_distributed_tpu.ops.join import build_join_table, hash_join
+
+
+def _join(probe_arrow, build_arrow, probe_keys, build_keys, how, out_cap=256,
+          slots=64):
+    probe = arrow_to_table(probe_arrow)
+    build = arrow_to_table(build_arrow)
+
+    @jax.jit
+    def run(p, b):
+        bs = build_join_table(b, build_keys, slots)
+        return hash_join(p, bs, probe_keys, how, out_cap, build_prefix="r_")
+
+    out, overflow = run(probe, build)
+    assert not bool(overflow)
+    return out.to_pandas()
+
+
+def test_inner_join_pk_fk():
+    orders = pa.table({"okey": [1, 2, 3, 4], "cust": [10, 20, 10, 30]})
+    items = pa.table({"okey2": [1, 1, 2, 3, 3, 3, 9], "qty": [5, 6, 7, 8, 9, 10, 11]})
+    got = _join(items, orders, ["okey2"], ["okey"], "inner")
+    got = got.sort_values(["okey2", "qty"]).reset_index(drop=True)
+    exp = (
+        items.to_pandas()
+        .merge(orders.to_pandas(), left_on="okey2", right_on="okey")
+        .sort_values(["okey2", "qty"]).reset_index(drop=True)
+    )
+    assert len(got) == len(exp) == 6
+    np.testing.assert_array_equal(got["qty"], exp["qty"])
+    np.testing.assert_array_equal(got["r_cust"], exp["cust"])
+
+
+def test_inner_join_many_to_many():
+    l = pa.table({"k": [1, 1, 2, 3], "lv": [10, 11, 12, 13]})
+    r = pa.table({"k": [1, 1, 1, 2, 5], "rv": [100, 101, 102, 103, 104]})
+    got = _join(l, r, ["k"], ["k"], "inner")
+    exp = l.to_pandas().merge(r.to_pandas(), on="k")
+    assert len(got) == len(exp) == 7
+    got_pairs = sorted(zip(got["lv"], got["r_rv"]))
+    exp_pairs = sorted(zip(exp["lv"], exp["rv"]))
+    assert got_pairs == exp_pairs
+
+
+def test_left_join_with_nulls():
+    l = pa.table({"k": pa.array([1, 2, None, 4], type=pa.int64()),
+                  "lv": [10, 20, 30, 40]})
+    r = pa.table({"k": pa.array([1, None], type=pa.int64()), "rv": [100, 200]})
+    got = _join(l, r, ["k"], ["k"], "left")
+    got = got.sort_values("lv").reset_index(drop=True)
+    # SQL: null keys never match; rows 2,3,4 unmatched -> rv null
+    assert len(got) == 4
+    assert got["r_rv"][0] == 100
+    assert pd.isna(got["r_rv"][1]) and pd.isna(got["r_rv"][2]) and pd.isna(got["r_rv"][3])
+
+
+def test_semi_and_anti_join():
+    l = pa.table({"k": [1, 2, 3, 4, 5], "lv": [10, 20, 30, 40, 50]})
+    r = pa.table({"k": [2, 4, 4, 9]})
+    semi = _join(l, r, ["k"], ["k"], "semi")
+    assert sorted(semi["k"]) == [2, 4]
+    anti = _join(l, r, ["k"], ["k"], "anti")
+    assert sorted(anti["k"]) == [1, 3, 5]
+
+
+def test_mark_join():
+    l = pa.table({"k": [1, 2, 3]})
+    r = pa.table({"k": [2]})
+    got = _join(l, r, ["k"], ["k"], "mark")
+    assert list(got["__mark"]) == [False, True, False]
+
+
+def test_multi_key_join():
+    l = pa.table({"a": [1, 1, 2, 2], "b": ["x", "y", "x", "y"], "lv": [1, 2, 3, 4]})
+    r = pa.table({"a": [1, 2], "b": ["y", "x"], "rv": [100, 200]})
+    # string keys need a shared dictionary across tables
+    from datafusion_distributed_tpu.ops.table import Dictionary
+
+    d = Dictionary.from_strings(["x", "y"])
+    from datafusion_distributed_tpu.io.parquet import arrow_to_table
+
+    lt = arrow_to_table(l, dictionaries={"b": d})
+    rt = arrow_to_table(r, dictionaries={"b": d})
+
+    bs = build_join_table(rt, ["a", "b"], 16)
+    out, ovf = hash_join(lt, bs, ["a", "b"], "inner", 64, build_prefix="r_")
+    assert not bool(ovf)
+    got = out.to_pandas().sort_values("lv").reset_index(drop=True)
+    assert list(got["lv"]) == [2, 3]
+    assert list(got["r_rv"]) == [100, 200]
+
+
+def test_join_overflow_flag():
+    l = pa.table({"k": [1] * 20})
+    r = pa.table({"k": [1] * 20})
+    probe = arrow_to_table(l)
+    build = arrow_to_table(r)
+    bs = build_join_table(build, ["k"], 16)
+    out, overflow = hash_join(probe, bs, ["k"], "inner", 64)  # 400 pairs > 64
+    assert bool(overflow)
+
+
+def test_join_random_golden():
+    rng = np.random.default_rng(42)
+    l = pa.table({"k": rng.integers(0, 50, 300), "lv": np.arange(300)})
+    r = pa.table({"k": rng.integers(0, 50, 100), "rv": np.arange(100)})
+    got = _join(l, r, ["k"], ["k"], "inner", out_cap=2048, slots=128)
+    exp = l.to_pandas().merge(r.to_pandas(), on="k")
+    assert len(got) == len(exp)
+    assert sorted(zip(got["lv"], got["r_rv"])) == sorted(zip(exp["lv"], exp["rv"]))
