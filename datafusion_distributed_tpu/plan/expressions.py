@@ -593,12 +593,17 @@ class Substring(PhysicalExpr):
         if c.dtype != DataType.STRING or c.dictionary is None:
             raise ValueError("SUBSTRING requires a dictionary string column")
         vals = c.dictionary.values
-        s = self.start - 1
+        # SQL semantics: positions before 1 exist but hold nothing, so a
+        # start of 0 with FOR 2 yields just the first character.
+        begin = self.start - 1
         if self.length is None:
-            derived = np.asarray([v[s:] for v in vals], dtype=object)
+            b = max(begin, 0)
+            derived = np.asarray([v[b:] for v in vals], dtype=object)
         else:
+            end = begin + self.length
+            b = max(begin, 0)
             derived = np.asarray(
-                [v[s : s + self.length] for v in vals], dtype=object
+                [v[b:end] if end > b else "" for v in vals], dtype=object
             )
         uniq, inverse = np.unique(derived.astype(str), return_inverse=True)
         new_dict = Dictionary(uniq.astype(object))

@@ -104,6 +104,9 @@ class HashJoinExec(ExecutionPlan):
     def execute(self, ctx: ExecContext) -> Table:
         probe = self.probe.execute(ctx)
         build = self.build.execute(ctx)
+        probe, build = _unify_key_dictionaries(
+            probe, build, self.probe_keys, self.build_keys
+        )
         # shared validity-lane layout: union of both sides' nullability
         lane_plan = []
         for pk, bk in zip(self.probe_keys, self.build_keys):
@@ -183,6 +186,46 @@ class HashJoinExec(ExecutionPlan):
         )
 
 
+def _unify_key_dictionaries(probe: Table, build: Table, probe_keys, build_keys):
+    """String join keys are dictionary codes; codes from different
+    dictionaries are not comparable. Remap both sides onto a sorted union
+    dictionary (host-side LUT over static metadata + device gather), the
+    analogue of Arrow dictionary unification before a DataFusion hash join."""
+    from datafusion_distributed_tpu.ops.table import Dictionary
+    import numpy as np
+
+    for pk, bk in zip(probe_keys, build_keys):
+        pc = probe.column(pk)
+        bc = build.column(bk)
+        if pc.dictionary is None and bc.dictionary is None:
+            continue
+        if pc.dictionary == bc.dictionary:
+            continue
+        if pc.dictionary is None or bc.dictionary is None:
+            raise ValueError(
+                f"string join key {pk}/{bk} missing a dictionary"
+            )
+        union_vals = np.unique(
+            np.concatenate([pc.dictionary.values, bc.dictionary.values]).astype(str)
+        )
+        unified = Dictionary(union_vals.astype(object))
+
+        def remap(col, table, name):
+            old = col.dictionary.values.astype(str)
+            lut = np.searchsorted(union_vals, old).astype(np.int32)
+            lut_dev = jnp.asarray(lut) if len(lut) else jnp.zeros(1, jnp.int32)
+            codes = lut_dev[jnp.clip(col.data, 0, max(len(lut) - 1, 0))]
+            from datafusion_distributed_tpu.ops.table import Column
+
+            return table.with_column(
+                name, Column(codes, col.validity, col.dtype, unified)
+            )
+
+        probe = remap(pc, probe, pk)
+        build = remap(bc, build, bk)
+    return probe, build
+
+
 class CrossJoinExec(ExecutionPlan):
     """Cartesian product (TPC-H never needs one after predicate extraction,
     but DataFusion exposes CrossJoinExec so parity requires it)."""
@@ -215,8 +258,9 @@ class CrossJoinExec(ExecutionPlan):
         l = self.left.execute(ctx)
         r = self.right.execute(ctx)
         cap = self.out_capacity
-        total = (l.num_rows * r.num_rows).astype(jnp.int32)
-        ctx.record_overflow(self, total > cap)
+        total64 = l.num_rows.astype(jnp.int64) * r.num_rows.astype(jnp.int64)
+        ctx.record_overflow(self, total64 > cap)
+        total = jnp.minimum(total64, cap).astype(jnp.int32)
         j = jnp.arange(cap, dtype=jnp.int32)
         li = jnp.clip(j // jnp.maximum(r.num_rows, 1), 0, l.capacity - 1)
         ri = jnp.clip(j % jnp.maximum(r.num_rows, 1), 0, r.capacity - 1)

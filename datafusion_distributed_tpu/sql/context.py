@@ -121,8 +121,14 @@ class DataFrame:
 
     @staticmethod
     def _strip_quals(t: Table) -> Table:
-        names = tuple(n.split(".")[-1] if "." in n else n for n in t.names)
-        return Table(names, t.columns, t.num_rows)
+        names = []
+        seen = set()
+        for n in t.names:
+            short = n.split(".")[-1] if "." in n else n
+            # duplicate short names (SELECT c.x, o.x) keep their qualifier
+            names.append(n if short in seen else short)
+            seen.add(short)
+        return Table(tuple(names), t.columns, t.num_rows)
 
     def explain(self) -> str:
         return self.physical_plan().display_tree()

@@ -155,6 +155,7 @@ class LJoin(LogicalPlan):
     right_keys: list
     residual: Optional[pe.PhysicalExpr] = None  # evaluated on joined schema
     mark_name: Optional[str] = None
+    null_aware: bool = False  # NOT IN semantics for anti joins
 
     def schema(self):
         if self.how in ("semi", "anti"):
@@ -368,7 +369,7 @@ class Binder:
                 else:
                     e = self._bind_expr(o.expr, scope, None)
                 keys.append((e, o.ascending, o.nulls_first))
-            plan = LSort(keys, plan, fetch=q.limit)
+            plan = LSort(keys, plan, fetch=_sort_fetch(q))
         if q.limit is not None or q.offset is not None:
             plan = LLimit(plan, q.limit, q.offset or 0)
         return plan
@@ -719,7 +720,8 @@ class Binder:
         lkeys = [expr] + [pe.Col(outer) for outer, _ in corr_pairs]
         rkeys = [value_col] + [inner for _, inner in corr_pairs]
         how = "anti" if c.negated else "semi"
-        return LJoin(plan, sub_plan, how, lkeys, rkeys, residual=residual)
+        return LJoin(plan, sub_plan, how, lkeys, rkeys, residual=residual,
+                     null_aware=c.negated)
 
     def _bind_scalar_pred(self, c, plan, scope, outer_refs):
         """Comparison against a scalar subquery (correlated or not)."""
@@ -1064,7 +1066,7 @@ class Binder:
                     sort_keys.append((e, o.ascending, o.nulls_first))
             plan2: LogicalPlan = LProject(proj_exprs + hidden, result)
             if sort_keys:
-                plan2 = LSort(sort_keys, plan2, fetch=q.limit)
+                plan2 = LSort(sort_keys, plan2, fetch=_sort_fetch(q))
             if hidden:
                 plan2 = LProject(
                     [(pe.Col(n), n) for n in out_names], plan2
@@ -1108,7 +1110,7 @@ class Binder:
         for o in q.order_by:
             e = bind_fn(o.expr)
             keys.append((e, o.ascending, o.nulls_first))
-        return LSort(keys, plan, fetch=q.limit)
+        return LSort(keys, plan, fetch=_sort_fetch(q))
 
     def _bind_order_expr_plain(self, e, scope, outer_refs, out_exprs,
                                select_aliases):
@@ -1251,6 +1253,11 @@ class Binder:
                 return folded if isinstance(folded, pe.PhysicalExpr) else (
                     self._bind_expr(folded, scope, outer_refs)
                 )
+            # exact decimal folding of literal arithmetic: SQL decimals make
+            # `.06 - 0.01` exactly 0.05; float64 would give 0.049999...
+            dec = _fold_decimal_arith(e)
+            if dec is not None:
+                return dec
             return pe.BinaryOp(
                 e.op,
                 self._bind_expr(e.left, scope, outer_refs),
@@ -1465,6 +1472,13 @@ def _common_or_conjuncts(node: ast.Binary) -> list:
     return [by_fp[fp] for fp in sorted(common)]
 
 
+def _sort_fetch(q) -> "int | None":
+    """Top-k bound for a sort feeding LIMIT/OFFSET: limit+offset rows."""
+    if q.limit is None:
+        return None
+    return q.limit + (q.offset or 0)
+
+
 def _split_conjuncts(node) -> list:
     if isinstance(node, ast.Binary) and node.op == "and":
         return _split_conjuncts(node.left) + _split_conjuncts(node.right)
@@ -1568,6 +1582,51 @@ def _fold_date_arith(e: ast.Binary):
         days = _shift_date(r.days, l.months, l.days)
         return pe.Literal(days, DataType.DATE32)
     return None
+
+
+def _as_decimal(node):
+    """NumberLit (or +/-/*// tree of them) -> decimal.Decimal, else None."""
+    import decimal
+
+    if isinstance(node, ast.NumberLit):
+        if node.raw is not None:
+            return decimal.Decimal(node.raw)
+        if isinstance(node.value, int):
+            return decimal.Decimal(node.value)
+        return None
+    if isinstance(node, ast.Unary) and node.op == "-":
+        d = _as_decimal(node.child)
+        return -d if d is not None else None
+    if isinstance(node, ast.Binary) and node.op in ("+", "-", "*", "/"):
+        l = _as_decimal(node.left)
+        r = _as_decimal(node.right)
+        if l is None or r is None:
+            return None
+        if node.op == "+":
+            return l + r
+        if node.op == "-":
+            return l - r
+        if node.op == "*":
+            return l * r
+        if r == 0:
+            return None
+        return l / r
+
+
+def _fold_decimal_arith(e: ast.Binary):
+    if e.op not in ("+", "-", "*", "/"):
+        return None
+    if not (
+        isinstance(e.left, (ast.NumberLit, ast.Binary, ast.Unary))
+        and isinstance(e.right, (ast.NumberLit, ast.Binary, ast.Unary))
+    ):
+        return None
+    d = _as_decimal(e)
+    if d is None:
+        return None
+    if d == d.to_integral_value() and "." not in str(d):
+        return pe.Literal(int(d), DataType.INT64)
+    return pe.Literal(float(d), DataType.FLOAT64)
 
 
 def _shift_date(epoch_days: int, months: int, days: int) -> int:

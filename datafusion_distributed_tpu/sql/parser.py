@@ -34,6 +34,7 @@ class Ident:
 @dataclass
 class NumberLit:
     value: Any  # int or float
+    raw: Optional[str] = None  # original text, for exact decimal folding
 
 
 @dataclass
@@ -404,20 +405,14 @@ class Parser:
                 ctes.append((name, sub))
                 if not self.eat_sym(","):
                     break
-        q = self._select()
-        q.ctes = ctes
-        # set operations
-        while self.at_kw("union", "intersect", "except"):
+        q = self._intersect_chain(ctes)
+        # UNION/EXCEPT bind looser than INTERSECT (SQL standard precedence)
+        while self.at_kw("union", "except"):
             op = self.next().value
             all_ = self.eat_kw("all")
-            rhs = self._select()
+            rhs = self._intersect_chain(ctes)
             q = SetOp(op, all_, q, rhs, ctes=ctes)
-            # a trailing ORDER BY/LIMIT parsed into the last arm belongs to
-            # the whole set-op chain (arms can't carry them without parens)
-            if rhs.order_by or rhs.limit is not None or rhs.offset is not None:
-                q.order_by, rhs.order_by = rhs.order_by, []
-                q.limit, rhs.limit = rhs.limit, None
-                q.offset, rhs.offset = rhs.offset, None
+            q = self._hoist_trailing_clauses(q, rhs)
         # ORDER BY / LIMIT can follow a set op chain
         if self.at_kw("order"):
             q.order_by = self._order_by()
@@ -425,6 +420,28 @@ class Parser:
             q.limit = self._int_literal()
         if self.eat_kw("offset"):
             q.offset = self._int_literal()
+        if isinstance(q, Query):
+            q.ctes = ctes
+        return q
+
+    def _intersect_chain(self, ctes):
+        q = self._select()
+        while self.at_kw("intersect"):
+            self.next()
+            all_ = self.eat_kw("all")
+            rhs = self._select()
+            q = SetOp("intersect", all_, q, rhs, ctes=ctes)
+            q = self._hoist_trailing_clauses(q, rhs)
+        return q
+
+    @staticmethod
+    def _hoist_trailing_clauses(q: "SetOp", rhs: "Query") -> "SetOp":
+        # a trailing ORDER BY/LIMIT parsed into the last arm belongs to the
+        # whole set-op chain (arms can't carry them without parens)
+        if rhs.order_by or rhs.limit is not None or rhs.offset is not None:
+            q.order_by, rhs.order_by = rhs.order_by, []
+            q.limit, rhs.limit = rhs.limit, None
+            q.offset, rhs.offset = rhs.offset, None
         return q
 
     def _select(self) -> Query:
@@ -693,7 +710,7 @@ class Parser:
         if t.kind == "number":
             self.next()
             v = float(t.value) if ("." in t.value or "e" in t.value.lower()) else int(t.value)
-            return NumberLit(v)
+            return NumberLit(v, raw=t.value)
         if t.kind == "string":
             self.next()
             return StringLit(t.value)

@@ -197,6 +197,7 @@ class PhysicalPlanner:
             residual=node.residual,
             mark_name=node.mark_name or "__mark",
             expansion_factor=self.config.join_expansion_factor,
+            null_aware=node.null_aware,
         )
         # strip materialized key columns from inner/left outputs
         if node.how in ("inner", "left"):
@@ -318,6 +319,10 @@ def _exec_scalar(physical: ExecutionPlan):
     result = execute_plan(physical)
     col = result.columns[0]
     n = int(result.num_rows)
+    if n > 1:
+        raise RuntimeError(
+            f"scalar subquery returned {n} rows (expected at most one)"
+        )
     if n == 0:
         return None, col.dtype
     if col.validity is not None and not bool(col.validity[0]):
