@@ -17,11 +17,31 @@ Layering (mirrors SURVEY.md §1, re-expressed TPU-first):
 - models/    benchmark workloads (TPC-H, ClickBench) and data generators
 """
 
+import os as _os
+
 import jax as _jax
 
 # A query engine needs real 64-bit integers (join keys at SF>=100 exceed
 # int32) and float64 accumulation for result parity with the CPU reference.
 _jax.config.update("jax_enable_x64", True)
+
+# Honor JAX_PLATFORMS when a platform plugin force-selected itself at
+# registration time (the environment's TPU-tunnel plugin sets
+# jax_platforms="axon,cpu", shadowing the env var). Only correct the
+# plugin's forced value — never clobber a platform the embedding program
+# already chose explicitly via jax.config.update (e.g. tests pinning cpu).
+_env_platforms = _os.environ.get("JAX_PLATFORMS")
+if _env_platforms:
+    try:
+        _current = _jax.config.jax_platforms
+    except AttributeError:  # pragma: no cover - config name change guard
+        _current = None
+    if (
+        _current is not None
+        and _current != _env_platforms
+        and "axon" in str(_current)
+    ):
+        _jax.config.update("jax_platforms", _env_platforms)
 
 from datafusion_distributed_tpu.schema import DataType, Field, Schema  # noqa: E402
 from datafusion_distributed_tpu.ops.table import (  # noqa: E402

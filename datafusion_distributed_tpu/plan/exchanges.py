@@ -1,0 +1,160 @@
+"""Exchange operators: the stage-boundary nodes of the distributed plan.
+
+These are the TPU-native counterparts of the reference's three
+`NetworkBoundary` implementations (`/root/reference/src/execution_plans/`):
+
+    ShuffleExchangeExec   <- NetworkShuffleExec   (hash N:M re-shard)
+    CoalesceExchangeExec  <- NetworkCoalesceExec  (N -> 1 concat)
+    BroadcastExchangeExec <- NetworkBroadcastExec (replicate to all)
+
+A boundary splits the plan into stages (producer below, consumer above).
+Under the mesh executor the whole staged tree traces into one SPMD program —
+`execute` simply emits the collective. The boundary duality of the reference
+(Pending/Ready; `network_shuffle.rs` Stage::Local vs Stage::Remote) shows up
+here as: the same node can run in-mesh (collective) or across meshes via the
+host runtime (runtime/), which materializes producer output and re-feeds
+consumers — that path is the DCN/multi-host fallback.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from datafusion_distributed_tpu.ops.table import Table, round_up_pow2
+from datafusion_distributed_tpu.parallel.exchange import (
+    broadcast_exchange,
+    coalesce_exchange,
+    shuffle_exchange,
+)
+from datafusion_distributed_tpu.plan.physical import ExecContext, ExecutionPlan
+
+
+class ExchangeExec(ExecutionPlan):
+    """Common base: a stage boundary with a producer child."""
+
+    is_exchange = True
+
+    def __init__(self, child: ExecutionPlan, num_tasks: int):
+        super().__init__()
+        self.child = child
+        self.num_tasks = num_tasks
+        # stamped by the prepare pass (stage ids mirror the reference's
+        # (query_id, stage_num) TaskKey addressing)
+        self.stage_id: Optional[int] = None
+
+    def children(self):
+        return [self.child]
+
+    def schema(self):
+        return self.child.schema()
+
+    def _require_axis(self, ctx: ExecContext) -> str:
+        axis = ctx.config.get("mesh_axis")
+        if axis is None:
+            raise RuntimeError(
+                f"{type(self).__name__} executed outside a mesh; use the "
+                "distributed executor (runtime/) or a shard_map context"
+            )
+        return axis
+
+
+class ShuffleExchangeExec(ExchangeExec):
+    """Hash shuffle: rows re-shard across tasks by key hash."""
+
+    def __init__(
+        self,
+        child: ExecutionPlan,
+        key_names: Sequence[str],
+        num_tasks: int,
+        per_dest_capacity: int,
+    ):
+        super().__init__(child, num_tasks)
+        self.key_names = list(key_names)
+        # sizing policy lives in planner/distributed.py _mk_shuffle (driven
+        # by DistributedConfig.shuffle_skew_factor and the overflow retry)
+        self.per_dest_capacity = per_dest_capacity
+
+    def with_new_children(self, children):
+        return ShuffleExchangeExec(
+            children[0], self.key_names, self.num_tasks, self.per_dest_capacity
+        )
+
+    def output_capacity(self):
+        return self.num_tasks * self.per_dest_capacity
+
+    def execute(self, ctx: ExecContext) -> Table:
+        t = self.child.execute(ctx)
+        out, overflow = shuffle_exchange(
+            t, self.key_names, self._require_axis(ctx), self.num_tasks,
+            self.per_dest_capacity,
+        )
+        ctx.record_overflow(self, overflow)
+        return out
+
+    def display(self):
+        return (
+            f"ShuffleExchange keys=[{', '.join(self.key_names)}] "
+            f"tasks={self.num_tasks} per_dest_cap={self.per_dest_capacity}"
+        )
+
+
+class PartitionReplicatedExec(ExchangeExec):
+    """REPLICATED -> PARTITIONED: every task keeps the row-index slice
+    ``row % num_tasks == task`` of its (identical) copy. No communication —
+    the inverse of a broadcast, used when a replicated subtree feeds a
+    partition-wise consumer (e.g. a UNION arm)."""
+
+    def with_new_children(self, children):
+        return PartitionReplicatedExec(children[0], self.num_tasks)
+
+    def output_capacity(self):
+        return self.child.output_capacity()
+
+    def execute(self, ctx: ExecContext) -> Table:
+        import jax
+
+        t = self.child.execute(ctx)
+        axis = self._require_axis(ctx)
+        me = jax.lax.axis_index(axis)
+        idx = jnp.arange(t.capacity, dtype=jnp.int32)
+        keep = t.row_mask() & ((idx % self.num_tasks) == me)
+        return t.compact(keep)
+
+    def display(self):
+        return f"PartitionReplicated tasks={self.num_tasks}"
+
+
+class CoalesceExchangeExec(ExchangeExec):
+    """All tasks' rows gathered into one logical table (replicated)."""
+
+    def with_new_children(self, children):
+        return CoalesceExchangeExec(children[0], self.num_tasks)
+
+    def output_capacity(self):
+        return self.child.output_capacity() * self.num_tasks
+
+    def execute(self, ctx: ExecContext) -> Table:
+        t = self.child.execute(ctx)
+        return coalesce_exchange(t, self._require_axis(ctx), self.num_tasks)
+
+    def display(self):
+        return f"CoalesceExchange tasks={self.num_tasks}"
+
+
+class BroadcastExchangeExec(ExchangeExec):
+    """Replicate rows to every task (broadcast-join build sides)."""
+
+    def with_new_children(self, children):
+        return BroadcastExchangeExec(children[0], self.num_tasks)
+
+    def output_capacity(self):
+        return self.child.output_capacity() * self.num_tasks
+
+    def execute(self, ctx: ExecContext) -> Table:
+        t = self.child.execute(ctx)
+        return broadcast_exchange(t, self._require_axis(ctx), self.num_tasks)
+
+    def display(self):
+        return f"BroadcastExchange tasks={self.num_tasks}"

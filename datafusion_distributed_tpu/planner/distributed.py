@@ -1,0 +1,348 @@
+"""Distributed planner: single-node physical plan -> staged SPMD plan.
+
+The reference's `DistributedQueryPlanner` pipeline (SURVEY.md §2.1,
+`/root/reference/src/distributed_planner/distributed_query_planner.rs`):
+shape -> insert broadcasts -> inject network boundaries (task-count lattice)
+-> prepare (elide 1:1, stamp stage ids). This module is the TPU re-design of
+those passes over our ExecutionPlan IR:
+
+- `inject_boundaries` walks bottom-up tracking each subtree's *distribution*
+  (PARTITIONED across tasks vs REPLICATED on all), rewriting:
+    aggregate  -> partial agg | shuffle(keys) | final agg
+                  (global agg -> partial | coalesce | final)
+    hash join  -> shuffle both sides on the join keys, or broadcast the
+                  build side when it is small (`insert_broadcast.rs`
+                  CollectLeft analogue; `broadcast_threshold` config)
+    sort/limit -> local sort/top-k | coalesce | final sort/limit
+                  (the push_fetch_into_network_coalesce fetch pushdown)
+- leaf scale-up splits scans into per-task slices
+  (`task_estimator.rs` scale_up_leaf_node / DistributedLeafExec analogue)
+- `prepare` elides boundaries whose producer and consumer distributions
+  already agree and stamps stage ids (`prepare_network_boundaries.rs`).
+
+Task counts: stages run at the mesh size. The Desired/Maximum annotation
+lattice of the reference drives *task routing* when meshes are larger than
+useful parallelism; carried in TaskCountAnnotation for parity.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Optional
+
+from datafusion_distributed_tpu.ops.aggregate import AggSpec
+from datafusion_distributed_tpu.ops.table import round_up_pow2
+from datafusion_distributed_tpu.parallel.exchange import partition_table
+from datafusion_distributed_tpu.plan.exchanges import (
+    BroadcastExchangeExec,
+    CoalesceExchangeExec,
+    ShuffleExchangeExec,
+)
+from datafusion_distributed_tpu.plan.joins import (
+    CrossJoinExec,
+    HashJoinExec,
+    UnionExec,
+)
+from datafusion_distributed_tpu.plan.physical import (
+    CoalescePartitionsExec,
+    ExecutionPlan,
+    FilterExec,
+    HashAggregateExec,
+    LimitExec,
+    MemoryScanExec,
+    ParquetScanExec,
+    ProjectionExec,
+    SortExec,
+)
+
+
+class Distribution(enum.Enum):
+    PARTITIONED = "partitioned"  # each task owns a disjoint row slice
+    REPLICATED = "replicated"  # every task holds the full data
+
+
+@dataclass(frozen=True)
+class TaskCountAnnotation:
+    """Desired/Maximum lattice (reference `task_estimator.rs:20-59`):
+    merge(Desired a, Desired b) = Desired max(a,b); Maximum dominates
+    Desired; merge(Maximum a, Maximum b) = Maximum min(a,b)."""
+
+    count: int
+    maximum: bool = False
+
+    def merge(self, other: "TaskCountAnnotation") -> "TaskCountAnnotation":
+        if self.maximum and other.maximum:
+            return TaskCountAnnotation(min(self.count, other.count), True)
+        if self.maximum:
+            return self  # Maximum dominates: the desired count is discarded
+        if other.maximum:
+            return other
+        return TaskCountAnnotation(max(self.count, other.count), False)
+
+
+@dataclass
+class DistributedConfig:
+    """Knobs (subset-parity with `distributed_config.rs`)."""
+
+    num_tasks: int = 8
+    broadcast_joins: bool = True
+    broadcast_threshold_rows: int = 1 << 17  # build sides smaller: broadcast
+    shuffle_skew_factor: int = 4
+    max_tasks_per_stage: int = 0  # 0 = num_tasks
+
+
+def distribute_plan(
+    plan: ExecutionPlan, config: DistributedConfig
+) -> ExecutionPlan:
+    """Rewrite a single-node plan into a staged distributed plan whose root
+    output is replicated (safe to read from any task)."""
+    out, dist = _inject(plan, config)
+    if dist == Distribution.PARTITIONED:
+        out = CoalesceExchangeExec(out, config.num_tasks)
+    out = _prepare(out)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# boundary injection
+# ---------------------------------------------------------------------------
+
+
+def _inject(plan: ExecutionPlan, cfg: DistributedConfig):
+    t = cfg.num_tasks
+
+    # -- leaves: scale up into per-task slices -----------------------------
+    if isinstance(plan, MemoryScanExec):
+        if len(plan.tasks) == 1 and t > 1:
+            slices = partition_table(plan.tasks[0], t)
+            return MemoryScanExec(slices, plan.schema()), Distribution.PARTITIONED
+        return plan, (
+            Distribution.PARTITIONED if len(plan.tasks) > 1
+            else Distribution.REPLICATED
+        )
+    if isinstance(plan, ParquetScanExec):
+        if len(plan.file_groups) == 1 and t > 1:
+            files = list(plan.file_groups[0])
+            groups = [files[i::t] for i in range(t)]
+            # per-task capacity: whole-file granularity keeps it conservative
+            per_task_cap = round_up_pow2(
+                max(plan.capacity * (len(files) // t + 1) // max(len(files), 1), 8)
+            )
+            return (
+                ParquetScanExec(
+                    groups, plan._schema, per_task_cap, plan.projection,
+                    plan.dictionaries,
+                ),
+                Distribution.PARTITIONED,
+            )
+        return plan, Distribution.PARTITIONED
+
+    # -- elementwise: keep child distribution ------------------------------
+    if isinstance(plan, (FilterExec, ProjectionExec, CoalescePartitionsExec)):
+        child, dist = _inject(plan.children()[0], cfg)
+        return plan.with_new_children([child]), dist
+
+    if isinstance(plan, HashAggregateExec):
+        return _inject_aggregate(plan, cfg)
+
+    if isinstance(plan, HashJoinExec):
+        return _inject_join(plan, cfg)
+
+    if isinstance(plan, CrossJoinExec):
+        left, ldist = _inject(plan.left, cfg)
+        right, rdist = _inject(plan.right, cfg)
+        if rdist == Distribution.PARTITIONED:
+            right = BroadcastExchangeExec(right, t)
+        return plan.with_new_children([left, right]), ldist
+
+    if isinstance(plan, SortExec):
+        child, dist = _inject(plan.child, cfg)
+        if dist == Distribution.REPLICATED:
+            return plan.with_new_children([child]), dist
+        # local (top-k) sort -> coalesce -> final sort; fetch pushdown is the
+        # push_fetch_into_network_coalesce analogue
+        local = SortExec(plan.keys, child, fetch=plan.fetch)
+        gathered = CoalesceExchangeExec(local, t)
+        final = SortExec(plan.keys, gathered, fetch=plan.fetch)
+        return final, Distribution.REPLICATED
+
+    if isinstance(plan, LimitExec):
+        child, dist = _inject(plan.child, cfg)
+        if dist == Distribution.REPLICATED:
+            return plan.with_new_children([child]), dist
+        # local limit bounds rows crossing the exchange (fetch+skip of them)
+        local = LimitExec(child, plan.fetch + plan.skip, 0)
+        gathered = CoalesceExchangeExec(local, t)
+        return LimitExec(gathered, plan.fetch, plan.skip), Distribution.REPLICATED
+
+    if isinstance(plan, UnionExec):
+        from datafusion_distributed_tpu.plan.exchanges import (
+            PartitionReplicatedExec,
+        )
+
+        children = []
+        for c in plan.children():
+            cc, cdist = _inject(c, cfg)
+            if cdist == Distribution.REPLICATED:
+                # a replicated arm unioned as-is would contribute its rows
+                # from every task (T duplicates after the root coalesce);
+                # re-partition it by row index first
+                cc = PartitionReplicatedExec(cc, t)
+            children.append(cc)
+        return UnionExec(children), Distribution.PARTITIONED
+
+    if not plan.children():
+        return plan, Distribution.REPLICATED
+
+    # default: single child passthrough
+    children = []
+    dist = Distribution.REPLICATED
+    for c in plan.children():
+        cc, cdist = _inject(c, cfg)
+        children.append(cc)
+        if cdist == Distribution.PARTITIONED:
+            dist = Distribution.PARTITIONED
+    return plan.with_new_children(children), dist
+
+
+def _inject_aggregate(plan: HashAggregateExec, cfg: DistributedConfig):
+    t = cfg.num_tasks
+    child, dist = _inject(plan.child, cfg)
+    if dist == Distribution.REPLICATED:
+        return plan.with_new_children([child]), dist
+    if plan.mode != "single":
+        # already split by a previous pass
+        return plan.with_new_children([child]), dist
+
+    if not plan.group_names:
+        partial = HashAggregateExec(
+            "partial", [], plan.aggs, child, plan.num_slots
+        )
+        gathered = CoalesceExchangeExec(partial, t)
+        final = HashAggregateExec(
+            "final", [], plan.aggs, gathered, plan.num_slots
+        )
+        return final, Distribution.REPLICATED
+
+    partial = HashAggregateExec(
+        "partial", plan.group_names, plan.aggs, child, plan.num_slots
+    )
+    shuffle = _mk_shuffle(partial, plan.group_names, cfg)
+    final = HashAggregateExec(
+        "final", plan.group_names, plan.aggs, shuffle,
+        min(plan.num_slots, round_up_pow2(max(shuffle.output_capacity(), 16))),
+    )
+    return final, Distribution.PARTITIONED
+
+
+def _mk_shuffle(child, keys, cfg: DistributedConfig) -> ShuffleExchangeExec:
+    t = cfg.num_tasks
+    per_dest = round_up_pow2(
+        max(cfg.shuffle_skew_factor * child.output_capacity() // max(t, 1), 8)
+    )
+    return ShuffleExchangeExec(child, keys, t, per_dest)
+
+
+def _inject_join(plan: HashJoinExec, cfg: DistributedConfig):
+    """Join distribution rules. Correctness constraints:
+
+    - preserved-side join types (left/semi/anti/mark) need every build row
+      that could match a probe row visible on that probe row's task: either
+      broadcast the build, or co-shuffle BOTH sides on the join keys.
+    - a REPLICATED input must never be shuffled (every task would inject its
+      full copy -> T-fold duplication); replicated probe forces a
+      replicated/broadcast build.
+    - null-aware anti (NOT IN) needs the global "any NULL build key" fact, so
+      the build is always broadcast.
+    """
+    t = cfg.num_tasks
+    probe, pdist = _inject(plan.probe, cfg)
+    build, bdist = _inject(plan.build, cfg)
+    preserved = plan.join_type in ("left", "semi", "anti", "mark")
+
+    if bdist == Distribution.REPLICATED and pdist == Distribution.REPLICATED:
+        return plan.with_new_children([probe, build]), Distribution.REPLICATED
+
+    if bdist == Distribution.REPLICATED:
+        # build already everywhere; partitioned probe joins locally
+        return plan.with_new_children([probe, build]), pdist
+
+    small_build = (
+        cfg.broadcast_joins
+        and build.output_capacity() <= cfg.broadcast_threshold_rows
+    )
+    must_broadcast = (
+        plan.null_aware
+        or pdist == Distribution.REPLICATED
+    )
+    if must_broadcast or small_build:
+        b = BroadcastExchangeExec(build, t)
+        out = plan.with_new_children([probe, b])
+        return out, pdist
+
+    if preserved:
+        # co-shuffle both sides on the join keys (probe is PARTITIONED here)
+        p = _mk_shuffle(probe, plan.probe_keys, cfg)
+        b = _mk_shuffle(build, plan.build_keys, cfg)
+        return plan.with_new_children([p, b]), Distribution.PARTITIONED
+
+    # inner join, partitioned probe: co-shuffle both sides
+    p = _mk_shuffle(probe, plan.probe_keys, cfg)
+    b = _mk_shuffle(build, plan.build_keys, cfg)
+    out = plan.with_new_children([p, b])
+    return out, Distribution.PARTITIONED
+
+
+# ---------------------------------------------------------------------------
+# prepare: elide no-op boundaries, stamp stage ids
+# ---------------------------------------------------------------------------
+
+
+def _prepare(plan: ExecutionPlan) -> ExecutionPlan:
+    """Stamp stage ids bottom-up (the (query_id, stage_num) of the
+    reference's TaskKey) and elide degenerate 1-task exchanges."""
+    counter = [0]
+
+    def walk(node: ExecutionPlan) -> ExecutionPlan:
+        children = [walk(c) for c in node.children()]
+        node = node.with_new_children(children) if children else node
+        if getattr(node, "is_exchange", False):
+            if node.num_tasks <= 1:
+                return node.children()[0]  # 1:1 boundary elision
+            node.stage_id = counter[0]
+            counter[0] += 1
+        return node
+
+    return walk(plan)
+
+
+def collect_stages(plan: ExecutionPlan) -> list:
+    """[(stage_id, exchange node)] in bottom-up order, for display/metrics."""
+    out = []
+
+    def walk(node):
+        for c in node.children():
+            walk(c)
+        if getattr(node, "is_exchange", False):
+            out.append((node.stage_id, node))
+
+    walk(plan)
+    return out
+
+
+def display_staged_plan(plan: ExecutionPlan) -> str:
+    """ASCII stage-tree display (the reference's display_plan_ascii stage
+    boxes, `stage.rs:266-355`)."""
+    lines = []
+
+    def walk(node, indent):
+        marker = ""
+        if getattr(node, "is_exchange", False):
+            marker = f" ── stage {node.stage_id} boundary"
+        lines.append("  " * indent + node.display() + marker)
+        for c in node.children():
+            walk(c, indent + 1)
+
+    walk(plan, 0)
+    return "\n".join(lines)

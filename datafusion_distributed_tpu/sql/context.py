@@ -81,12 +81,21 @@ class DataFrame:
     def __init__(self, ctx: "SessionContext", logical: LogicalPlan):
         self.ctx = ctx
         self.logical = logical
-        self._physical: Optional[ExecutionPlan] = None
+        # plan memoization: repeated collect() of the same DataFrame reuses
+        # the plan object, which keys the executors' compile caches
+        self._plan_cache: dict = {}
 
-    def physical_plan(self, config: Optional[PlannerConfig] = None) -> ExecutionPlan:
+    def physical_plan(self, config: Optional[PlannerConfig] = None,
+                      subquery_executor=None) -> ExecutionPlan:
         cfg = config or self.ctx.config.planner
-        planner = PhysicalPlanner(self.ctx.catalog, cfg)
-        return planner.plan(self.logical)
+        key = ("single", cfg.join_expansion_factor, cfg.agg_slot_factor,
+               subquery_executor is not None)
+        plan = self._plan_cache.get(key)
+        if plan is None:
+            planner = PhysicalPlanner(self.ctx.catalog, cfg, subquery_executor)
+            plan = planner.plan(self.logical)
+            self._plan_cache[key] = plan
+        return plan
 
     def collect_table(self) -> Table:
         """Execute, with automatic re-plan on hash/join capacity overflow —
@@ -130,8 +139,89 @@ class DataFrame:
             seen.add(short)
         return Table(tuple(names), t.columns, t.num_rows)
 
+    # -- distributed execution -------------------------------------------------
+    def distributed_plan(self, num_tasks: int = 8, config=None,
+                         planner_config: Optional[PlannerConfig] = None,
+                         mesh=None):
+        from datafusion_distributed_tpu.planner.distributed import (
+            DistributedConfig,
+            distribute_plan,
+        )
+
+        cfg = config or DistributedConfig(num_tasks=num_tasks)
+        pcfg = planner_config or self.ctx.config.planner
+        key = ("dist", cfg.num_tasks, cfg.shuffle_skew_factor,
+               cfg.broadcast_threshold_rows, pcfg.join_expansion_factor,
+               pcfg.agg_slot_factor, mesh is not None)
+        plan = self._plan_cache.get(key)
+        if plan is not None:
+            return plan
+        subquery_executor = None
+        if mesh is not None:
+            from datafusion_distributed_tpu.runtime.mesh_executor import (
+                execute_on_mesh,
+            )
+
+            def subquery_executor(p):
+                return execute_on_mesh(distribute_plan(p, cfg), mesh)
+
+        planner = PhysicalPlanner(self.ctx.catalog, pcfg, subquery_executor)
+        plan = distribute_plan(planner.plan(self.logical), cfg)
+        self._plan_cache[key] = plan
+        return plan
+
+    def collect_distributed_table(self, num_tasks: Optional[int] = None,
+                                  mesh=None) -> Table:
+        """Execute over a jax Mesh: the whole staged plan compiles into one
+        SPMD program (see runtime/mesh_executor.py). Overflow -> re-plan with
+        widened capacities, like collect_table."""
+        import jax as _jax
+
+        from datafusion_distributed_tpu.planner.distributed import DistributedConfig
+        from datafusion_distributed_tpu.runtime.mesh_executor import (
+            execute_on_mesh,
+            make_mesh,
+        )
+
+        if mesh is None:
+            mesh = make_mesh(num_tasks or len(_jax.devices()))
+        t = mesh.shape["tasks"]
+        pcfg = self.ctx.config.planner
+        dcfg = DistributedConfig(num_tasks=t)
+        last_err: Optional[Exception] = None
+        for _attempt in range(self.ctx.config.overflow_retries + 1):
+            try:
+                plan = self.distributed_plan(t, dcfg, pcfg, mesh=mesh)
+                return execute_on_mesh(plan, mesh)
+            except RuntimeError as e:
+                if "overflow" not in str(e):
+                    raise
+                last_err = e
+                pcfg = replace(
+                    pcfg,
+                    join_expansion_factor=pcfg.join_expansion_factor * 4,
+                    agg_slot_factor=pcfg.agg_slot_factor * 4,
+                )
+                dcfg = DistributedConfig(
+                    num_tasks=t,
+                    shuffle_skew_factor=dcfg.shuffle_skew_factor * 4,
+                )
+        raise last_err  # type: ignore[misc]
+
+    def collect_distributed(self, num_tasks: Optional[int] = None, mesh=None):
+        return table_to_arrow(
+            self._strip_quals(self.collect_distributed_table(num_tasks, mesh))
+        )
+
     def explain(self) -> str:
         return self.physical_plan().display_tree()
+
+    def explain_distributed(self, num_tasks: int = 8) -> str:
+        from datafusion_distributed_tpu.planner.distributed import (
+            display_staged_plan,
+        )
+
+        return display_staged_plan(self.distributed_plan(num_tasks))
 
     def logical_display(self) -> str:
         return self.logical.display_tree()

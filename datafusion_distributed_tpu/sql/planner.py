@@ -50,9 +50,15 @@ class PlannerConfig:
 
 
 class PhysicalPlanner:
-    def __init__(self, catalog, config: Optional[PlannerConfig] = None):
+    def __init__(self, catalog, config: Optional[PlannerConfig] = None,
+                 subquery_executor=None):
         self.catalog = catalog
         self.config = config or PlannerConfig()
+        # Scalar subqueries must run under the SAME execution mode as the
+        # main query: float aggregation order differs between single-node and
+        # distributed plans, and TPC-H q15's `total_revenue = (select max..)`
+        # equality only holds when both sides sum in the same order.
+        self.subquery_executor = subquery_executor
 
     # -- public ---------------------------------------------------------------
     def plan(self, logical: lg.LogicalPlan) -> ExecutionPlan:
@@ -255,12 +261,14 @@ class PhysicalPlanner:
         # no memoization guard: a replan after an overflow must re-plan the
         # subquery with the widened config too
         if isinstance(expr, lg.ScalarSubqueryExpr):
-            sub_planner = PhysicalPlanner(self.catalog, self.config)
+            sub_planner = PhysicalPlanner(
+                self.catalog, self.config, self.subquery_executor
+            )
             expr.physical = sub_planner.plan(expr.logical)
             # Execute NOW, at planning time — this must happen outside any
             # enclosing jit trace (a nested jit during tracing would inline
             # symbolically and break host-side overflow checks).
-            value, dtype = _exec_scalar(expr.physical)
+            value, dtype = _exec_scalar(expr.physical, self.subquery_executor)
             expr.evaluate = _make_scalar_eval(value, dtype)  # type: ignore[method-assign]
         for c in expr.children():
             self._resolve_subqueries(c)
@@ -312,11 +320,11 @@ def _collect_used_columns(plan: lg.LogicalPlan) -> set:
     return used
 
 
-def _exec_scalar(physical: ExecutionPlan):
+def _exec_scalar(physical: ExecutionPlan, executor=None):
     """Run a scalar subquery plan to completion; -> (python scalar|None, dtype)."""
     from datafusion_distributed_tpu.plan.physical import execute_plan
 
-    result = execute_plan(physical)
+    result = executor(physical) if executor is not None else execute_plan(physical)
     col = result.columns[0]
     n = int(result.num_rows)
     if n > 1:

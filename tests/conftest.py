@@ -9,7 +9,9 @@ the same `jax.sharding.Mesh` + collective code paths as a real TPU pod slice.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# hard override: the harness may export JAX_PLATFORMS=axon (TPU tunnel);
+# tests always run on the virtual CPU mesh
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
