@@ -77,14 +77,16 @@ class ShuffleExchangeExec(ExchangeExec):
         self.per_dest_capacity = per_dest_capacity
 
     def with_new_children(self, children):
-        return ShuffleExchangeExec(
+        n = ShuffleExchangeExec(
             children[0], self.key_names, self.num_tasks, self.per_dest_capacity
         )
+        n.stage_id = self.stage_id
+        return n
 
     def output_capacity(self):
         return self.num_tasks * self.per_dest_capacity
 
-    def execute(self, ctx: ExecContext) -> Table:
+    def _execute(self, ctx: ExecContext) -> Table:
         t = self.child.execute(ctx)
         out, overflow = shuffle_exchange(
             t, self.key_names, self._require_axis(ctx), self.num_tasks,
@@ -107,12 +109,14 @@ class PartitionReplicatedExec(ExchangeExec):
     partition-wise consumer (e.g. a UNION arm)."""
 
     def with_new_children(self, children):
-        return PartitionReplicatedExec(children[0], self.num_tasks)
+        n = PartitionReplicatedExec(children[0], self.num_tasks)
+        n.stage_id = self.stage_id
+        return n
 
     def output_capacity(self):
         return self.child.output_capacity()
 
-    def execute(self, ctx: ExecContext) -> Table:
+    def _execute(self, ctx: ExecContext) -> Table:
         import jax
 
         t = self.child.execute(ctx)
@@ -130,12 +134,14 @@ class CoalesceExchangeExec(ExchangeExec):
     """All tasks' rows gathered into one logical table (replicated)."""
 
     def with_new_children(self, children):
-        return CoalesceExchangeExec(children[0], self.num_tasks)
+        n = CoalesceExchangeExec(children[0], self.num_tasks)
+        n.stage_id = self.stage_id
+        return n
 
     def output_capacity(self):
         return self.child.output_capacity() * self.num_tasks
 
-    def execute(self, ctx: ExecContext) -> Table:
+    def _execute(self, ctx: ExecContext) -> Table:
         t = self.child.execute(ctx)
         return coalesce_exchange(t, self._require_axis(ctx), self.num_tasks)
 
@@ -147,12 +153,14 @@ class BroadcastExchangeExec(ExchangeExec):
     """Replicate rows to every task (broadcast-join build sides)."""
 
     def with_new_children(self, children):
-        return BroadcastExchangeExec(children[0], self.num_tasks)
+        n = BroadcastExchangeExec(children[0], self.num_tasks)
+        n.stage_id = self.stage_id
+        return n
 
     def output_capacity(self):
         return self.child.output_capacity() * self.num_tasks
 
-    def execute(self, ctx: ExecContext) -> Table:
+    def _execute(self, ctx: ExecContext) -> Table:
         t = self.child.execute(ctx)
         return broadcast_exchange(t, self._require_axis(ctx), self.num_tasks)
 

@@ -101,7 +101,7 @@ class HashJoinExec(ExecutionPlan):
             return self.probe.output_capacity()
         return self.out_capacity
 
-    def execute(self, ctx: ExecContext) -> Table:
+    def _execute(self, ctx: ExecContext) -> Table:
         probe = self.probe.execute(ctx)
         build = self.build.execute(ctx)
         probe, build = _unify_key_dictionaries(
@@ -254,7 +254,7 @@ class CrossJoinExec(ExecutionPlan):
     def output_capacity(self):
         return self.out_capacity
 
-    def execute(self, ctx: ExecContext) -> Table:
+    def _execute(self, ctx: ExecContext) -> Table:
         l = self.left.execute(ctx)
         r = self.right.execute(ctx)
         cap = self.out_capacity
@@ -294,7 +294,7 @@ class UnionExec(ExecutionPlan):
     def output_capacity(self):
         return sum(c.output_capacity() for c in self._children)
 
-    def execute(self, ctx: ExecContext) -> Table:
+    def _execute(self, ctx: ExecContext) -> Table:
         tables = [c.execute(ctx) for c in self._children]
         first = tables[0]
         # align column names to the first child's

@@ -67,9 +67,17 @@ class ExecContext:
     inputs: dict[int, Table]  # leaf node_id -> loaded device Table
     overflow_flags: list = dc_field(default_factory=list)
     config: dict = dc_field(default_factory=dict)
+    # traced per-node metrics: (node_id, metric_name, traced scalar). The
+    # executor returns these as program outputs and stitches them into a
+    # MetricsStore host-side (runtime/metrics.py).
+    metrics: list = dc_field(default_factory=list)
 
     def record_overflow(self, node: "ExecutionPlan", flag) -> None:
         self.overflow_flags.append((node.label(), flag))
+
+    def record_metric(self, node: "ExecutionPlan", name: str, value) -> None:
+        if self.config.get("collect_metrics", True):
+            self.metrics.append((node.node_id, name, value))
 
 
 _NODE_COUNTER = itertools.count()
@@ -102,6 +110,13 @@ class ExecutionPlan:
 
     # -- execution ----------------------------------------------------------
     def execute(self, ctx: ExecContext) -> Table:
+        """Trace this operator; records the per-node output_rows metric
+        (the DataFusion baseline metric set analogue)."""
+        out = self._execute(ctx)
+        ctx.record_metric(self, "output_rows", out.num_rows)
+        return out
+
+    def _execute(self, ctx: ExecContext) -> Table:
         raise NotImplementedError
 
     # -- display ------------------------------------------------------------
@@ -148,10 +163,14 @@ class MemoryScanExec(ExecutionPlan):
     here each task's slice is one padded Table in `tasks`.
     """
 
-    def __init__(self, tasks: Sequence[Table], schema: Schema):
+    def __init__(self, tasks: Sequence[Table], schema: Schema,
+                 pinned: bool = False):
         super().__init__()
         self.tasks = list(tasks)
         self._schema = schema
+        # pinned: this scan is already task-specialized (holds exactly the
+        # executing task's slice); ignore task_index on load
+        self.pinned = pinned
 
     def children(self):
         return []
@@ -167,6 +186,8 @@ class MemoryScanExec(ExecutionPlan):
         return max(t.capacity for t in self.tasks)
 
     def load(self, task: DistributedTaskContext) -> Table:
+        if self.pinned:
+            return self.tasks[0]
         if task.task_index >= len(self.tasks):
             # Tasks beyond the data slices read nothing (the reference's
             # short coalesce groups yield empty streams the same way).
@@ -174,7 +195,7 @@ class MemoryScanExec(ExecutionPlan):
             return Table.empty(self._schema, ref.capacity, _dicts_of(ref))
         return self.tasks[task.task_index]
 
-    def execute(self, ctx: ExecContext) -> Table:
+    def _execute(self, ctx: ExecContext) -> Table:
         return ctx.inputs[self.node_id]
 
     def display(self):
@@ -233,7 +254,7 @@ class ParquetScanExec(ExecutionPlan):
             dictionaries=self.dictionaries,
         )
 
-    def execute(self, ctx: ExecContext) -> Table:
+    def _execute(self, ctx: ExecContext) -> Table:
         return ctx.inputs[self.node_id]
 
     def display(self):
@@ -267,7 +288,7 @@ class FilterExec(ExecutionPlan):
     def output_capacity(self):
         return self.child.output_capacity()
 
-    def execute(self, ctx: ExecContext) -> Table:
+    def _execute(self, ctx: ExecContext) -> Table:
         t = self.child.execute(ctx)
         v = self.predicate.evaluate(t)
         keep = v.data.astype(jnp.bool_) & v.valid_mask()
@@ -300,7 +321,7 @@ class ProjectionExec(ExecutionPlan):
     def output_capacity(self):
         return self.child.output_capacity()
 
-    def execute(self, ctx: ExecContext) -> Table:
+    def _execute(self, ctx: ExecContext) -> Table:
         t = self.child.execute(ctx)
         cols = {}
         for expr, name in self.exprs:
@@ -357,7 +378,7 @@ class HashAggregateExec(ExecutionPlan):
     def output_capacity(self):
         return self.num_slots
 
-    def execute(self, ctx: ExecContext) -> Table:
+    def _execute(self, ctx: ExecContext) -> Table:
         t = self.child.execute(ctx)
         if not self.group_names:
             from datafusion_distributed_tpu.ops.aggregate import global_aggregate
@@ -420,7 +441,7 @@ class SortExec(ExecutionPlan):
     def output_capacity(self):
         return self.child.output_capacity()
 
-    def execute(self, ctx: ExecContext) -> Table:
+    def _execute(self, ctx: ExecContext) -> Table:
         t = sort_table(self.child.execute(ctx), self.keys)
         if self.fetch is not None:
             t = t.head(self.fetch)
@@ -453,7 +474,7 @@ class LimitExec(ExecutionPlan):
     def output_capacity(self):
         return self.child.output_capacity()
 
-    def execute(self, ctx: ExecContext) -> Table:
+    def _execute(self, ctx: ExecContext) -> Table:
         return limit_table(self.child.execute(ctx), self.fetch, self.skip)
 
     def display(self):
@@ -483,7 +504,7 @@ class CoalescePartitionsExec(ExecutionPlan):
     def output_capacity(self):
         return self.child.output_capacity()
 
-    def execute(self, ctx: ExecContext) -> Table:
+    def _execute(self, ctx: ExecContext) -> Table:
         return self.child.execute(ctx)
 
 
@@ -501,15 +522,18 @@ def execute_plan(
     task: Optional[DistributedTaskContext] = None,
     config: Optional[dict] = None,
     check_overflow: bool = True,
-    donate: bool = False,
+    metrics_store=None,
+    task_label: Optional[str] = None,
+    use_cache: bool = True,
 ) -> Table:
     """Run a (single-task) plan: host-load leaves, trace+jit the rest once.
 
     The jit cache key is the plan object identity plus input shapes, so
     repeated execution over same-capacity batches reuses the compiled
     executable (the analogue of the reference's task re-execution against the
-    cached plan in `TaskData`).
-    """
+    cached plan in `TaskData`). When ``metrics_store`` is given, the traced
+    per-node metrics are returned as program outputs and inserted under
+    ``task_label`` (runtime/metrics.py MetricsStore protocol)."""
     task = task or DistributedTaskContext()
     leaves = collect_leaves(plan)
     inputs = {}
@@ -518,17 +542,21 @@ def execute_plan(
             inputs[leaf.node_id] = leaf.load(task)
 
     overflow_box: list = []
+    metric_names: list = []
 
     def run(inp):
         ctx = ExecContext(task=task, inputs=inp, config=config or {})
-        out = ctx_out = plan.execute(ctx)
+        out = plan.execute(ctx)
         overflow_box.clear()
         overflow_box.extend(ctx.overflow_flags)
+        metric_names.clear()
+        metric_names.extend((nid, name) for nid, name, _ in ctx.metrics)
+        metric_vals = [v for _, _, v in ctx.metrics]
         flags = [f for _, f in ctx.overflow_flags]
         any_overflow = (
             jnp.any(jnp.stack(flags)) if flags else jnp.asarray(False)
         )
-        return out, any_overflow
+        return out, any_overflow, metric_vals
 
     cache_key = (
         plan.node_id,
@@ -536,28 +564,35 @@ def execute_plan(
         task.task_count,
         tuple(sorted((config or {}).items())),
     )
-    fn = _get_compiled(plan, run, cache_key)
-    out, any_overflow = fn(inputs)
+    # the trace-time boxes (overflow names, metric names) must come from the
+    # SAME closure as the cached executable, or cache hits would see them
+    # empty. use_cache=False (worker path: plans are freshly decoded per task
+    # and would never hit) keeps one-shot programs out of the global cache so
+    # their closures don't pin shipped task tables.
+    cached = _COMPILE_CACHE.get(cache_key) if use_cache else None
+    if cached is None:
+        if use_cache and len(_COMPILE_CACHE) >= _COMPILE_CACHE_MAX:
+            _COMPILE_CACHE.clear()
+        cached = (jax.jit(run), overflow_box, metric_names)
+        if use_cache:
+            _COMPILE_CACHE[cache_key] = cached
+    fn, overflow_box, metric_names = cached
+    out, any_overflow, metric_vals = fn(inputs)
     if check_overflow and bool(any_overflow):
         raise RuntimeError(
             f"hash table overflow in plan (nodes: "
             f"{[name for name, _ in overflow_box]}); re-plan with more slots"
         )
+    if metrics_store is not None:
+        node_metrics: dict = {}
+        for (nid, name), v in zip(metric_names, metric_vals):
+            node_metrics.setdefault(nid, {})[name] = int(v)
+        metrics_store.insert(task_label or f"task{task.task_index}", node_metrics)
     return out
 
 
 _COMPILE_CACHE: dict = {}
 _COMPILE_CACHE_MAX = 512
-
-
-def _get_compiled(plan: ExecutionPlan, run: Callable, cache_key) -> Callable:
-    fn = _COMPILE_CACHE.get(cache_key)
-    if fn is None:
-        if len(_COMPILE_CACHE) >= _COMPILE_CACHE_MAX:
-            _COMPILE_CACHE.clear()
-        fn = jax.jit(run)
-        _COMPILE_CACHE[cache_key] = fn
-    return fn
 
 
 def _dicts_of(table: Table) -> dict:

@@ -1,0 +1,401 @@
+"""Plan (de)serialization codec.
+
+The reference ships task-specialized plan subtrees to workers as protobuf
+(`DistributedCodec`, `/root/reference/src/protobuf/distributed_codec.rs`, with
+user-codec composition). Here plans serialize to JSON-able dicts; bulk data
+never rides inside the plan — scan leaves serialize as *table references*
+into a shipment store (in-process: shared by reference, the
+LocalWorkerConnection zero-copy bypass analogue; cross-host: Arrow IPC bytes
+via `encode_table`/`decode_table`).
+
+User extension nodes register via `register_codec` (the user-codec registry
+analogue, `src/protobuf/user_codec.rs`).
+"""
+
+from __future__ import annotations
+
+import io
+import uuid
+from typing import Any, Callable, Optional
+
+from datafusion_distributed_tpu.ops.aggregate import AggSpec
+from datafusion_distributed_tpu.ops.sort import SortKey
+from datafusion_distributed_tpu.ops.table import Table
+from datafusion_distributed_tpu.plan import expressions as pe
+from datafusion_distributed_tpu.plan.exchanges import (
+    BroadcastExchangeExec,
+    CoalesceExchangeExec,
+    PartitionReplicatedExec,
+    ShuffleExchangeExec,
+)
+from datafusion_distributed_tpu.plan.joins import (
+    CrossJoinExec,
+    HashJoinExec,
+    UnionExec,
+)
+from datafusion_distributed_tpu.plan.physical import (
+    CoalescePartitionsExec,
+    ExecutionPlan,
+    FilterExec,
+    HashAggregateExec,
+    LimitExec,
+    MemoryScanExec,
+    ParquetScanExec,
+    ProjectionExec,
+    SortExec,
+)
+from datafusion_distributed_tpu.schema import DataType, Field, Schema
+
+
+class CodecError(ValueError):
+    pass
+
+
+_USER_CODECS: dict[str, tuple[Callable, Callable]] = {}
+
+
+def register_codec(kind: str, encode: Callable, decode: Callable) -> None:
+    """Register (encode(node, ctx) -> dict, decode(obj, ctx) -> node) for a
+    custom ExecutionPlan type."""
+    _USER_CODECS[kind] = (encode, decode)
+
+
+class TableStore:
+    """Shipment store: table id -> Table. In-process peers share it by
+    reference; cross-host transport serializes entries with encode_table.
+    Callers release shipped entries when their task completes (drop-driven
+    cleanup, like the reference's partition-drop accounting)."""
+
+    def __init__(self) -> None:
+        self.tables: dict[str, Table] = {}
+
+    def put(self, table: Table) -> str:
+        tid = uuid.uuid4().hex
+        self.tables[tid] = table
+        return tid
+
+    def get(self, tid: str) -> Table:
+        if tid not in self.tables:
+            raise CodecError(f"table {tid} not in shipment store")
+        return self.tables[tid]
+
+    def remove(self, tids) -> None:
+        for tid in tids:
+            self.tables.pop(tid, None)
+
+
+def collect_table_ids(plan_obj: dict) -> list[str]:
+    """All shipment-store ids referenced by an encoded plan."""
+    out: list[str] = []
+
+    def walk(o):
+        if isinstance(o, dict):
+            if o.get("t") == "memscan":
+                out.extend(o["tables"])
+            for v in o.values():
+                walk(v)
+        elif isinstance(o, list):
+            for v in o:
+                walk(v)
+
+    walk(plan_obj)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# schema / expressions
+# ---------------------------------------------------------------------------
+
+
+def encode_schema(s: Schema) -> list:
+    return [[f.name, f.dtype.value, f.nullable] for f in s.fields]
+
+
+def decode_schema(obj) -> Schema:
+    return Schema([Field(n, DataType(d), bool(nl)) for n, d, nl in obj])
+
+
+def encode_expr(e: pe.PhysicalExpr) -> dict:
+    if isinstance(e, pe.Col):
+        return {"t": "col", "name": e.name}
+    if isinstance(e, pe.Literal):
+        v = e.value
+        return {"t": "lit", "value": v, "dtype": e.dtype.value}
+    if isinstance(e, pe.BinaryOp):
+        return {"t": "bin", "op": e.op, "l": encode_expr(e.left),
+                "r": encode_expr(e.right)}
+    if isinstance(e, pe.BooleanOp):
+        return {"t": "bool", "op": e.op, "l": encode_expr(e.left),
+                "r": encode_expr(e.right)}
+    if isinstance(e, pe.Not):
+        return {"t": "not", "c": encode_expr(e.child)}
+    if isinstance(e, pe.IsNull):
+        return {"t": "isnull", "c": encode_expr(e.child), "neg": e.negated}
+    if isinstance(e, pe.Cast):
+        return {"t": "cast", "c": encode_expr(e.child), "to": e.to.value}
+    if isinstance(e, pe.Like):
+        return {"t": "like", "c": encode_expr(e.child), "p": e.pattern,
+                "neg": e.negated}
+    if isinstance(e, pe.InList):
+        return {"t": "inlist", "c": encode_expr(e.child),
+                "values": list(e.values), "neg": e.negated}
+    if isinstance(e, pe.Case):
+        return {
+            "t": "case",
+            "branches": [[encode_expr(c), encode_expr(v)] for c, v in e.branches],
+            "else": encode_expr(e.otherwise) if e.otherwise else None,
+        }
+    if isinstance(e, pe.Alias):
+        return {"t": "alias", "c": encode_expr(e.child), "name": e.name}
+    if isinstance(e, pe.Negate):
+        return {"t": "neg", "c": encode_expr(e.child)}
+    if isinstance(e, pe.Extract):
+        return {"t": "extract", "part": e.part, "c": encode_expr(e.child)}
+    if isinstance(e, pe.Substring):
+        return {"t": "substr", "c": encode_expr(e.child), "start": e.start,
+                "length": e.length}
+    raise CodecError(f"cannot encode expression {type(e).__name__}")
+
+
+def decode_expr(o: dict) -> pe.PhysicalExpr:
+    t = o["t"]
+    if t == "col":
+        return pe.Col(o["name"])
+    if t == "lit":
+        return pe.Literal(o["value"], DataType(o["dtype"]))
+    if t == "bin":
+        return pe.BinaryOp(o["op"], decode_expr(o["l"]), decode_expr(o["r"]))
+    if t == "bool":
+        return pe.BooleanOp(o["op"], decode_expr(o["l"]), decode_expr(o["r"]))
+    if t == "not":
+        return pe.Not(decode_expr(o["c"]))
+    if t == "isnull":
+        return pe.IsNull(decode_expr(o["c"]), o["neg"])
+    if t == "cast":
+        return pe.Cast(decode_expr(o["c"]), DataType(o["to"]))
+    if t == "like":
+        return pe.Like(decode_expr(o["c"]), o["p"], o["neg"])
+    if t == "inlist":
+        return pe.InList(decode_expr(o["c"]), tuple(o["values"]), o["neg"])
+    if t == "case":
+        branches = tuple(
+            (decode_expr(c), decode_expr(v)) for c, v in o["branches"]
+        )
+        otherwise = decode_expr(o["else"]) if o["else"] else None
+        return pe.Case(branches, otherwise)
+    if t == "alias":
+        return pe.Alias(decode_expr(o["c"]), o["name"])
+    if t == "neg":
+        return pe.Negate(decode_expr(o["c"]))
+    if t == "extract":
+        return pe.Extract(o["part"], decode_expr(o["c"]))
+    if t == "substr":
+        return pe.Substring(decode_expr(o["c"]), o["start"], o["length"])
+    raise CodecError(f"cannot decode expression kind {t!r}")
+
+
+# ---------------------------------------------------------------------------
+# plans
+# ---------------------------------------------------------------------------
+
+
+def encode_plan(p: ExecutionPlan, store: TableStore) -> dict:
+    if isinstance(p, MemoryScanExec):
+        return {
+            "t": "memscan",
+            "tables": [store.put(t) for t in p.tasks],
+            "schema": encode_schema(p.schema()),
+            "pinned": p.pinned,
+        }
+    if isinstance(p, ParquetScanExec):
+        return {
+            "t": "pqscan",
+            "file_groups": p.file_groups,
+            "schema": encode_schema(p._schema),
+            "capacity": p.capacity,
+            "projection": p.projection,
+            # shared dictionaries must travel: per-worker rebuilt dictionaries
+            # would make codes incomparable across the exchange
+            "dictionaries": {
+                name: list(d.values)
+                for name, d in (p.dictionaries or {}).items()
+            } or None,
+        }
+    if isinstance(p, FilterExec):
+        return {"t": "filter", "pred": encode_expr(p.predicate),
+                "c": encode_plan(p.child, store)}
+    if isinstance(p, ProjectionExec):
+        return {
+            "t": "project",
+            "exprs": [[encode_expr(e), n] for e, n in p.exprs],
+            "c": encode_plan(p.child, store),
+        }
+    if isinstance(p, HashAggregateExec):
+        return {
+            "t": "agg",
+            "mode": p.mode,
+            "groups": p.group_names,
+            "aggs": [[a.func, a.input_name, a.output_name] for a in p.aggs],
+            "slots": p.num_slots,
+            "c": encode_plan(p.child, store),
+        }
+    if isinstance(p, SortExec):
+        return {
+            "t": "sort",
+            "keys": [[k.name, k.ascending, k.nulls_first] for k in p.keys],
+            "fetch": p.fetch,
+            "c": encode_plan(p.child, store),
+        }
+    if isinstance(p, LimitExec):
+        return {"t": "limit", "fetch": p.fetch, "skip": p.skip,
+                "c": encode_plan(p.child, store)}
+    if isinstance(p, CoalescePartitionsExec):
+        return {"t": "coalesce_parts", "c": encode_plan(p.child, store)}
+    if isinstance(p, HashJoinExec):
+        return {
+            "t": "hashjoin",
+            "jt": p.join_type,
+            "pk": p.probe_keys,
+            "bk": p.build_keys,
+            "residual": encode_expr(p.residual) if p.residual else None,
+            "out_cap": p.out_capacity,
+            "slots": p.num_slots,
+            "mark": p.mark_name,
+            "null_aware": p.null_aware,
+            "probe": encode_plan(p.probe, store),
+            "build": encode_plan(p.build, store),
+        }
+    if isinstance(p, CrossJoinExec):
+        return {"t": "crossjoin", "out_cap": p.out_capacity,
+                "l": encode_plan(p.left, store),
+                "r": encode_plan(p.right, store)}
+    if isinstance(p, UnionExec):
+        return {"t": "union",
+                "cs": [encode_plan(c, store) for c in p.children()]}
+    if isinstance(p, ShuffleExchangeExec):
+        return {"t": "shuffle", "keys": p.key_names, "tasks": p.num_tasks,
+                "per_dest": p.per_dest_capacity, "stage": p.stage_id,
+                "c": encode_plan(p.child, store)}
+    if isinstance(p, CoalesceExchangeExec):
+        return {"t": "coalesce_ex", "tasks": p.num_tasks, "stage": p.stage_id,
+                "c": encode_plan(p.child, store)}
+    if isinstance(p, BroadcastExchangeExec):
+        return {"t": "broadcast_ex", "tasks": p.num_tasks, "stage": p.stage_id,
+                "c": encode_plan(p.child, store)}
+    if isinstance(p, PartitionReplicatedExec):
+        return {"t": "partrep", "tasks": p.num_tasks, "stage": p.stage_id,
+                "c": encode_plan(p.child, store)}
+    kind = getattr(p, "codec_kind", None)
+    if kind and kind in _USER_CODECS:
+        enc, _ = _USER_CODECS[kind]
+        return {"t": f"user:{kind}", "body": enc(p, store)}
+    raise CodecError(f"cannot encode plan node {type(p).__name__}")
+
+
+def decode_plan(o: dict, store: TableStore) -> ExecutionPlan:
+    t = o["t"]
+    if t == "memscan":
+        tables = [store.get(tid) for tid in o["tables"]]
+        return MemoryScanExec(tables, decode_schema(o["schema"]),
+                              pinned=o.get("pinned", False))
+    if t == "pqscan":
+        from datafusion_distributed_tpu.ops.table import Dictionary
+        import numpy as np
+
+        dicts = None
+        if o.get("dictionaries"):
+            dicts = {
+                name: Dictionary(np.asarray(vals, dtype=object))
+                for name, vals in o["dictionaries"].items()
+            }
+        return ParquetScanExec(
+            o["file_groups"], decode_schema(o["schema"]), o["capacity"],
+            o["projection"], dicts,
+        )
+    if t == "filter":
+        return FilterExec(decode_expr(o["pred"]), decode_plan(o["c"], store))
+    if t == "project":
+        return ProjectionExec(
+            [(decode_expr(e), n) for e, n in o["exprs"]],
+            decode_plan(o["c"], store),
+        )
+    if t == "agg":
+        return HashAggregateExec(
+            o["mode"], o["groups"],
+            [AggSpec(f, i, n) for f, i, n in o["aggs"]],
+            decode_plan(o["c"], store), o["slots"],
+        )
+    if t == "sort":
+        return SortExec(
+            [SortKey(n, a, nf) for n, a, nf in o["keys"]],
+            decode_plan(o["c"], store), o["fetch"],
+        )
+    if t == "limit":
+        return LimitExec(decode_plan(o["c"], store), o["fetch"], o["skip"])
+    if t == "coalesce_parts":
+        return CoalescePartitionsExec(decode_plan(o["c"], store))
+    if t == "hashjoin":
+        return HashJoinExec(
+            decode_plan(o["probe"], store), decode_plan(o["build"], store),
+            o["pk"], o["bk"], o["jt"],
+            residual=decode_expr(o["residual"]) if o["residual"] else None,
+            out_capacity=o["out_cap"], num_slots=o["slots"],
+            mark_name=o["mark"], null_aware=o["null_aware"],
+        )
+    if t == "crossjoin":
+        return CrossJoinExec(decode_plan(o["l"], store),
+                             decode_plan(o["r"], store), o["out_cap"])
+    if t == "union":
+        return UnionExec([decode_plan(c, store) for c in o["cs"]])
+    if t == "shuffle":
+        n = ShuffleExchangeExec(decode_plan(o["c"], store), o["keys"],
+                                o["tasks"], o["per_dest"])
+        n.stage_id = o["stage"]
+        return n
+    if t == "coalesce_ex":
+        n = CoalesceExchangeExec(decode_plan(o["c"], store), o["tasks"])
+        n.stage_id = o["stage"]
+        return n
+    if t == "broadcast_ex":
+        n = BroadcastExchangeExec(decode_plan(o["c"], store), o["tasks"])
+        n.stage_id = o["stage"]
+        return n
+    if t == "partrep":
+        n = PartitionReplicatedExec(decode_plan(o["c"], store), o["tasks"])
+        n.stage_id = o["stage"]
+        return n
+    if t.startswith("user:"):
+        kind = t[5:]
+        if kind not in _USER_CODECS:
+            raise CodecError(f"no codec registered for {kind!r}")
+        _, dec = _USER_CODECS[kind]
+        return dec(o["body"], store)
+    raise CodecError(f"cannot decode plan kind {t!r}")
+
+
+# ---------------------------------------------------------------------------
+# table transport (cross-host payloads)
+# ---------------------------------------------------------------------------
+
+
+def encode_table(table: Table) -> bytes:
+    """Table -> Arrow IPC bytes (the Flight data-plane payload analogue)."""
+    import pyarrow as pa
+
+    from datafusion_distributed_tpu.io.parquet import table_to_arrow
+
+    arrow = table_to_arrow(table)
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, arrow.schema) as w:
+        w.write_table(arrow)
+    return sink.getvalue()
+
+
+def decode_table(data: bytes, capacity: Optional[int] = None) -> Table:
+    import pyarrow as pa
+
+    from datafusion_distributed_tpu.io.parquet import arrow_to_table
+
+    with pa.ipc.open_stream(io.BytesIO(data)) as r:
+        arrow = r.read_all()
+    return arrow_to_table(arrow, capacity=capacity)

@@ -1,0 +1,228 @@
+"""Coordinator: stage-wise distributed execution across workers.
+
+The reference's `DistributedExec`/`QueryCoordinator` assign worker URLs per
+task, ship task-specialized plans over a coordinator channel, then stream
+results through the exchange network (`/root/reference/src/coordinator/`,
+SURVEY.md §3.2). This is the host-runtime tier of the TPU design:
+
+  in-mesh   -> runtime/mesh_executor.py (one SPMD program, collectives)
+  cross-mesh/host -> THIS: each stage's tasks run on workers; the coordinator
+  materializes stage outputs and performs the exchange semantics between
+  stages (the DCN hop).
+
+Stages execute bottom-up: every exchange boundary's producer subtree is
+shipped to workers task-by-task (round-robin routing, the reference's
+routed_urls default), executed, and the exchange (shuffle regroup /
+broadcast / coalesce) is applied to the collected outputs; the boundary then
+becomes an in-memory scan for the consumer stage — the Pending->Ready flip
+of `Stage::Local -> Stage::Remote`.
+"""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from datafusion_distributed_tpu.ops.hash import hash_columns
+from datafusion_distributed_tpu.ops.table import Table, concat_tables, round_up_pow2
+from datafusion_distributed_tpu.plan.exchanges import (
+    BroadcastExchangeExec,
+    CoalesceExchangeExec,
+    PartitionReplicatedExec,
+    ShuffleExchangeExec,
+)
+from datafusion_distributed_tpu.plan.physical import (
+    DistributedTaskContext,
+    ExecutionPlan,
+    MemoryScanExec,
+)
+from datafusion_distributed_tpu.runtime.codec import TableStore, encode_plan
+from datafusion_distributed_tpu.runtime.worker import TaskKey, Worker
+
+
+class WorkerResolver:
+    """Cluster membership (the reference's WorkerResolver: get_urls)."""
+
+    def get_urls(self) -> list[str]:
+        raise NotImplementedError
+
+
+class ChannelResolver:
+    """URL -> worker channel (the reference's ChannelResolver)."""
+
+    def get_worker(self, url: str) -> Worker:
+        raise NotImplementedError
+
+
+class InMemoryCluster(WorkerResolver, ChannelResolver):
+    """N in-process workers (the InMemoryChannelResolver fake cluster used by
+    the reference's whole TPC suite, `src/test_utils/`)."""
+
+    def __init__(self, num_workers: int, ttl_seconds: float = 600.0):
+        self.workers = {
+            f"mem://worker-{i}": Worker(f"mem://worker-{i}", ttl_seconds)
+            for i in range(num_workers)
+        }
+
+    def get_urls(self) -> list[str]:
+        return list(self.workers.keys())
+
+    def get_worker(self, url: str) -> Worker:
+        return self.workers[url]
+
+
+@dataclass
+class Coordinator:
+    resolver: WorkerResolver
+    channels: ChannelResolver
+    route_tasks: Optional[Callable] = None  # custom routing hook
+    collect_metrics: bool = True
+    metrics: dict = field(default_factory=dict)  # TaskKey -> worker metrics
+
+    def execute(self, plan: ExecutionPlan) -> Table:
+        """Run a distributed plan (exchange-staged) across the workers and
+        return the (replicated) root result."""
+        query_id = uuid.uuid4().hex
+        resolved = self._materialize_exchanges(plan, query_id)
+        # the root stage: a single consumer task
+        out = self._run_stage_task(
+            resolved, query_id, stage_id=-1, task_number=0, task_count=1
+        )
+        return out
+
+    # -- stage materialization ----------------------------------------------
+    def _materialize_exchanges(
+        self, plan: ExecutionPlan, query_id: str
+    ) -> ExecutionPlan:
+        children = [
+            self._materialize_exchanges(c, query_id) for c in plan.children()
+        ]
+        if children:
+            plan = plan.with_new_children(children)
+        if not getattr(plan, "is_exchange", False):
+            return plan
+
+        t = plan.num_tasks
+        producer = plan.children()[0]
+        stage_id = plan.stage_id if plan.stage_id is not None else 0
+        if isinstance(plan, PartitionReplicatedExec):
+            # producer is replicated: one task's output carries everything
+            outputs = [
+                self._run_stage_task(producer, query_id, stage_id, 0, t)
+            ]
+        else:
+            outputs = [
+                self._run_stage_task(producer, query_id, stage_id, i, t)
+                for i in range(t)
+            ]
+        if isinstance(plan, ShuffleExchangeExec):
+            slices = _shuffle_regroup(
+                outputs, plan.key_names, t, plan.per_dest_capacity
+            )
+        elif isinstance(plan, (CoalesceExchangeExec, BroadcastExchangeExec)):
+            cap = sum(o.capacity for o in outputs)
+            merged = concat_tables(outputs, capacity=cap)
+            slices = [merged] * t
+        elif isinstance(plan, PartitionReplicatedExec):
+            # producer is replicated: each consumer keeps its modulo slice of
+            # task 0's output
+            slices = _mod_slices(outputs[0], t)
+        else:
+            raise NotImplementedError(type(plan).__name__)
+        return MemoryScanExec(slices, producer.schema())
+
+    # -- task execution ------------------------------------------------------
+    def _run_stage_task(
+        self,
+        stage_plan: ExecutionPlan,
+        query_id: str,
+        stage_id: int,
+        task_number: int,
+        task_count: int,
+    ) -> Table:
+        urls = self.resolver.get_urls()
+        if self.route_tasks is not None:
+            url = self.route_tasks(query_id, stage_id, task_number, urls)
+        else:
+            url = urls[(stage_id + task_number) % len(urls)]  # round-robin
+        worker = self.channels.get_worker(url)
+        key = TaskKey(query_id, stage_id, task_number)
+        store = worker.table_store
+        plan_obj = encode_plan(
+            _task_specialized(stage_plan, task_number), store
+        )
+        worker.set_plan(key, plan_obj, task_count)
+        try:
+            out = worker.execute_task(key)
+            if self.collect_metrics:
+                self.metrics[key] = worker.task_progress(key) or {}
+        finally:
+            # drop-driven cleanup: the task's cache entry AND its shipped
+            # table slices are released as soon as its single partition is
+            # consumed (reference: on_drop_stream + invalidate,
+            # `impl_execute_task.rs:97-112`)
+            worker.registry.invalidate(key)
+            from datafusion_distributed_tpu.runtime.codec import (
+                collect_table_ids,
+            )
+
+            store.remove(collect_table_ids(plan_obj))
+        return out
+
+
+def _task_specialized(plan: ExecutionPlan, task_number: int) -> ExecutionPlan:
+    """Ship only this task's leaf slice (the reference strips other tasks'
+    DistributedLeaf variants before sending, `query_coordinator.rs:346-382`).
+    The worker indexes its slice with task_index 0...task-local addressing is
+    preserved because MemoryScanExec.load clamps by list length."""
+
+    def walk(node: ExecutionPlan) -> ExecutionPlan:
+        if isinstance(node, MemoryScanExec) and not node.pinned:
+            if task_number < len(node.tasks):
+                chosen = node.tasks[task_number]
+            else:
+                from datafusion_distributed_tpu.plan.physical import _dicts_of
+
+                ref = node.tasks[0]
+                chosen = Table.empty(
+                    node.schema(), ref.capacity, _dicts_of(ref)
+                )
+            return MemoryScanExec([chosen], node.schema(), pinned=True)
+        children = [walk(c) for c in node.children()]
+        return node.with_new_children(children) if children else node
+
+    return walk(plan)
+
+
+def _shuffle_regroup(
+    outputs: Sequence[Table], key_names, num_tasks: int, per_dest_capacity: int
+) -> list[Table]:
+    """Host-side hash regroup between stages. Uses the SAME hash as the
+    in-mesh kernel so a query may mix mesh-internal and cross-mesh shuffles
+    and keys still co-locate."""
+    buckets: list[list[Table]] = [[] for _ in range(num_tasks)]
+    for out in outputs:
+        cols = [out.column(k).data for k in key_names]
+        valids = [out.column(k).validity for k in key_names]
+        h = hash_columns(cols, valids)
+        dest = (h % np.uint32(num_tasks)).astype(jnp.int32)
+        live = out.row_mask()
+        for j in range(num_tasks):
+            buckets[j].append(out.compact(live & (dest == j)))
+    slices = []
+    cap = num_tasks * per_dest_capacity
+    for j in range(num_tasks):
+        slices.append(concat_tables(buckets[j], capacity=cap))
+    return slices
+
+
+def _mod_slices(table: Table, num_tasks: int) -> list[Table]:
+    idx = jnp.arange(table.capacity, dtype=jnp.int32)
+    live = table.row_mask()
+    return [
+        table.compact(live & ((idx % num_tasks) == i)) for i in range(num_tasks)
+    ]

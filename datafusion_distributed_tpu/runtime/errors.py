@@ -1,0 +1,80 @@
+"""Structured error propagation across the runtime.
+
+The reference round-trips full `DataFusionError` structure over the wire
+(`/root/reference/src/protobuf/errors/`, carried in tonic Status details) so
+a worker's failure surfaces verbatim at the coordinator. The host-runtime
+analogue: every worker exception is wrapped in a WorkerError carrying the
+worker url, task key, original type and traceback; `to_dict`/`from_dict`
+round-trip it over any transport.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Any, Optional
+
+
+class QueryError(RuntimeError):
+    """Base class for engine errors."""
+
+
+class PlanningError(QueryError):
+    pass
+
+
+class WorkerError(QueryError):
+    """An error that happened on (or is attributed to) a worker."""
+
+    def __init__(
+        self,
+        message: str,
+        worker_url: str = "",
+        task: Any = None,
+        original_type: str = "",
+        original_traceback: str = "",
+    ):
+        super().__init__(message)
+        self.worker_url = worker_url
+        self.task = task
+        self.original_type = original_type or type(self).__name__
+        self.original_traceback = original_traceback
+
+    def __str__(self) -> str:  # coordinator-side rendering
+        base = super().__str__()
+        loc = f" [worker={self.worker_url}, task={self.task}]" if (
+            self.worker_url
+        ) else ""
+        return f"{base}{loc}"
+
+    def to_dict(self) -> dict:
+        t = self.task
+        return {
+            "message": RuntimeError.__str__(self),
+            "worker_url": self.worker_url,
+            "task": [t.query_id, t.stage_id, t.task_number] if t else None,
+            "original_type": self.original_type,
+            "original_traceback": self.original_traceback,
+        }
+
+    @staticmethod
+    def from_dict(o: dict) -> "WorkerError":
+        from datafusion_distributed_tpu.runtime.worker import TaskKey
+
+        task = TaskKey(*o["task"]) if o.get("task") else None
+        return WorkerError(
+            o["message"],
+            worker_url=o.get("worker_url", ""),
+            task=task,
+            original_type=o.get("original_type", ""),
+            original_traceback=o.get("original_traceback", ""),
+        )
+
+
+def wrap_worker_exception(e: Exception, worker_url: str, task) -> WorkerError:
+    return WorkerError(
+        str(e),
+        worker_url=worker_url,
+        task=task,
+        original_type=type(e).__name__,
+        original_traceback=traceback.format_exc(),
+    )

@@ -43,8 +43,13 @@ def execute_on_mesh(
     plan: ExecutionPlan,
     mesh: Mesh,
     check_overflow: bool = True,
+    metrics_store=None,
 ) -> Table:
-    """Execute a distributed plan (root output replicated) on a mesh."""
+    """Execute a distributed plan (root output replicated) on a mesh.
+
+    With ``metrics_store`` (runtime/metrics.py protocol), traced per-node
+    metrics come back per task via a P(axis)-stacked program output and are
+    inserted under labels task0..taskN-1."""
     num_tasks = mesh.shape[AXIS]
     leaves = plan.collect(lambda n: not n.children())
 
@@ -62,6 +67,7 @@ def execute_on_mesh(
         )
 
     overflow_names: list = []
+    metric_names: list = []
 
     def run(inputs_stacked):
         # local view: leading task axis of size 1 -> squeeze
@@ -77,6 +83,14 @@ def execute_on_mesh(
         out = plan.execute(ctx)
         overflow_names.clear()
         overflow_names.extend(name for name, _ in ctx.overflow_flags)
+        metric_names.clear()
+        metric_names.extend((nid, name) for nid, name, _ in ctx.metrics)
+        if ctx.metrics:
+            mvec = jnp.stack(
+                [v.astype(jnp.int64) for _, _, v in ctx.metrics]
+            )[None, :]
+        else:
+            mvec = jnp.zeros((1, 0), dtype=jnp.int64)
         flags = [f for _, f in ctx.overflow_flags]
         any_overflow = (
             jnp.any(jnp.stack(flags)) if flags else jnp.asarray(False)
@@ -84,12 +98,12 @@ def execute_on_mesh(
         any_overflow = (
             jax.lax.pmax(any_overflow.astype(jnp.int32), AXIS) > 0
         )
-        return out, any_overflow
+        return out, any_overflow, mvec
 
     in_specs = jax.tree.map(lambda _: P(AXIS), stacked_inputs)
     cache_key = (plan.node_id, tuple(d.id for d in mesh.devices.flat))
-    fn = _MESH_COMPILE_CACHE.get(cache_key)
-    if fn is None:
+    cached = _MESH_COMPILE_CACHE.get(cache_key)
+    if cached is None:
         if len(_MESH_COMPILE_CACHE) >= 256:
             _MESH_COMPILE_CACHE.clear()
         fn = jax.jit(
@@ -97,15 +111,26 @@ def execute_on_mesh(
                 run,
                 mesh=mesh,
                 in_specs=(in_specs,),
-                out_specs=P(),
+                out_specs=(P(), P(), P(AXIS)),
                 check_rep=False,
             )
         )
-        _MESH_COMPILE_CACHE[cache_key] = fn
-    out, any_overflow = fn(stacked_inputs)
+        cached = (fn, overflow_names, metric_names)
+        _MESH_COMPILE_CACHE[cache_key] = cached
+    fn, overflow_names, metric_names = cached
+    out, any_overflow, mvec = fn(stacked_inputs)
     if check_overflow and bool(any_overflow):
         raise RuntimeError(
             f"exchange/hash capacity overflow on mesh (nodes: "
             f"{overflow_names}); re-plan with larger capacities"
         )
+    if metrics_store is not None:
+        import numpy as np_
+
+        m = np_.asarray(mvec)  # [T, M]
+        for t in range(m.shape[0]):
+            node_metrics: dict = {}
+            for (nid, name), v in zip(metric_names, m[t]):
+                node_metrics.setdefault(nid, {})[name] = int(v)
+            metrics_store.insert(f"task{t}", node_metrics)
     return out

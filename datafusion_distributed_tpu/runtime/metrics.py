@@ -1,0 +1,83 @@
+"""Metrics collection + explain_analyze rendering.
+
+The reference collects DataFusion per-node metrics on workers, protobuf-ships
+them to the coordinator's MetricsStore, and `explain_analyze` stitches them
+back into the plan display labeled by task
+(`/root/reference/src/metrics/task_metrics_rewriter.rs`,
+`stage.rs display_plan_ascii`). TPU twist: metrics inside a jitted program
+must be *traced outputs*, so operators record row-count scalars into the
+ExecContext during tracing and the executors return them alongside the
+result; host-side wall-clock and bytes metrics attach per task afterwards.
+
+Formats mirror DistributedMetricsFormat::{Aggregated, PerTask}.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from datafusion_distributed_tpu.plan.physical import ExecutionPlan
+
+
+@dataclass
+class MetricsStore:
+    """(task_label -> node_id -> {metric: value}); the watch-map analogue of
+    the reference's MetricsStore (`metrics_store.rs`)."""
+
+    per_task: dict = field(default_factory=dict)
+
+    def insert(self, task_label: str, node_metrics: dict) -> None:
+        self.per_task[task_label] = node_metrics
+
+    def aggregated(self) -> dict:
+        """node_id -> {metric: summed value across tasks}."""
+        out: dict = {}
+        for metrics in self.per_task.values():
+            for nid, mm in metrics.items():
+                slot = out.setdefault(nid, {})
+                for name, v in mm.items():
+                    slot[name] = slot.get(name, 0) + v
+        return out
+
+    def per_task_view(self) -> dict:
+        """node_id -> {metric_taskN: value} (PerTask format)."""
+        out: dict = {}
+        for label, metrics in sorted(self.per_task.items()):
+            for nid, mm in metrics.items():
+                slot = out.setdefault(nid, {})
+                for name, v in mm.items():
+                    slot[f"{name}_{label}"] = v
+        return out
+
+
+def explain_analyze(
+    plan: ExecutionPlan,
+    store: MetricsStore,
+    per_task: bool = False,
+) -> str:
+    """Render the plan tree with metrics stitched into each node line."""
+    node_metrics = store.per_task_view() if per_task else store.aggregated()
+    lines = []
+
+    def walk(node: ExecutionPlan, indent: int) -> None:
+        mm = node_metrics.get(node.node_id, {})
+        suffix = ""
+        if mm:
+            inner = ", ".join(f"{k}={_fmt(v)}" for k, v in sorted(mm.items()))
+            suffix = f"  [{inner}]"
+        marker = ""
+        if getattr(node, "is_exchange", False):
+            marker = f" ── stage {node.stage_id}"
+        lines.append("  " * indent + node.display() + marker + suffix)
+        for c in node.children():
+            walk(c, indent + 1)
+
+    walk(plan, 0)
+    return "\n".join(lines)
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.4f}"
+    return str(v)

@@ -1,0 +1,183 @@
+"""Worker runtime: plan hosting, task registry, execution service.
+
+The reference's worker (`/root/reference/src/worker/worker_service.rs`) is a
+gRPC service holding a TTL cache of `TaskKey -> TaskData`, a per-query
+session builder, plan hooks, and the ExecuteTask data plane. This is the
+TPU-native equivalent for the host runtime tier: inside a mesh no worker
+objects exist at all (the SPMD program IS the stage execution); workers come
+into play across meshes/hosts, where each worker owns a device (or mesh) and
+the coordinator moves stage outputs between them.
+
+Transport-agnostic by design: `Worker` is plain Python called in-process
+(the InMemoryChannelResolver analogue); `runtime/grpc_worker.py` wraps the
+same object behind gRPC for multi-host deployments.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from datafusion_distributed_tpu.ops.table import Table
+from datafusion_distributed_tpu.plan.physical import (
+    DistributedTaskContext,
+    ExecContext,
+    ExecutionPlan,
+)
+from datafusion_distributed_tpu.runtime.codec import TableStore, decode_plan
+from datafusion_distributed_tpu.runtime.errors import (
+    WorkerError,
+    wrap_worker_exception,
+)
+
+
+@dataclass(frozen=True)
+class TaskKey:
+    """(query, stage, task) addressing — the reference's `TaskKey`
+    (`worker.proto`)."""
+
+    query_id: str
+    stage_id: int
+    task_number: int
+
+
+@dataclass
+class TaskData:
+    """Per-task state (the reference's `task_data.rs`): the decoded plan plus
+    temporal metrics for observability."""
+
+    key: TaskKey
+    plan: ExecutionPlan
+    task_count: int
+    plan_added_at: float = field(default_factory=time.time)
+    executed_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    metrics: dict = field(default_factory=dict)
+
+
+class TaskRegistry:
+    """TTL cache of TaskData (the moka TTI cache, `worker_service.rs:26,39`:
+    entries idle longer than `ttl_seconds` are evicted so abandoned queries
+    cannot leak plans/buffers)."""
+
+    def __init__(self, ttl_seconds: float = 600.0):
+        self.ttl = ttl_seconds
+        self._entries: dict[TaskKey, tuple[float, TaskData]] = {}
+        self._lock = threading.Lock()
+
+    def put(self, data: TaskData) -> None:
+        with self._lock:
+            self._evict()
+            self._entries[data.key] = (time.time(), data)
+
+    def get(self, key: TaskKey) -> Optional[TaskData]:
+        with self._lock:
+            self._evict()
+            hit = self._entries.get(key)
+            if hit is None:
+                return None
+            ts, data = hit
+            if time.time() - ts > self.ttl:
+                del self._entries[key]
+                return None
+            self._entries[key] = (time.time(), data)  # touch (TTI semantics)
+            return data
+
+    def invalidate(self, key: TaskKey) -> None:
+        with self._lock:
+            self._entries.pop(key, None)
+
+    def _evict(self) -> None:
+        now = time.time()
+        dead = [k for k, (ts, _) in self._entries.items() if now - ts > self.ttl]
+        for k in dead:
+            del self._entries[k]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+class Worker:
+    """One worker = one executor endpoint.
+
+    API mirrors the reference service surface (`worker_service.rs`):
+      set_plan     <- CoordinatorChannel SetPlanRequest
+      execute_task <- ExecuteTask
+      get_info     <- GetWorkerInfo (version checks for rolling upgrades)
+    """
+
+    def __init__(
+        self,
+        url: str = "mem://worker",
+        ttl_seconds: float = 600.0,
+        version: str = "0.1.0",
+        on_plan: Optional[Callable[[ExecutionPlan, TaskKey], ExecutionPlan]] = None,
+    ):
+        self.url = url
+        self.version = version
+        self.registry = TaskRegistry(ttl_seconds)
+        self.on_plan = on_plan
+        self.table_store = TableStore()
+
+    # -- control plane ------------------------------------------------------
+    def set_plan(self, key: TaskKey, plan_obj: dict, task_count: int) -> None:
+        try:
+            plan = decode_plan(plan_obj, self.table_store)
+            if self.on_plan is not None:
+                plan = self.on_plan(plan, key)
+        except Exception as e:  # structured propagation to the coordinator
+            raise wrap_worker_exception(e, self.url, key) from e
+        self.registry.put(TaskData(key=key, plan=plan, task_count=task_count))
+
+    # -- data plane ---------------------------------------------------------
+    def execute_task(self, key: TaskKey) -> Table:
+        data = self.registry.get(key)
+        if data is None:
+            raise WorkerError(
+                f"no plan for task {key} (expired or never set)",
+                worker_url=self.url,
+                task=key,
+            )
+        data.executed_at = time.time()
+        try:
+            from datafusion_distributed_tpu.plan.physical import execute_plan
+            from datafusion_distributed_tpu.runtime.metrics import MetricsStore
+
+            store = MetricsStore()
+            out = execute_plan(
+                data.plan,
+                DistributedTaskContext(key.task_number, data.task_count),
+                metrics_store=store,
+                task_label=f"task{key.task_number}",
+                use_cache=False,  # freshly decoded plans never hit the cache
+            )
+            data.metrics["nodes"] = store.per_task.get(
+                f"task{key.task_number}", {}
+            )
+        except WorkerError:
+            raise
+        except Exception as e:
+            raise wrap_worker_exception(e, self.url, key) from e
+        data.finished_at = time.time()
+        data.metrics["rows_out"] = int(out.num_rows)
+        data.metrics["elapsed_s"] = data.finished_at - data.executed_at
+        return out
+
+    # -- observability ------------------------------------------------------
+    def get_info(self) -> dict:
+        return {"url": self.url, "version": self.version,
+                "tasks_cached": len(self.registry)}
+
+    def task_progress(self, key: TaskKey) -> Optional[dict]:
+        data = self.registry.get(key)
+        if data is None:
+            return None
+        return {
+            "plan_added_at": data.plan_added_at,
+            "executed_at": data.executed_at,
+            "finished_at": data.finished_at,
+            **data.metrics,
+        }

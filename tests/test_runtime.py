@@ -1,0 +1,208 @@
+"""Coordinator/worker runtime tests (in-memory cluster).
+
+The reference's integration tier (SURVEY.md §4): plan shipping, task
+registry TTL, structured error propagation, distributed-vs-single parity
+through the worker path.
+"""
+
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from datafusion_distributed_tpu.io.parquet import arrow_to_table
+from datafusion_distributed_tpu.ops.aggregate import AggSpec
+from datafusion_distributed_tpu.ops.sort import SortKey
+from datafusion_distributed_tpu.plan.physical import (
+    HashAggregateExec,
+    MemoryScanExec,
+    SortExec,
+)
+from datafusion_distributed_tpu.planner.distributed import (
+    DistributedConfig,
+    distribute_plan,
+)
+from datafusion_distributed_tpu.runtime.codec import (
+    TableStore,
+    decode_plan,
+    decode_table,
+    encode_plan,
+    encode_table,
+)
+from datafusion_distributed_tpu.runtime.coordinator import (
+    Coordinator,
+    InMemoryCluster,
+)
+from datafusion_distributed_tpu.runtime.errors import WorkerError
+from datafusion_distributed_tpu.runtime.worker import (
+    TaskKey,
+    TaskRegistry,
+    TaskData,
+    Worker,
+)
+
+NT = 4
+
+
+def _cluster(n=3):
+    c = InMemoryCluster(n)
+    return Coordinator(resolver=c, channels=c)
+
+
+def sample_plan(n=2000, seed=0):
+    rng = np.random.default_rng(seed)
+    arrow = pa.table({"k": rng.integers(0, 25, n), "v": rng.normal(size=n)})
+    t = arrow_to_table(arrow)
+    scan = MemoryScanExec([t], t.schema())
+    agg = HashAggregateExec(
+        "single", ["k"],
+        [AggSpec("sum", "v", "sv"), AggSpec("count_star", None, "n")],
+        scan,
+    )
+    return SortExec([SortKey("k")], agg), arrow
+
+
+def test_codec_roundtrip():
+    plan, _ = sample_plan(100)
+    dplan = distribute_plan(plan, DistributedConfig(num_tasks=NT))
+    store = TableStore()
+    obj = encode_plan(dplan, store)
+    import json
+
+    json.dumps({k: v for k, v in obj.items() if k != "tables"})  # JSON-able
+    back = decode_plan(obj, store)
+    assert back.display_tree().replace(" ", "") != ""
+    # same structure
+    assert type(back).__name__ == type(dplan).__name__
+    assert len(back.collect(lambda n: True)) == len(dplan.collect(lambda n: True))
+
+
+def test_table_ipc_roundtrip():
+    arrow = pa.table({"a": [1, 2, None], "s": ["x", None, "z"]})
+    t = arrow_to_table(arrow)
+    data = encode_table(t)
+    assert isinstance(data, bytes) and len(data) > 0
+    back = decode_table(data)
+    assert back.to_pandas()["a"].fillna(-1).tolist() == [1, 2, -1]
+    assert back.to_pandas()["s"].fillna("@").tolist() == ["x", "@", "z"]
+
+
+def test_coordinator_executes_distributed_plan():
+    plan, arrow = sample_plan()
+    dplan = distribute_plan(plan, DistributedConfig(num_tasks=NT))
+    coord = _cluster(3)
+    out = coord.execute(dplan).to_pandas()
+    exp = (
+        arrow.to_pandas().groupby("k")
+        .agg(sv=("v", "sum"), n=("v", "size")).reset_index()
+        .sort_values("k").reset_index(drop=True)
+    )
+    np.testing.assert_array_equal(out["k"], exp["k"])
+    np.testing.assert_allclose(out["sv"], exp["sv"], rtol=1e-9)
+    np.testing.assert_array_equal(out["n"], exp["n"])
+    # metrics were collected per task
+    assert len(coord.metrics) > 0
+    assert all("elapsed_s" in m for m in coord.metrics.values())
+
+
+def test_task_registry_ttl():
+    reg = TaskRegistry(ttl_seconds=0.05)
+    key = TaskKey("q", 0, 0)
+    reg.put(TaskData(key=key, plan=None, task_count=1))
+    assert reg.get(key) is not None
+    time.sleep(0.08)
+    reg.put(TaskData(key=TaskKey("q2", 0, 0), plan=None, task_count=1))  # evicts
+    assert reg.get(key) is None
+
+
+def test_worker_error_propagation():
+    w = Worker("mem://w0")
+    key = TaskKey("q", 0, 0)
+    with pytest.raises(WorkerError) as ei:
+        w.execute_task(key)
+    assert "no plan" in str(ei.value)
+    assert ei.value.worker_url == "mem://w0"
+    # structured round trip
+    d = ei.value.to_dict()
+    back = WorkerError.from_dict(d)
+    assert back.worker_url == "mem://w0"
+    assert back.task == key
+
+
+def test_worker_on_plan_hook():
+    seen = []
+
+    def hook(plan, key):
+        seen.append(key)
+        return plan
+
+    cluster = InMemoryCluster(2)
+    for w in cluster.workers.values():
+        w.on_plan = hook
+    coord = Coordinator(resolver=cluster, channels=cluster)
+    plan, arrow = sample_plan(500)
+    dplan = distribute_plan(plan, DistributedConfig(num_tasks=2))
+    coord.execute(dplan)
+    assert len(seen) > 0
+
+
+def test_sql_through_coordinator():
+    from datafusion_distributed_tpu.sql.context import DataFrame, SessionContext
+
+    rng = np.random.default_rng(5)
+    ctx = SessionContext()
+    ctx.register_arrow("f", pa.table({
+        "k": rng.integers(0, 10, 1000), "v": rng.normal(size=1000)}))
+    ctx.register_arrow("d", pa.table({"k": np.arange(10),
+                                      "w": rng.normal(size=10)}))
+    sql = ("select f.k, sum(f.v + d.w) s from f, d where f.k = d.k "
+           "group by f.k order by f.k")
+    df = ctx.sql(sql)
+    single = df.to_pandas()
+    dplan = df.distributed_plan(NT)
+    out = DataFrame._strip_quals(_cluster(2).execute(dplan)).to_pandas()
+    np.testing.assert_array_equal(out["k"], single["k"])
+    np.testing.assert_allclose(out["s"], single["s"], rtol=1e-9)
+
+
+def test_metrics_and_explain_analyze():
+    from datafusion_distributed_tpu.plan.physical import execute_plan
+    from datafusion_distributed_tpu.runtime.metrics import (
+        MetricsStore,
+        explain_analyze,
+    )
+
+    plan, arrow = sample_plan(300, seed=9)
+    store = MetricsStore()
+    execute_plan(plan, metrics_store=store, task_label="task0")
+    text = explain_analyze(plan, store)
+    assert "output_rows=" in text
+    assert "Sort" in text and "HashAggregate" in text
+    # aggregated rows of the scan must equal the input row count
+    agg = store.aggregated()
+    scan_id = plan.collect(lambda n: not n.children())[0].node_id
+    assert agg[scan_id]["output_rows"] == 300
+    # PerTask format labels metrics with the task
+    per = explain_analyze(plan, store, per_task=True)
+    assert "output_rows_task0=" in per
+
+
+def test_mesh_metrics_per_task():
+    from datafusion_distributed_tpu.runtime.mesh_executor import (
+        execute_on_mesh,
+        make_mesh,
+    )
+    from datafusion_distributed_tpu.runtime.metrics import MetricsStore
+
+    plan, arrow = sample_plan(800, seed=11)
+    dplan = distribute_plan(plan, DistributedConfig(num_tasks=4))
+    store = MetricsStore()
+    mesh = make_mesh(4)
+    execute_on_mesh(dplan, mesh, metrics_store=store)
+    assert len(store.per_task) == 4
+    # scan rows across tasks sum to the input size
+    agg = store.aggregated()
+    scans = dplan.collect(lambda n: not n.children())
+    total = sum(agg.get(s.node_id, {}).get("output_rows", 0) for s in scans)
+    assert total == 800
