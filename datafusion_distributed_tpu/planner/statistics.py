@@ -1,0 +1,199 @@
+"""Cost model: symbolic per-operator complexity evaluated against statistics.
+
+The reference's `src/distributed_planner/statistics/` builds symbolic
+complexity expressions per operator (Constant/Linear/Log/Plus/Multiply,
+`complexity.rs:3-33`), evaluates them against plan statistics into a
+`Cost{cpu, memory, network}` in bytes (`cost.rs`), with Trino-style
+per-datatype width estimates (`default_bytes_for_datatype.rs`). The adaptive
+planner sizes stage task counts from that cost (`prepare_dynamic_plan.rs`).
+
+Same architecture here, adapted to the TPU operator set: the CPU dimension
+becomes "device work" (rows processed through fused kernels), memory is
+padded HBM bytes (capacity-based, matching our static-shape model), and
+network is ICI/DCN bytes crossing exchanges (broadcast multiplies by the
+consumer task count exactly like `complexity_network.rs`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from datafusion_distributed_tpu.plan.exchanges import (
+    BroadcastExchangeExec,
+    CoalesceExchangeExec,
+    PartitionReplicatedExec,
+    ShuffleExchangeExec,
+)
+from datafusion_distributed_tpu.plan.joins import CrossJoinExec, HashJoinExec, UnionExec
+from datafusion_distributed_tpu.plan.physical import (
+    ExecutionPlan,
+    FilterExec,
+    HashAggregateExec,
+    LimitExec,
+    MemoryScanExec,
+    ParquetScanExec,
+    ProjectionExec,
+    SortExec,
+)
+from datafusion_distributed_tpu.schema import DataType, Schema
+
+
+# Trino-style per-datatype byte widths (default_bytes_for_datatype.rs)
+_BYTES = {
+    DataType.INT32: 4,
+    DataType.INT64: 8,
+    DataType.FLOAT32: 4,
+    DataType.FLOAT64: 8,
+    DataType.BOOL: 1,
+    DataType.DATE32: 4,
+    DataType.STRING: 16,  # dictionary code + amortized dictionary share
+}
+
+
+def row_width(schema: Schema) -> int:
+    return sum(_BYTES[f.dtype] + (1 if f.nullable else 0) for f in schema.fields)
+
+
+@dataclass
+class Complexity:
+    """Symbolic complexity: cost = constant + linear*n + nlogn*n*log2(n)."""
+
+    constant: float = 0.0
+    linear: float = 0.0
+    nlogn: float = 0.0
+
+    def evaluate(self, n: float) -> float:
+        import math
+
+        logn = math.log2(max(n, 2.0))
+        return self.constant + self.linear * n + self.nlogn * n * logn
+
+    def __add__(self, other: "Complexity") -> "Complexity":
+        return Complexity(
+            self.constant + other.constant,
+            self.linear + other.linear,
+            self.nlogn + other.nlogn,
+        )
+
+
+@dataclass
+class Cost:
+    """Device work / HBM / interconnect, all in bytes (cost.rs analogue)."""
+
+    compute: float = 0.0
+    memory: float = 0.0
+    network: float = 0.0
+
+    def __add__(self, other: "Cost") -> "Cost":
+        return Cost(
+            self.compute + other.compute,
+            self.memory + other.memory,
+            self.network + other.network,
+        )
+
+
+@dataclass
+class PlanStatistics:
+    """Estimated (or sampled) row counts per node, keyed by node_id; the
+    runtime-statistics attachment point for the adaptive planner."""
+
+    rows: dict  # node_id -> float estimated rows
+
+    def rows_of(self, node: ExecutionPlan, default: float) -> float:
+        return self.rows.get(node.node_id, default)
+
+
+def estimate_rows(plan: ExecutionPlan, stats: Optional[PlanStatistics] = None) -> float:
+    """Bottom-up cardinality estimate (CardinalityEffect analogue: filters
+    shrink, joins keep the probe side, aggregates dedupe)."""
+    if stats is not None and plan.node_id in stats.rows:
+        return stats.rows[plan.node_id]
+    if isinstance(plan, (MemoryScanExec,)):
+        return float(sum(int(t.num_rows) for t in plan.tasks))
+    if isinstance(plan, ParquetScanExec):
+        return float(plan.capacity)
+    if isinstance(plan, FilterExec):
+        return estimate_rows(plan.child, stats) / 3.0
+    if isinstance(plan, (ProjectionExec, LimitExec)):
+        child = plan.children()[0]
+        n = estimate_rows(child, stats)
+        if isinstance(plan, LimitExec):
+            return min(n, float(plan.fetch))
+        return n
+    if isinstance(plan, HashAggregateExec):
+        n = estimate_rows(plan.child, stats)
+        return max(n ** 0.5, 1.0) if plan.group_names else 1.0
+    if isinstance(plan, HashJoinExec):
+        p = estimate_rows(plan.probe, stats)
+        if plan.join_type in ("semi", "anti"):
+            return p / 2.0
+        return p
+    if isinstance(plan, CrossJoinExec):
+        return estimate_rows(plan.left, stats) * estimate_rows(plan.right, stats)
+    if isinstance(plan, UnionExec):
+        return sum(estimate_rows(c, stats) for c in plan.children())
+    if isinstance(plan, SortExec):
+        n = estimate_rows(plan.child, stats)
+        return min(n, float(plan.fetch)) if plan.fetch else n
+    if plan.children():
+        return max(estimate_rows(c, stats) for c in plan.children())
+    return 1000.0
+
+
+def operator_complexity(plan: ExecutionPlan) -> Complexity:
+    """Per-operator symbolic device-work model (complexity_cpu.rs analogue,
+    adapted: hash ops are linear vectorized passes, sorts are n log n)."""
+    if isinstance(plan, (MemoryScanExec, ParquetScanExec)):
+        return Complexity(linear=1.0)
+    if isinstance(plan, (FilterExec, ProjectionExec, LimitExec)):
+        return Complexity(linear=1.0)
+    if isinstance(plan, HashAggregateExec):
+        return Complexity(linear=3.0)  # hash + claim rounds + scatter
+    if isinstance(plan, HashJoinExec):
+        return Complexity(linear=4.0)  # build + probe + expand + gather
+    if isinstance(plan, CrossJoinExec):
+        return Complexity(linear=8.0)
+    if isinstance(plan, SortExec):
+        return Complexity(nlogn=1.0)
+    return Complexity(linear=1.0)
+
+
+def calculate_cost(
+    plan: ExecutionPlan, stats: Optional[PlanStatistics] = None
+) -> Cost:
+    """Total cost of a (sub)plan: the `calculate_cost` entry point
+    (cost.rs:27). Exchange nodes contribute network bytes; broadcast
+    multiplies by consumer task count (complexity_network.rs)."""
+    total = Cost()
+    for c in plan.children():
+        total = total + calculate_cost(c, stats)
+    n = estimate_rows(plan, stats)
+    width = row_width(plan.schema())
+    work = operator_complexity(plan).evaluate(n) * width
+    mem = float(plan.output_capacity()) * width
+    net = 0.0
+    if isinstance(plan, ShuffleExchangeExec):
+        net = n * width
+    elif isinstance(plan, BroadcastExchangeExec):
+        net = n * width * plan.num_tasks
+    elif isinstance(plan, (CoalesceExchangeExec,)):
+        net = n * width * plan.num_tasks  # all_gather implementation
+    elif isinstance(plan, PartitionReplicatedExec):
+        net = 0.0
+    return total + Cost(compute=work, memory=mem, network=net)
+
+
+def compute_based_task_count(
+    cost: Cost,
+    bytes_per_task_per_second: float,
+    max_tasks: int,
+    target_seconds: float = 1.0,
+) -> int:
+    """Adaptive task sizing (prepare_dynamic_plan.rs:60-69 analogue):
+    tasks = ceil(compute_bytes / bytes_per_task_per_second / target) clamped
+    to [1, max_tasks]."""
+    import math
+
+    t = math.ceil(cost.compute / max(bytes_per_task_per_second, 1.0) / target_seconds)
+    return max(1, min(t, max_tasks))

@@ -174,6 +174,60 @@ class Coordinator:
         return out
 
 
+class AdaptiveCoordinator(Coordinator):
+    """Dynamic-planning coordinator (the reference's `dynamic_task_count`
+    mode): consumer stages are re-sized from the EXACT LoadInfo of their
+    materialized inputs before execution — planning and execution interleave
+    (`prepare_dynamic_plan.rs`), with real statistics instead of samples."""
+
+    def __post_init_adaptive(self):
+        pass
+
+    def execute(self, plan: ExecutionPlan) -> Table:
+        self._load_info: dict[int, object] = {}
+        return super().execute(plan)
+
+    def _materialize_exchanges(self, plan, query_id):
+        resolved = super()._materialize_exchanges(plan, query_id)
+        return resolved
+
+    def _run_stage_task(self, stage_plan, query_id, stage_id, task_number,
+                        task_count):
+        info = self._stage_input_info(stage_plan)
+        if info is not None:
+            from datafusion_distributed_tpu.planner.adaptive import (
+                resize_for_inputs,
+            )
+
+            stage_plan = resize_for_inputs(stage_plan, info)
+        out = super()._run_stage_task(
+            stage_plan, query_id, stage_id, task_number, task_count
+        )
+        return out
+
+    def _stage_input_info(self, stage_plan):
+        from datafusion_distributed_tpu.planner.adaptive import (
+            LoadInfo,
+            collect_load_info,
+        )
+
+        scans = [
+            n for n in stage_plan.collect(lambda n: not n.children())
+            if isinstance(n, MemoryScanExec)
+        ]
+        if not scans:
+            return None
+        merged: Optional[LoadInfo] = None
+        for s in scans:
+            info = self._load_info.get(s.node_id)
+            if info is None:
+                info = collect_load_info(s.tasks)
+                self._load_info[s.node_id] = info
+            if merged is None or info.rows > merged.rows:
+                merged = info
+        return merged
+
+
 def _task_specialized(plan: ExecutionPlan, task_number: int) -> ExecutionPlan:
     """Ship only this task's leaf slice (the reference strips other tasks'
     DistributedLeaf variants before sending, `query_coordinator.rs:346-382`).

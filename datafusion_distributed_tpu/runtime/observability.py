@@ -1,0 +1,101 @@
+"""Observability service: cluster discovery, task progress, system metrics.
+
+The reference runs a separate gRPC ObservabilityService with `Ping`,
+`GetTaskProgress` (per-task partition completion + output rows) and
+`GetClusterWorkers`, plus optional 100 ms RSS/CPU sampling
+(`/root/reference/src/observability/service.rs`). Host-runtime equivalent
+over the in-process (or gRPC-wrapped) worker objects; system metrics read
+/proc directly (no sysinfo dependency).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class SystemMetrics:
+    rss_bytes: int = 0
+    cpu_seconds: float = 0.0
+    sampled_at: float = 0.0
+
+
+def sample_system_metrics() -> SystemMetrics:
+    rss = 0
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        rss = pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        pass
+    cpu = 0.0
+    try:
+        cpu = sum(os.times()[:2])
+    except OSError:
+        pass
+    return SystemMetrics(rss_bytes=rss, cpu_seconds=cpu, sampled_at=time.time())
+
+
+class SystemMetricsSampler:
+    """Background sampler (the reference samples every 100 ms under the
+    `system-metrics` feature)."""
+
+    def __init__(self, interval_s: float = 0.1):
+        self.interval = interval_s
+        self.latest = sample_system_metrics()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "SystemMetricsSampler":
+        def loop():
+            while not self._stop.wait(self.interval):
+                self.latest = sample_system_metrics()
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=1.0)
+
+
+class ObservabilityService:
+    """Ping / GetTaskProgress / GetClusterWorkers over a worker cluster."""
+
+    def __init__(self, resolver, channels, sample_system: bool = False):
+        self.resolver = resolver
+        self.channels = channels
+        self.sampler = SystemMetricsSampler().start() if sample_system else None
+
+    def ping(self) -> dict:
+        return {"ok": True, "ts": time.time()}
+
+    def get_cluster_workers(self) -> list[dict]:
+        out = []
+        for url in self.resolver.get_urls():
+            try:
+                info = self.channels.get_worker(url).get_info()
+            except Exception as e:
+                info = {"url": url, "error": str(e)}
+            out.append(info)
+        return out
+
+    def get_task_progress(self, keys) -> dict:
+        """TaskKey list -> progress dicts from whichever worker holds each."""
+        out = {}
+        for key in keys:
+            for url in self.resolver.get_urls():
+                p = self.channels.get_worker(url).task_progress(key)
+                if p is not None:
+                    out[key] = {**p, "worker": url}
+                    break
+        return out
+
+    def system_metrics(self) -> Optional[SystemMetrics]:
+        return self.sampler.latest if self.sampler else None

@@ -36,6 +36,7 @@ from datafusion_distributed_tpu.sql.logical import Binder, LogicalPlan
 from datafusion_distributed_tpu.sql.parser import (
     CreateView,
     DropView,
+    SetOption,
     parse_statements,
 )
 from datafusion_distributed_tpu.sql.planner import PhysicalPlanner, PlannerConfig
@@ -69,10 +70,32 @@ class Catalog:
 class SessionConfig:
     planner: PlannerConfig = None  # type: ignore[assignment]
     overflow_retries: int = 3
+    # `SET distributed.<key> = <value>` overrides, applied when building the
+    # DistributedConfig (the reference's ConfigExtension with prefix
+    # "distributed"; coordinator->worker propagation rides the plan codec)
+    distributed_options: dict = None  # type: ignore[assignment]
+    # user headers forwarded verbatim to workers (auth etc.; the
+    # passthrough_headers analogue)
+    passthrough_headers: dict = None  # type: ignore[assignment]
 
     def __post_init__(self):
         if self.planner is None:
             self.planner = PlannerConfig()
+        if self.distributed_options is None:
+            self.distributed_options = {}
+        if self.passthrough_headers is None:
+            self.passthrough_headers = {}
+
+    def set_option(self, name: str, value) -> None:
+        scope, _, key = name.partition(".")
+        if scope == "distributed":
+            self.distributed_options[key] = value
+        elif scope == "planner":
+            if not hasattr(self.planner, key):
+                raise ValueError(f"unknown planner option {key!r}")
+            setattr(self.planner, key, value)
+        else:
+            raise ValueError(f"unknown option scope {scope!r}")
 
 
 class DataFrame:
@@ -148,7 +171,14 @@ class DataFrame:
             distribute_plan,
         )
 
-        cfg = config or DistributedConfig(num_tasks=num_tasks)
+        if config is None:
+            opts = {
+                k: v for k, v in self.ctx.config.distributed_options.items()
+                if k in DistributedConfig.__dataclass_fields__
+            }
+            opts.setdefault("num_tasks", num_tasks)
+            config = DistributedConfig(**opts)
+        cfg = config
         pcfg = planner_config or self.ctx.config.planner
         key = ("dist", cfg.num_tasks, cfg.shuffle_skew_factor,
                cfg.broadcast_threshold_rows, pcfg.join_expansion_factor,
@@ -275,11 +305,15 @@ class SessionContext:
             elif isinstance(stmt, DropView):
                 views.pop(stmt.name.lower(), None)
                 self.catalog.views.pop(stmt.name.lower(), None)
+            elif isinstance(stmt, SetOption):
+                self.config.set_option(stmt.name, stmt.value)
             else:
                 binder = Binder(_ViewCatalog(self.catalog, views), views)
                 result = DataFrame(self, binder.bind(stmt))
         if result is None:
-            raise ValueError("no SELECT statement in input")
+            if stmts:
+                return None  # DDL/SET-only script
+            raise ValueError("no SQL statements in input")
         return result
 
 

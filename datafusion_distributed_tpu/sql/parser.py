@@ -867,6 +867,15 @@ class DropView:
     name: str
 
 
+@dataclass
+class SetOption:
+    """SET <scope>.<key> = <value> (the reference supports
+    `SET distributed.max_tasks_per_stage = 4` via ConfigExtension)."""
+
+    name: str  # dotted, e.g. "distributed.broadcast_joins"
+    value: Any
+
+
 def parse_sql(sql: str):
     return Parser(sql).parse_query()
 
@@ -895,6 +904,24 @@ def parse_statements(sql: str) -> list:
             p.next()
             _expect_word(p, "view")
             out.append(DropView(p._ident_name()))
+        elif p.peek().kind == "ident" and p.peek().value.lower() == "set":
+            p.next()
+            parts = [p._ident_name()]
+            while p.eat_sym("."):
+                parts.append(p._ident_name())
+            p.expect_sym("=")
+            t = p.next()
+            if t.kind == "number":
+                v: Any = float(t.value) if "." in t.value else int(t.value)
+            elif t.kind == "string":
+                v = t.value
+            elif t.kind == "kw" and t.value in ("true", "false"):
+                v = t.value == "true"
+            elif t.kind == "ident" and t.value.lower() in ("true", "false"):
+                v = t.value.lower() == "true"
+            else:
+                p.error("expected literal value in SET")
+            out.append(SetOption(".".join(parts), v))
         else:
             p.error("expected statement")
         while p.eat_sym(";"):

@@ -206,3 +206,36 @@ def test_mesh_metrics_per_task():
     scans = dplan.collect(lambda n: not n.children())
     total = sum(agg.get(s.node_id, {}).get("output_rows", 0) for s in scans)
     assert total == 800
+
+
+def test_observability_service():
+    from datafusion_distributed_tpu.runtime.observability import (
+        ObservabilityService,
+        sample_system_metrics,
+    )
+
+    cluster = InMemoryCluster(2)
+    coord = Coordinator(resolver=cluster, channels=cluster)
+    plan, _ = sample_plan(300)
+    dplan = distribute_plan(plan, DistributedConfig(num_tasks=2))
+    coord.execute(dplan)
+    obs = ObservabilityService(cluster, cluster)
+    assert obs.ping()["ok"]
+    workers = obs.get_cluster_workers()
+    assert len(workers) == 2 and all("version" in w for w in workers)
+    m = sample_system_metrics()
+    assert m.rss_bytes > 0
+
+
+def test_set_option_flows_to_distributed_config():
+    from datafusion_distributed_tpu.sql.context import SessionContext
+
+    ctx = SessionContext()
+    ctx.register_arrow("t", pa.table({"k": [1, 2, 3], "v": [1.0, 2.0, 3.0]}))
+    assert ctx.sql("set distributed.broadcast_joins = false") is None
+    assert ctx.config.distributed_options["broadcast_joins"] is False
+    df = ctx.sql("select k from t where v > 1 order by k")
+    dplan = df.distributed_plan(2)
+    assert dplan is not None
+    ctx.sql("set planner.join_expansion_factor = 2.0")
+    assert ctx.config.planner.join_expansion_factor == 2.0
