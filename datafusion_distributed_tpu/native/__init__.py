@@ -1,0 +1,170 @@
+"""ctypes bindings to the native host data plane (see src/dftpu.cpp).
+
+Compiled lazily with g++ on first use; falls back cleanly (callers check
+`available()`) when no toolchain exists. The hash here is bit-identical to
+ops/hash.py so host-side and in-mesh shuffles co-locate keys identically.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from datafusion_distributed_tpu.schema import DataType
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src", "dftpu.cpp")
+_SO = os.path.join(_HERE, "libdftpu.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+_HASH_FILE = _SO + ".sha256"
+
+
+def _src_hash() -> str:
+    import hashlib
+
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        with open(_HASH_FILE, "w") as f:
+            f.write(_src_hash())
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        # staleness by content hash, not mtime: a checked-out tree can't be
+        # trusted to have meaningful mtimes, and a stale binary would break
+        # the bit-identical-hash guarantee with the device kernel
+        current = None
+        if os.path.exists(_HASH_FILE):
+            with open(_HASH_FILE) as f:
+                current = f.read().strip()
+        needs_build = not os.path.exists(_SO) or current != _src_hash()
+        if needs_build and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.dftpu_hash_rows.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p),
+            np.ctypeslib.ndpointer(np.int32),
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.c_int32,
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.uint32),
+        ]
+        lib.dftpu_shuffle_dest.argtypes = [
+            np.ctypeslib.ndpointer(np.uint32),
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_int32,
+            np.ctypeslib.ndpointer(np.int32),
+            np.ctypeslib.ndpointer(np.int64),
+        ]
+        lib.dftpu_bucket_indices.argtypes = [
+            np.ctypeslib.ndpointer(np.int32),
+            ctypes.c_int64,
+            ctypes.c_int32,
+            np.ctypeslib.ndpointer(np.int64),
+            np.ctypeslib.ndpointer(np.int64),
+            np.ctypeslib.ndpointer(np.int64),
+        ]
+        lib.dftpu_version.restype = ctypes.c_int32
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def hash_rows(cols: list[np.ndarray], valids: list[Optional[np.ndarray]],
+              dtypes: list[DataType]) -> np.ndarray:
+    """Combined uint32 hash, bit-identical to ops.hash.hash_columns."""
+    lib = _load()
+    assert lib is not None
+    n = len(cols[0])
+    payloads = []
+    kinds = np.zeros(len(cols), dtype=np.int32)
+    for i, (c, dt) in enumerate(zip(cols, dtypes)):
+        if dt in (DataType.INT64,):
+            payloads.append(np.ascontiguousarray(c, dtype=np.int64))
+            kinds[i] = 0
+        elif dt == DataType.FLOAT64:
+            payloads.append(
+                np.ascontiguousarray(c, dtype=np.float64).view(np.int64)
+            )
+            kinds[i] = 0
+        elif dt == DataType.FLOAT32:
+            bits = np.ascontiguousarray(c, dtype=np.float32).view(np.uint32)
+            payloads.append(bits.astype(np.int64))
+            kinds[i] = 1
+        else:  # int32 / date32 / bool / dict codes: astype(uint32) semantics
+            u = np.ascontiguousarray(c).astype(np.int64)
+            payloads.append(u & np.int64(0xFFFFFFFF))
+            kinds[i] = 1
+    col_ptrs = (ctypes.c_void_p * len(cols))(
+        *[p.ctypes.data_as(ctypes.c_void_p) for p in payloads]
+    )
+    vbufs = []
+    vptrs = (ctypes.c_void_p * len(cols))()
+    for i, v in enumerate(valids):
+        if v is None:
+            vptrs[i] = None
+        else:
+            vb = np.ascontiguousarray(v, dtype=np.uint8)
+            vbufs.append(vb)
+            vptrs[i] = vb.ctypes.data_as(ctypes.c_void_p).value
+    out = np.empty(n, dtype=np.uint32)
+    lib.dftpu_hash_rows(col_ptrs, kinds, vptrs, len(cols), n, out)
+    return out
+
+
+def shuffle_buckets(
+    hash_: np.ndarray, live: Optional[np.ndarray], parts: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """-> (offsets[parts+1], indices[sum(counts)], counts[parts]): CSR of row
+    indices per destination bucket."""
+    lib = _load()
+    assert lib is not None
+    n = len(hash_)
+    dest = np.empty(n, dtype=np.int32)
+    counts = np.empty(parts, dtype=np.int64)
+    live_ptr = None
+    if live is not None:
+        live8 = np.ascontiguousarray(live, dtype=np.uint8)
+        live_ptr = live8.ctypes.data_as(ctypes.c_void_p)
+    lib.dftpu_shuffle_dest(
+        np.ascontiguousarray(hash_, dtype=np.uint32), live_ptr, n, parts,
+        dest, counts,
+    )
+    offsets = np.empty(parts + 1, dtype=np.int64)
+    indices = np.empty(int(counts.sum()), dtype=np.int64)
+    lib.dftpu_bucket_indices(dest, n, parts, counts, offsets, indices)
+    return offsets, indices, counts

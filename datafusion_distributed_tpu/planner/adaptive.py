@@ -125,9 +125,13 @@ def resize_for_inputs(
         children = [walk(c) for c in node.children()]
         node = node.with_new_children(children) if children else node
         if isinstance(node, HashAggregateExec) and node.group_names:
+            # NDV of a derived/renamed group column isn't in the LoadInfo;
+            # the exact input row count is always a safe upper bound
             ndv = 1
             for g in node.group_names:
-                ndv *= max(input_info.ndv.get(g, 64), 1)
+                ndv *= max(
+                    input_info.ndv.get(g, max(input_info.rows, 1)), 1
+                )
             ndv = min(ndv, max(input_info.rows, 1))
             node = HashAggregateExec(
                 node.mode, node.group_names, node.aggs, node.child,

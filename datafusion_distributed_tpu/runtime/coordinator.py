@@ -257,11 +257,31 @@ def _shuffle_regroup(
 ) -> list[Table]:
     """Host-side hash regroup between stages. Uses the SAME hash as the
     in-mesh kernel so a query may mix mesh-internal and cross-mesh shuffles
-    and keys still co-locate."""
+    and keys still co-locate. Prefers the native (C++) data plane for the
+    hash + CSR bucket build (native/), falling back to device ops."""
+    from datafusion_distributed_tpu import native
+
     buckets: list[list[Table]] = [[] for _ in range(num_tasks)]
     for out in outputs:
         cols = [out.column(k).data for k in key_names]
         valids = [out.column(k).validity for k in key_names]
+        if native.available():
+            np_cols = [np.asarray(c) for c in cols]
+            np_valids = [
+                np.asarray(v) if v is not None else None for v in valids
+            ]
+            dtypes = [out.column(k).dtype for k in key_names]
+            h = native.hash_rows(np_cols, np_valids, dtypes)
+            live = np.arange(out.capacity) < int(out.num_rows)
+            offsets, indices, counts = native.shuffle_buckets(
+                h, live, num_tasks
+            )
+            for j in range(num_tasks):
+                rows = indices[offsets[j] : offsets[j + 1]]
+                idx = jnp.zeros(out.capacity, dtype=jnp.int32)
+                idx = idx.at[: len(rows)].set(jnp.asarray(rows, dtype=jnp.int32))
+                buckets[j].append(out.gather(idx, len(rows)))
+            continue
         h = hash_columns(cols, valids)
         dest = (h % np.uint32(num_tasks)).astype(jnp.int32)
         live = out.row_mask()

@@ -1,0 +1,266 @@
+"""gRPC transport for the worker service (multi-host deployments).
+
+The reference's workers are tonic gRPC services speaking a protobuf contract
+(`/root/reference/src/worker/worker.proto`: CoordinatorChannel, ExecuteTask,
+GetWorkerInfo) with Arrow Flight framing on the data plane. Here the same
+worker object (runtime/worker.py) is exposed over gRPC generic handlers:
+
+    control plane: SetPlan (plan JSON + shipped table slices as Arrow IPC)
+    data plane:    ExecuteTask -> Arrow IPC stream bytes
+    observability: GetInfo / TaskProgress
+
+`GrpcWorkerClient` implements the same duck-typed surface as `Worker`, so
+the Coordinator runs unchanged over in-process or remote workers — the
+LocalWorkerConnection-vs-RemoteWorkerConnection duality of the reference
+(`worker_connection_pool.rs:48-60`). `start_localhost_cluster` is the
+`start_localhost_context` test fixture: real sockets, one process.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from concurrent import futures
+from typing import Optional
+
+from datafusion_distributed_tpu.ops.table import Table
+from datafusion_distributed_tpu.runtime.codec import (
+    TableStore,
+    collect_table_ids,
+    decode_table,
+    encode_table,
+)
+from datafusion_distributed_tpu.runtime.errors import (
+    WorkerError,
+    wrap_worker_exception,
+)
+from datafusion_distributed_tpu.runtime.worker import TaskKey, Worker
+
+_SERVICE = "dftpu.Worker"
+
+
+def _key_to_obj(key: TaskKey) -> list:
+    return [key.query_id, key.stage_id, key.task_number]
+
+
+def _key_from_obj(o) -> TaskKey:
+    return TaskKey(o[0], o[1], o[2])
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+
+def _handlers(worker: Worker):
+    import grpc
+
+    def set_plan(request: bytes, context) -> bytes:
+        msg = json.loads(request.decode())
+        key = _key_from_obj(msg["key"])
+        try:
+            # materialize shipped table slices into the worker's store
+            for tid, b64 in msg.get("tables", {}).items():
+                table = decode_table(base64.b64decode(b64))
+                worker.table_store.tables[tid] = table
+            worker.set_plan(key, msg["plan"], msg["task_count"])
+            return json.dumps({"ok": True}).encode()
+        except WorkerError as e:
+            return json.dumps({"error": e.to_dict()}).encode()
+        except Exception as e:  # structured contract for transport errors too
+            return json.dumps(
+                {"error": wrap_worker_exception(e, worker.url, key).to_dict()}
+            ).encode()
+
+    def execute_task(request: bytes, context) -> bytes:
+        msg = json.loads(request.decode())
+        key = _key_from_obj(msg["key"])
+        try:
+            out = worker.execute_task(key)
+            # progress rides the response: the registry entry is invalidated
+            # below, so a later TaskProgress call couldn't see it
+            progress = worker.task_progress(key)
+            payload = base64.b64encode(encode_table(out)).decode()
+            return json.dumps(
+                {"table": payload, "progress": progress}
+            ).encode()
+        except WorkerError as e:
+            return json.dumps({"error": e.to_dict()}).encode()
+        except Exception as e:
+            return json.dumps(
+                {"error": wrap_worker_exception(e, worker.url, key).to_dict()}
+            ).encode()
+        finally:
+            worker.registry.invalidate(key)
+            worker.table_store.remove(msg.get("table_ids", []))
+
+    def get_info(request: bytes, context) -> bytes:
+        return json.dumps(worker.get_info()).encode()
+
+    def task_progress(request: bytes, context) -> bytes:
+        msg = json.loads(request.decode())
+        p = worker.task_progress(_key_from_obj(msg["key"]))
+        return json.dumps({"progress": p}).encode()
+
+    rpcs = {
+        "SetPlan": set_plan,
+        "ExecuteTask": execute_task,
+        "GetInfo": get_info,
+        "TaskProgress": task_progress,
+    }
+    method_handlers = {
+        name: grpc.unary_unary_rpc_method_handler(
+            fn, request_deserializer=None, response_serializer=None
+        )
+        for name, fn in rpcs.items()
+    }
+    return grpc.method_handlers_generic_handler(_SERVICE, method_handlers)
+
+
+def serve_worker(worker: Worker, port: int = 0, host: str = "0.0.0.0"):
+    """-> (grpc.Server, bound_port). Unlimited message sizes, matching the
+    reference's into_worker_server (`worker_service.rs:127-158`). Binds to
+    all interfaces by default (multi-host); pass host="127.0.0.1" for a
+    loopback-only fixture."""
+    import grpc
+
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=8),
+        options=[
+            ("grpc.max_receive_message_length", -1),
+            ("grpc.max_send_message_length", -1),
+        ],
+    )
+    server.add_generic_rpc_handlers((_handlers(worker),))
+    bound = server.add_insecure_port(f"{host}:{port}")
+    server.start()
+    return server, bound
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+
+class GrpcWorkerClient:
+    """Duck-typed as `Worker` for the Coordinator: set_plan / execute_task /
+    get_info / task_progress / table_store / registry."""
+
+    def __init__(self, url: str):
+        import grpc
+
+        self.url = url
+        target = url.removeprefix("grpc://")
+        self._channel = grpc.insecure_channel(
+            target,
+            options=[
+                ("grpc.max_receive_message_length", -1),
+                ("grpc.max_send_message_length", -1),
+            ],
+        )
+        self.table_store = TableStore()  # filled by encode_plan pre-flight
+        self.registry = _NullRegistry()
+        self._shipped_ids: dict[TaskKey, list] = {}
+        self._progress_cache: dict[TaskKey, Optional[dict]] = {}
+
+    def _call(self, method: str, payload: dict) -> dict:
+        rpc = self._channel.unary_unary(
+            f"/{_SERVICE}/{method}",
+            request_serializer=None,
+            response_deserializer=None,
+        )
+        resp = rpc(json.dumps(payload).encode())
+        msg = json.loads(resp.decode())
+        if "error" in msg:
+            raise WorkerError.from_dict(msg["error"])
+        return msg
+
+    def set_plan(self, key: TaskKey, plan_obj: dict, task_count: int) -> None:
+        tids = collect_table_ids(plan_obj)
+        tables = {
+            tid: base64.b64encode(
+                encode_table(self.table_store.get(tid))
+            ).decode()
+            for tid in tids
+        }
+        self._shipped_ids[key] = tids
+        self._call(
+            "SetPlan",
+            {
+                "key": _key_to_obj(key),
+                "plan": plan_obj,
+                "task_count": task_count,
+                "tables": tables,
+            },
+        )
+        # local copies served their purpose once serialized
+        self.table_store.remove(tids)
+
+    def execute_task(self, key: TaskKey) -> Table:
+        msg = self._call(
+            "ExecuteTask",
+            {
+                "key": _key_to_obj(key),
+                "table_ids": self._shipped_ids.pop(key, []),
+            },
+        )
+        # server invalidates its registry after the call; progress rides the
+        # response and is served from this cache
+        self._progress_cache[key] = msg.get("progress")
+        return decode_table(base64.b64decode(msg["table"]))
+
+    def get_info(self) -> dict:
+        return self._call("GetInfo", {})
+
+    def task_progress(self, key: TaskKey):
+        if key in self._progress_cache:
+            return self._progress_cache[key]
+        return self._call("TaskProgress", {"key": _key_to_obj(key)}).get(
+            "progress"
+        )
+
+
+class _NullRegistry:
+    """The server invalidates its own registry; the client has nothing to
+    clean (Coordinator calls registry.invalidate uniformly)."""
+
+    def invalidate(self, key) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# localhost cluster fixture
+# ---------------------------------------------------------------------------
+
+
+class GrpcCluster:
+    """N gRPC workers on random localhost ports, one process — the
+    `start_localhost_context` analogue (`src/test_utils/localhost.rs`)."""
+
+    def __init__(self, num_workers: int, ttl_seconds: float = 600.0):
+        self.servers = []
+        self.urls = []
+        self._clients: dict[str, GrpcWorkerClient] = {}
+        for i in range(num_workers):
+            w = Worker(url=f"grpc-local-{i}", ttl_seconds=ttl_seconds)
+            server, port = serve_worker(w)
+            url = f"grpc://127.0.0.1:{port}"
+            w.url = url
+            self.servers.append(server)
+            self.urls.append(url)
+
+    def get_urls(self):
+        return list(self.urls)
+
+    def get_worker(self, url: str) -> GrpcWorkerClient:
+        if url not in self._clients:
+            self._clients[url] = GrpcWorkerClient(url)
+        return self._clients[url]
+
+    def shutdown(self) -> None:
+        for s in self.servers:
+            s.stop(grace=None)
+
+
+def start_localhost_cluster(num_workers: int) -> GrpcCluster:
+    return GrpcCluster(num_workers)
