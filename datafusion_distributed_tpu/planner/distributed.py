@@ -156,6 +156,19 @@ def _inject(plan: ExecutionPlan, cfg: DistributedConfig):
             right = BroadcastExchangeExec(right, t)
         return plan.with_new_children([left, right]), ldist
 
+    from datafusion_distributed_tpu.plan.window_exec import WindowExec
+
+    if isinstance(plan, WindowExec):
+        child, dist = _inject(plan.child, cfg)
+        if dist == Distribution.REPLICATED:
+            return plan.with_new_children([child]), dist
+        if plan.partition_names:
+            # rows of one window partition must land on one task
+            shuffled = _mk_shuffle(child, plan.partition_names, cfg)
+            return plan.with_new_children([shuffled]), Distribution.PARTITIONED
+        gathered = CoalesceExchangeExec(child, t)
+        return plan.with_new_children([gathered]), Distribution.REPLICATED
+
     if isinstance(plan, SortExec):
         child, dist = _inject(plan.child, cfg)
         if dist == Distribution.REPLICATED:

@@ -272,6 +272,19 @@ def encode_plan(p: ExecutionPlan, store: TableStore) -> dict:
     if isinstance(p, UnionExec):
         return {"t": "union",
                 "cs": [encode_plan(c, store) for c in p.children()]}
+    from datafusion_distributed_tpu.plan.window_exec import WindowExec
+
+    if isinstance(p, WindowExec):
+        return {
+            "t": "window",
+            "funcs": [[f.func, f.input_name, f.output_name, f.frame]
+                      for f in p.funcs],
+            "partitions": p.partition_names,
+            "orders": [[k.name, k.ascending, k.nulls_first]
+                       for k in p.order_keys],
+            "fields": encode_schema(Schema(p.out_fields)),
+            "c": encode_plan(p.child, store),
+        }
     if isinstance(p, ShuffleExchangeExec):
         return {"t": "shuffle", "keys": p.key_names, "tasks": p.num_tasks,
                 "per_dest": p.per_dest_capacity, "stage": p.stage_id,
@@ -347,6 +360,17 @@ def decode_plan(o: dict, store: TableStore) -> ExecutionPlan:
                              decode_plan(o["r"], store), o["out_cap"])
     if t == "union":
         return UnionExec([decode_plan(c, store) for c in o["cs"]])
+    if t == "window":
+        from datafusion_distributed_tpu.ops.window import WindowFunc
+        from datafusion_distributed_tpu.plan.window_exec import WindowExec
+
+        return WindowExec(
+            decode_plan(o["c"], store),
+            [WindowFunc(*args) for args in o["funcs"]],
+            o["partitions"],
+            [SortKey(n, a, nf) for n, a, nf in o["orders"]],
+            list(decode_schema(o["fields"]).fields),
+        )
     if t == "shuffle":
         n = ShuffleExchangeExec(decode_plan(o["c"], store), o["keys"],
                                 o["tasks"], o["per_dest"])

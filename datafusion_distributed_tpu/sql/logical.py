@@ -185,6 +185,46 @@ class LJoin(LogicalPlan):
 
 
 @dataclass
+class LWindowExpr:
+    func: str  # rank|dense_rank|row_number|sum|avg|min|max|count|count_star
+    arg: Optional[pe.PhysicalExpr]
+    partition_by: list  # [PhysicalExpr]
+    order_by: list  # [(PhysicalExpr, ascending, nulls_first|None)]
+    name: str
+    frame: str = "range"
+
+
+@dataclass
+class LWindow(LogicalPlan):
+    """Window evaluation: appends one column per LWindowExpr (post-GROUP BY,
+    pre-final-projection — standard SQL evaluation order)."""
+
+    exprs: list  # [LWindowExpr]
+    child: LogicalPlan
+
+    def schema(self):
+        fields = list(self.child.schema().fields)
+        cs = self.child.schema()
+        for w in self.exprs:
+            fields.append(Field(w.name, _window_dtype(w, cs), True))
+        return Schema(fields)
+
+    def children(self):
+        return [self.child]
+
+    def display(self):
+        inner = ", ".join(f"{w.func}() AS {w.name}" for w in self.exprs)
+        return f"Window [{inner}]"
+
+
+def _window_dtype(w: LWindowExpr, cs: Schema) -> DataType:
+    from datafusion_distributed_tpu.ops.window import window_output_dtype
+
+    input_dtype = w.arg.output_field(cs).dtype if w.arg is not None else None
+    return window_output_dtype(w.func, input_dtype)
+
+
+@dataclass
 class LSort(LogicalPlan):
     keys: list  # [(PhysicalExpr, ascending, nulls_first|None)]
     child: LogicalPlan
@@ -978,12 +1018,15 @@ class Binder:
     def _bind_projection_and_aggregates(self, q: ast.Query, plan, scope,
                                         outer_refs) -> LogicalPlan:
         agg_calls = []
+        window_calls = []
         for item in q.select_items:
             _collect_agg_calls(item.expr, agg_calls)
+            _collect_window_calls(item.expr, window_calls)
         if q.having is not None:
             _collect_agg_calls(q.having, agg_calls)
         for o in q.order_by:
             _collect_agg_calls(o.expr, agg_calls)
+            _collect_window_calls(o.expr, window_calls)
 
         has_group = bool(q.group_by)
         has_aggs = bool(agg_calls)
@@ -1031,6 +1074,13 @@ class Binder:
                     e, scope, group_lookup, agg_map, select_aliases
                 )
 
+            result: LogicalPlan = agg_plan
+            if q.having is not None:
+                result = LFilter(rebind(q.having), result)
+            self._window_map = {}
+            if window_calls:
+                result = self._build_windows(window_calls, result, rebind)
+
             out_exprs = []
             out_names = []
             for idx, item in enumerate(q.select_items):
@@ -1039,9 +1089,6 @@ class Binder:
                 name = item.alias or _display_name(item.expr, idx)
                 out_exprs.append(rebind(item.expr))
                 out_names.append(name)
-            result: LogicalPlan = agg_plan
-            if q.having is not None:
-                result = LFilter(rebind(q.having), result)
             # structural fingerprints of select items -> output names
             out_fps = {
                 _ast_fingerprint(item.expr): name
@@ -1078,10 +1125,17 @@ class Binder:
             return plan2
 
         # no aggregation
+        self._window_map = {}
+        star_schema = plan.schema()  # pre-window: __wN stays internal
+        if window_calls:
+            plan = self._build_windows(
+                window_calls, plan,
+                lambda e: self._bind_expr(e, scope, outer_refs),
+            )
         out = []
         for idx, item in enumerate(q.select_items):
             if isinstance(item.expr, ast.Star):
-                for f in plan.schema().fields:
+                for f in star_schema.fields:
                     short = f.name.split(".")[-1]
                     if item.expr.qualifier and not f.name.startswith(
                         item.expr.qualifier + "."
@@ -1091,19 +1145,68 @@ class Binder:
                 continue
             name = item.alias or _display_name(item.expr, idx)
             out.append((self._bind_expr(item.expr, scope, outer_refs), name))
-        result = LProject(out, plan)
+        out_names = [n for _, n in out]
+        sort_keys = []
+        hidden: list = []
         if q.order_by:
-            result = self._bind_order_by(
-                q, result,
-                lambda e: self._bind_order_expr_plain(
-                    e, scope, outer_refs, out, select_aliases
-                ),
-            )
+            for o in q.order_by:
+                e = self._bind_order_expr_plain(
+                    o.expr, scope, outer_refs, out, select_aliases
+                )
+                # sort keys referencing columns (incl. window __wN) that the
+                # projection would drop ride through as hidden columns
+                for cname in _collect_col_names([e]):
+                    if cname not in out_names and cname not in (
+                        n for _, n in hidden
+                    ):
+                        hidden.append((pe.Col(cname), cname))
+                sort_keys.append((e, o.ascending, o.nulls_first))
+        result = LProject(out + hidden, plan)
+        if sort_keys:
+            result = LSort(sort_keys, result, fetch=_sort_fetch(q))
+        if hidden:
+            result = LProject([(pe.Col(n), n) for n in out_names], result)
         if q.distinct:
             result = LDistinct(result)
         if q.limit is not None or q.offset is not None:
             result = LLimit(result, q.limit, q.offset or 0)
         return result
+
+    def _build_windows(self, window_calls, plan, bind_fn) -> LogicalPlan:
+        """Materialize window calls as __wN columns via an LWindow node;
+        records id(call) -> name in self._window_map for later rebinding."""
+        wexprs = []
+        for j, wc in enumerate(window_calls):
+            name = f"__w{j}"
+            func = wc.name
+            if func not in _AGG_FUNCS | _WINDOW_ONLY_FUNCS:
+                raise BindError(f"unsupported window function {func}")
+            if wc.distinct:
+                raise BindError(
+                    f"DISTINCT is not supported in window function {func}"
+                )
+            arg = None
+            if func in _AGG_FUNCS:
+                if wc.args and isinstance(wc.args[0], ast.Star):
+                    func = "count_star"
+                elif not wc.args:
+                    if func == "count":
+                        func = "count_star"
+                    else:
+                        raise BindError(f"window {func} needs an argument")
+                else:
+                    arg = bind_fn(wc.args[0])
+            partitions = [bind_fn(p) for p in wc.over.partition_by]
+            orders = [
+                (bind_fn(o.expr), o.ascending, o.nulls_first)
+                for o in wc.over.order_by
+            ]
+            wexprs.append(
+                LWindowExpr(func, arg, partitions, orders, name,
+                            frame=wc.over.frame)
+            )
+            self._window_map[id(wc)] = name
+        return LWindow(wexprs, plan)
 
     def _bind_order_by(self, q, plan, bind_fn) -> LogicalPlan:
         keys = []
@@ -1154,6 +1257,9 @@ class Binder:
     def _bind_post_agg(self, e, scope, group_lookup, agg_map, select_aliases):
         """Bind an expression over the aggregate's output: aggregate calls map
         to their output columns, group-expr subtrees map to group columns."""
+        wm = getattr(self, "_window_map", {})
+        if id(e) in wm:
+            return pe.Col(wm[id(e)])
         fp = _ast_fingerprint(e)
         if fp in group_lookup:
             return pe.Col(group_lookup[fp])
@@ -1340,6 +1446,13 @@ class Binder:
             sub = Binder(self.catalog, self.ctes)._bind_query(e.query, None)
             return ScalarSubqueryExpr(sub)
         if isinstance(e, ast.FuncCall):
+            wm = getattr(self, "_window_map", {})
+            if id(e) in wm:
+                return pe.Col(wm[id(e)])
+            if e.over is not None:
+                raise BindError(
+                    f"window function {e.name} not allowed in this context"
+                )
             if e.name in _AGG_FUNCS:
                 raise BindError(
                     f"aggregate {e.name} not allowed in this context"
@@ -1385,6 +1498,18 @@ class ScalarSubqueryExpr(pe.PhysicalExpr):
 # ---------------------------------------------------------------------------
 
 _AGG_FUNCS = {"sum", "count", "min", "max", "avg"}
+_WINDOW_ONLY_FUNCS = {"rank", "dense_rank", "row_number"}
+
+
+def _collect_window_calls(node, out: list) -> None:
+    if isinstance(node, ast.FuncCall) and node.over is not None:
+        out.append(node)
+        _AGG_ID_REGISTRY[id(node)] = node
+        return
+    if isinstance(node, (ast.ScalarSubquery, ast.Exists, ast.InSubquery)):
+        return
+    for ch in _ast_children(node):
+        _collect_window_calls(ch, out)
 _AGG_ID_REGISTRY: dict[int, Any] = {}
 
 
@@ -1394,6 +1519,16 @@ def _agg_parts(call: ast.FuncCall):
 
 
 def _collect_agg_calls(node, out: list) -> None:
+    if isinstance(node, ast.FuncCall) and node.over is not None:
+        # a window call is NOT a group aggregate, but its argument and spec
+        # may contain ones (sum(sum(x)) over (partition by ...))
+        for a in node.args:
+            _collect_agg_calls(a, out)
+        for p in node.over.partition_by:
+            _collect_agg_calls(p, out)
+        for o in node.over.order_by:
+            _collect_agg_calls(o.expr, out)
+        return
     if isinstance(node, ast.FuncCall) and node.name in _AGG_FUNCS:
         out.append(node)
         _AGG_ID_REGISTRY[id(node)] = node

@@ -59,10 +59,18 @@ class Star:
 
 
 @dataclass
+class WindowSpec:
+    partition_by: list
+    order_by: list  # [OrderItem]
+    frame: str = "range"  # "range" (default, peers share) | "rows"
+
+
+@dataclass
 class FuncCall:
     name: str
     args: list
     distinct: bool = False
+    over: Optional["WindowSpec"] = None  # window function when set
 
 
 @dataclass
@@ -410,9 +418,12 @@ class Parser:
         while self.at_kw("union", "except"):
             op = self.next().value
             all_ = self.eat_kw("all")
+            # a directly-parenthesized arm keeps its own ORDER BY/LIMIT
+            arm_paren = self.at_sym("(")
             rhs = self._intersect_chain(ctes)
             q = SetOp(op, all_, q, rhs, ctes=ctes)
-            q = self._hoist_trailing_clauses(q, rhs)
+            if isinstance(rhs, Query) and not arm_paren:
+                q = self._hoist_trailing_clauses(q, rhs)
         # ORDER BY / LIMIT can follow a set op chain
         if self.at_kw("order"):
             q.order_by = self._order_by()
@@ -424,14 +435,54 @@ class Parser:
             q.ctes = ctes
         return q
 
+    def _consume_frame_bounds(self) -> str:
+        """Consume `BETWEEN <bound> AND <bound>` or `<bound>`. Only the
+        UNBOUNDED-PRECEDING..CURRENT-ROW shape is supported (the default
+        running frame); anything else raises."""
+
+        def bound() -> str:
+            t = self.next()
+            w = t.value.lower()
+            if w == "unbounded":
+                d = self.next().value.lower()
+                return f"unbounded {d}"
+            if w == "current":
+                self.next()  # ROW
+                return "current row"
+            self.error(f"unsupported window frame bound {w!r}")
+
+        if self.eat_kw("between"):
+            lo = bound()
+            self.expect_kw("and")
+            hi = bound()
+        else:
+            lo, hi = bound(), "current row"
+        if lo != "unbounded preceding" or hi not in (
+            "current row", "unbounded following",
+        ):
+            self.error(f"unsupported window frame {lo} .. {hi}")
+        return hi
+
+    def _select_or_paren(self):
+        """A set-operation arm: SELECT ... or a parenthesized query.
+        -> (query, parenthesized): ORDER BY/LIMIT inside parens belong to
+        the arm and must NOT be hoisted to the enclosing set op."""
+        if self.at_sym("("):
+            self.next()
+            q = self._query()
+            self.expect_sym(")")
+            return q, True
+        return self._select(), False
+
     def _intersect_chain(self, ctes):
-        q = self._select()
+        q, _ = self._select_or_paren()
         while self.at_kw("intersect"):
             self.next()
             all_ = self.eat_kw("all")
-            rhs = self._select()
+            rhs, paren = self._select_or_paren()
             q = SetOp("intersect", all_, q, rhs, ctes=ctes)
-            q = self._hoist_trailing_clauses(q, rhs)
+            if isinstance(rhs, Query) and not paren:
+                q = self._hoist_trailing_clauses(q, rhs)
         return q
 
     @staticmethod
@@ -826,7 +877,33 @@ class Parser:
                     while self.eat_sym(","):
                         args.append(self._expr())
                 self.expect_sym(")")
-                return FuncCall(name.lower(), args, distinct)
+                over = None
+                if self.peek().kind == "ident" and self.peek().value.lower() == "over":
+                    self.next()
+                    self.expect_sym("(")
+                    partition_by: list = []
+                    order_by: list = []
+                    if self.peek().kind == "ident" and (
+                        self.peek().value.lower() == "partition"
+                    ):
+                        self.next()
+                        self.expect_kw("by")
+                        partition_by.append(self._expr())
+                        while self.eat_sym(","):
+                            partition_by.append(self._expr())
+                    if self.at_kw("order"):
+                        order_by = self._order_by()
+                    frame = "range"
+                    if self.peek().kind == "ident" and self.peek().value.lower() in (
+                        "rows", "range",
+                    ):
+                        frame = self.next().value.lower()
+                        hi = self._consume_frame_bounds()
+                        if hi == "unbounded following":
+                            frame = "full"  # whole-partition frame
+                    self.expect_sym(")")
+                    over = WindowSpec(partition_by, order_by, frame)
+                return FuncCall(name.lower(), args, distinct, over)
             # qualified identifier?
             if self.at_sym(".") :
                 self.next()

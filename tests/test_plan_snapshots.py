@@ -1,0 +1,118 @@
+"""Distributed plan snapshot tests.
+
+The reference asserts full ASCII stage trees for representative query shapes
+(`distributed_query_planner.rs:135+` insta snapshots, plus the per-suite
+tpch/tpcds/clickbench plan tests). Same idea: the staged plan's structure is
+asserted as text, with volatile values (capacities, slot counts) normalized
+away — mirroring their UUID/byte-range snapshot filters
+(`test_utils/insta.rs`)."""
+
+import re
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from datafusion_distributed_tpu.data.tpchgen import register_tpch
+from datafusion_distributed_tpu.sql.context import SessionContext
+
+
+def normalize(tree: str) -> str:
+    """Strip volatile numbers: capacities, slots, per-dest sizes."""
+    tree = re.sub(r"cap=\d+", "cap=N", tree)
+    tree = re.sub(r"slots=\d+", "slots=N", tree)
+    tree = re.sub(r"per_dest_cap=\d+", "per_dest_cap=N", tree)
+    tree = re.sub(r"out_cap=\d+", "out_cap=N", tree)
+    tree = re.sub(r"files=\d+", "files=N", tree)
+    return tree
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    c = SessionContext()
+    register_tpch(c, sf=0.001, seed=0)
+    return c
+
+
+def test_aggregate_plan_shape(ctx):
+    tree = normalize(ctx.sql(
+        "select l_returnflag, sum(l_quantity) q from lineitem "
+        "group by l_returnflag order by l_returnflag"
+    ).explain_distributed(4))
+    assert tree == """\
+Sort: [l_returnflag ASC]
+  CoalesceExchange tasks=4 ── stage 1 boundary
+    Sort: [l_returnflag ASC]
+      Projection: __g0 AS l_returnflag, __a0 AS q
+        HashAggregate mode=final gby=[__g0] aggs=[sum(__in___a0)] slots=N
+          ShuffleExchange keys=[__g0] tasks=4 per_dest_cap=N ── stage 0 boundary
+            HashAggregate mode=partial gby=[__g0] aggs=[sum(__in___a0)] slots=N
+              Projection: lineitem.l_returnflag AS __g0, lineitem.l_quantity AS __in___a0
+                Projection: l_quantity AS lineitem.l_quantity, l_returnflag AS lineitem.l_returnflag
+                  MemoryScan tasks=4 cap=N"""
+
+
+def test_broadcast_join_plan_shape(ctx):
+    tree = normalize(ctx.sql(
+        "select n_name, count(*) c from supplier, nation "
+        "where s_nationkey = n_nationkey group by n_name"
+    ).explain_distributed(4))
+    # small build side -> broadcast exchange, no probe shuffle below the join
+    assert "BroadcastExchange tasks=4" in tree
+    assert tree.count("ShuffleExchange") == 1  # only the aggregate shuffle
+    assert "HashJoin inner" in tree
+
+
+def test_global_aggregate_plan_shape(ctx):
+    tree = normalize(ctx.sql(
+        "select sum(l_extendedprice * l_discount) as revenue from lineitem "
+        "where l_quantity < 24"
+    ).explain_distributed(8))
+    assert tree == """\
+Projection: __a0 AS revenue
+  HashAggregate mode=final gby=[] aggs=[sum(__in___a0)] slots=N
+    CoalesceExchange tasks=8 ── stage 0 boundary
+      HashAggregate mode=partial gby=[] aggs=[sum(__in___a0)] slots=N
+        Projection: (lineitem.l_extendedprice * lineitem.l_discount) AS __in___a0
+          Filter: (lineitem.l_quantity < 24)
+            Projection: l_quantity AS lineitem.l_quantity, l_extendedprice AS lineitem.l_extendedprice, l_discount AS lineitem.l_discount
+              MemoryScan tasks=8 cap=N"""
+
+
+def test_topk_pushdown_below_coalesce(ctx):
+    tree = normalize(ctx.sql(
+        "select o_orderkey from orders order by o_totalprice desc limit 5"
+    ).explain_distributed(4))
+    # local top-k under the coalesce boundary, final sort above
+    below = tree.split("── stage")[1]
+    assert "Sort" in below and "fetch=5" in below
+
+
+def test_semi_join_plan_shapes(ctx):
+    sql = ("select o_orderpriority, count(*) c from orders where exists ("
+           "  select 1 from lineitem where l_orderkey = o_orderkey"
+           ") group by o_orderpriority")
+    # small build at SF0.001 -> broadcast
+    tree = normalize(ctx.sql(sql).explain_distributed(4))
+    assert "HashJoin semi" in tree
+    assert "BroadcastExchange" in tree
+    # with broadcast disabled both sides co-shuffle on the join key
+    from datafusion_distributed_tpu.planner.distributed import DistributedConfig
+
+    df = ctx.sql(sql)
+    dplan = df.distributed_plan(
+        4, DistributedConfig(num_tasks=4, broadcast_joins=False)
+    )
+    from datafusion_distributed_tpu.planner.distributed import display_staged_plan
+
+    tree2 = normalize(display_staged_plan(dplan))
+    semi_part = tree2[tree2.index("HashJoin semi"):]
+    assert semi_part.count("ShuffleExchange") >= 2
+
+
+def test_stage_ids_are_stamped(ctx):
+    tree = ctx.sql(
+        "select l_returnflag, count(*) from lineitem group by 1"
+    ).explain_distributed(4)
+    stages = re.findall(r"── stage (\d+)", tree)
+    assert stages and sorted(set(stages)) == sorted(stages)
