@@ -13,9 +13,14 @@ approximation that ignores the reference's fixed per-query overhead, so
 treat it as a trend indicator until SF10 runs land.
 
 Env knobs:
-  BENCH_SF      scale factor (default 0.05; raise on real HBM)
-  BENCH_QUERIES comma list (default: all 22)
-  BENCH_TASKS   mesh size for distributed mode (default 1 = single chip)
+  BENCH_SF       scale factor (default 0.05; raise on real HBM)
+  BENCH_QUERIES  comma list (default: all 22)
+  BENCH_TASKS    mesh size for distributed mode (default 1 = single chip)
+  BENCH_BUDGET_S wall-clock budget in seconds (default 420). XLA compilation
+                 of 22 distinct query programs dominates cold runs; the
+                 harness stops admitting queries near the budget and always
+                 prints its JSON line with however many completed (the query
+                 count is part of the metric name).
 """
 
 import json
@@ -40,6 +45,9 @@ def main() -> None:
         else [f"q{i}" for i in range(1, 23)]
     )
 
+    budget = float(os.environ.get("BENCH_BUDGET_S", "420"))
+    started = time.perf_counter()
+
     ctx = SessionContext()
     register_tpch(ctx, sf=sf, seed=0)
 
@@ -47,24 +55,31 @@ def main() -> None:
     total = 0.0
     per_query = {}
     for q in qlist:
+        if time.perf_counter() - started > budget * 0.85:
+            break  # leave room to report
         path = os.path.join(qdir, f"{q}.sql")
         if not os.path.exists(path):
             continue
         sql = open(path).read()
-        df = ctx.sql(sql)
-        # warm-up run compiles; second run measures steady-state latency
-        # (the reference reports p50 of multiple runs the same way)
-        best = float("inf")
-        for attempt in range(2):
-            t0 = time.perf_counter()
-            if tasks > 1:
-                df.collect_distributed_table(num_tasks=tasks)
-            else:
-                df.collect_table()
-            dt = time.perf_counter() - t0
-            best = min(best, dt)
-        per_query[q] = best
-        total += best
+        try:
+            df = ctx.sql(sql)
+            # warm-up run compiles; second run measures steady-state latency
+            # (the reference reports p50 of multiple runs the same way)
+            best = float("inf")
+            for _attempt in range(2):
+                t0 = time.perf_counter()
+                if tasks > 1:
+                    df.collect_distributed_table(num_tasks=tasks)
+                else:
+                    df.collect_table()
+                dt = time.perf_counter() - t0
+                best = min(best, dt)
+                if time.perf_counter() - started > budget:
+                    break
+            per_query[q] = best
+            total += best
+        except Exception as e:  # a failing query must not eat the report
+            print(f"{q} failed: {type(e).__name__}: {e}", file=sys.stderr)
 
     # Reference baseline: TPC-H SF10 total = 10 s on 12x c5n.2xlarge
     # (BASELINE.md). Normalize by scale factor for a rough ratio until we run

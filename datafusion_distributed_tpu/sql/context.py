@@ -48,6 +48,7 @@ class Catalog:
     def __init__(self) -> None:
         self.tables: dict[str, Table] = {}
         self.views: dict[str, LogicalPlan] = {}
+        self._ndv_cache: dict = {}
 
     def register_table(self, name: str, table: Table) -> None:
         self.tables[name.lower()] = table
@@ -60,6 +61,26 @@ class Catalog:
 
     def table_rows(self, name: str) -> int:
         return int(self.tables[name.lower()].num_rows)
+
+    def column_ndv(self, table: str, column: str):
+        """Exact distinct count, computed once per column (drives the join
+        orderer's fan-out estimates — the statistics the reference gets from
+        DataFusion's table providers)."""
+        key = (table.lower(), column)
+        if key not in self._ndv_cache:
+            import numpy as np
+
+            t = self.tables.get(table.lower())
+            if t is None or column not in t:
+                self._ndv_cache[key] = None
+            else:
+                n = int(t.num_rows)
+                col = t.column(column)
+                vals = np.asarray(col.data[:n])
+                if col.validity is not None:
+                    vals = vals[np.asarray(col.validity[:n])]
+                self._ndv_cache[key] = int(len(np.unique(vals)))
+        return self._ndv_cache[key]
 
     def scan_exec(self, name: str, columns: Sequence[str]) -> ExecutionPlan:
         t = self.tables[name.lower()]
@@ -342,6 +363,11 @@ class _ViewCatalog:
         if name.lower() in self.views:
             return 1000
         return self.catalog.table_rows(name)
+
+    def column_ndv(self, table: str, column: str):
+        if table.lower() in self.views:
+            return None
+        return self.catalog.column_ndv(table, column)
 
     def scan_exec(self, name: str, columns):
         return self.catalog.scan_exec(name, columns)

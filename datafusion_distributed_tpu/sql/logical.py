@@ -305,6 +305,11 @@ class CatalogProtocol:
         """Row-count estimate for join ordering; override when known."""
         return 1000
 
+    def column_ndv(self, table: str, column: str) -> Optional[int]:
+        """Distinct-count estimate for a column (join fan-out estimation);
+        None when unknown."""
+        return None
+
 
 # ---------------------------------------------------------------------------
 # Binder
@@ -577,7 +582,14 @@ class Binder:
                 units.append([uplan, ualiases, urows])
 
         # 4. greedy left-deep join order over units connected by equi edges
-        plan = self._order_joins(units, equi_edges, scope, outer_refs)
+        alias_tables = {}
+        for rplan, alias, _ in relations:
+            node = rplan
+            while isinstance(node, LFilter):
+                node = node.child
+            alias_tables[alias] = node.table if isinstance(node, LScan) else None
+        plan = self._order_joins(units, equi_edges, scope, outer_refs,
+                                 alias_tables)
 
         # 5. residual predicates after joins
         for c in residuals:
@@ -650,12 +662,36 @@ class Binder:
             out = LFilter(self._bind_expr(c, scope, outer_refs), out)
         return out
 
-    def _order_joins(self, units, equi_edges, scope, outer_refs):
+    def _join_fanout(self, edge, ualiases, urows, alias_tables) -> float:
+        """Estimated output rows per probe row if this edge attaches the
+        unit: rows(new) / ndv(new-side key). FK->PK joins (unique key on the
+        new side) give ~1; low-cardinality keys (nationkey=nationkey) give a
+        blow-up factor the orderer must avoid."""
+        la, le, ra, re_ = edge
+        inner_ast = le if la in ualiases else re_
+        if not isinstance(inner_ast, ast.Ident):
+            return 1.0
+        # resolve alias for the ident within the unit
+        alias = inner_ast.qualifier
+        if alias is None:
+            alias = la if la in ualiases else ra
+        table = alias_tables.get(alias)
+        if table is None:
+            return 1.0
+        ndv = self.catalog.column_ndv(table, inner_ast.name)
+        if not ndv:
+            return 1.0
+        return max(float(urows) / float(ndv), 1.0)
+
+    def _order_joins(self, units, equi_edges, scope, outer_refs,
+                     alias_tables=None):
         """Greedily join units (relations or pre-folded outer-join groups):
         probe side = the largest unit (the fact table keeps output
         cardinality bounded by the probe side, which is what the static
-        output-capacity model wants); attach the smallest connected unit
-        first (dims as build sides, left-deep)."""
+        output-capacity model wants); among connected candidates, attach the
+        one with the smallest estimated fan-out first (FK->PK dimension
+        joins before many-to-many edges), breaking ties by unit size."""
+        alias_tables = alias_tables or {}
         units = [list(u) for u in units]
         if len(units) == 1:
             return units[0][0]
@@ -667,20 +703,25 @@ class Binder:
             candidates = []
             for ui, u in enumerate(remaining):
                 _, ualiases, urows = u
+                fanouts = []
                 for e in edges:
                     la, _, ra, _ = e
                     if (la in joined and ra in ualiases) or (
                         ra in joined and la in ualiases
                     ):
-                        candidates.append((urows, ui))
-                        break
+                        fanouts.append(
+                            self._join_fanout(e, ualiases, urows, alias_tables)
+                        )
+                if fanouts:
+                    # several edges bound the fan-out by the most selective
+                    candidates.append((min(fanouts), urows, ui))
             if not candidates:
                 u = remaining.pop(0)
                 plan = LJoin(plan, u[0], "cross", [], [])
                 joined |= u[1]
                 continue
             candidates.sort()
-            _, ui = candidates[0]
+            _, _, ui = candidates[0]
             u = remaining.pop(ui)
             _, ualiases, _ = u
             lkeys, rkeys, rest = [], [], []
