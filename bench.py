@@ -29,10 +29,48 @@ import sys
 import time
 
 
+_PROGRESS = {"per_query": {}, "total": 0.0}  # shared with the watchdog
+
+
+def _report(sf: float, per_query: dict, total: float, suffix: str = "") -> None:
+    baseline_scaled = 10.0 * (sf / 10.0)
+    vs_baseline = baseline_scaled / total if total > 0 else 0.0
+    print(
+        json.dumps(
+            {
+                "metric": f"tpch_sf{sf}_total_wall_clock_"
+                          f"{len(per_query)}q{suffix}",
+                "value": round(total, 4) if per_query else -1,
+                "unit": "seconds",
+                "vs_baseline": round(vs_baseline, 4),
+            }
+        ),
+        flush=True,
+    )
+
+
+def _start_watchdog(deadline_s: float, sf: float) -> None:
+    """The TPU-tunnel backend can block indefinitely inside PJRT client init
+    (observed in this environment); a watchdog guarantees the driver still
+    receives one JSON line, reporting whatever queries completed."""
+    import threading
+
+    def fire():
+        _report(sf, _PROGRESS["per_query"], _PROGRESS["total"],
+                suffix="_incomplete")
+        os._exit(3)
+
+    t = threading.Timer(deadline_s, fire)
+    t.daemon = True
+    t.start()
+
+
 def main() -> None:
     sf = float(os.environ.get("BENCH_SF", "0.05"))
     queries = os.environ.get("BENCH_QUERIES", "")
     tasks = int(os.environ.get("BENCH_TASKS", "1"))
+    budget = float(os.environ.get("BENCH_BUDGET_S", "420"))
+    _start_watchdog(budget + 120.0, sf)
 
     import jax
 
@@ -45,7 +83,6 @@ def main() -> None:
         else [f"q{i}" for i in range(1, 23)]
     )
 
-    budget = float(os.environ.get("BENCH_BUDGET_S", "420"))
     started = time.perf_counter()
 
     ctx = SessionContext()
@@ -53,6 +90,7 @@ def main() -> None:
 
     qdir = "/root/reference/testdata/tpch/queries"
     total = 0.0
+    failed = 0
     per_query = {}
     for q in qlist:
         if time.perf_counter() - started > budget * 0.85:
@@ -76,32 +114,27 @@ def main() -> None:
                 best = min(best, dt)
                 if time.perf_counter() - started > budget:
                     break
+            # note: a query whose second (steady-state) run was cut by the
+            # budget reports its compile-inclusive first run — conservative
             per_query[q] = best
             total += best
+            _PROGRESS["per_query"] = dict(per_query)
+            _PROGRESS["total"] = total
         except Exception as e:  # a failing query must not eat the report
+            failed += 1
             print(f"{q} failed: {type(e).__name__}: {e}", file=sys.stderr)
 
     # Reference baseline: TPC-H SF10 total = 10 s on 12x c5n.2xlarge
-    # (BASELINE.md). Normalize by scale factor for a rough ratio until we run
-    # at SF10: baseline_time_scaled = 10 s * (sf / 10).
-    baseline_scaled = 10.0 * (sf / 10.0)
-    vs_baseline = baseline_scaled / total if total > 0 else 0.0
-
-    print(
-        json.dumps(
-            {
-                "metric": f"tpch_sf{sf}_total_wall_clock_{len(per_query)}q",
-                "value": round(total, 4),
-                "unit": "seconds",
-                "vs_baseline": round(vs_baseline, 4),
-            }
-        )
-    )
+    # (BASELINE.md); vs_baseline linearly scales it to this SF (see module
+    # docstring for caveats).
+    _report(sf, per_query, total)
     if os.environ.get("BENCH_VERBOSE"):
         print(
             json.dumps({k: round(v, 4) for k, v in per_query.items()}),
             file=sys.stderr,
         )
+    if failed and not per_query:
+        sys.exit(2)  # every query failed: not a valid 0-second run
 
 
 if __name__ == "__main__":

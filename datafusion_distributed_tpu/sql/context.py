@@ -74,12 +74,20 @@ class Catalog:
             if t is None or column not in t:
                 self._ndv_cache[key] = None
             else:
-                n = int(t.num_rows)
+                # sample-bounded: the heuristic only needs the order of
+                # magnitude, and a full 60M-row device->host pull at bind
+                # time would eat the benchmark budget
+                n = min(int(t.num_rows), 1 << 20)
+                scale = int(t.num_rows) / max(n, 1)
                 col = t.column(column)
                 vals = np.asarray(col.data[:n])
                 if col.validity is not None:
                     vals = vals[np.asarray(col.validity[:n])]
-                self._ndv_cache[key] = int(len(np.unique(vals)))
+                ndv = int(len(np.unique(vals)))
+                # distinct-on-sample extrapolates only when near-unique
+                if ndv > 0.9 * n:
+                    ndv = int(ndv * scale)
+                self._ndv_cache[key] = ndv
         return self._ndv_cache[key]
 
     def scan_exec(self, name: str, columns: Sequence[str]) -> ExecutionPlan:

@@ -582,12 +582,10 @@ class Binder:
                 units.append([uplan, ualiases, urows])
 
         # 4. greedy left-deep join order over units connected by equi edges
-        alias_tables = {}
-        for rplan, alias, _ in relations:
-            node = rplan
-            while isinstance(node, LFilter):
-                node = node.child
-            alias_tables[alias] = node.table if isinstance(node, LScan) else None
+        alias_tables = {
+            alias: (rplan.table if isinstance(rplan, LScan) else None)
+            for rplan, alias, _ in relations
+        }
         plan = self._order_joins(units, equi_edges, scope, outer_refs,
                                  alias_tables)
 
