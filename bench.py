@@ -65,6 +65,44 @@ def _start_watchdog(deadline_s: float, sf: float) -> None:
     t.start()
 
 
+def _probe_devices(timeout_s: float, sf: float) -> None:
+    """PJRT client init over the TPU tunnel can block forever (observed in
+    rounds 1-2). Probe it on a side thread; on timeout, report a distinct
+    metric so a wedged tunnel is distinguishable from slow queries."""
+    import threading
+
+    import jax
+
+    done = threading.Event()
+    info = {}
+
+    def probe():
+        t0 = time.perf_counter()
+        try:
+            info["devices"] = [str(d) for d in jax.devices()]
+            info["init_s"] = round(time.perf_counter() - t0, 1)
+        except Exception as e:  # pragma: no cover
+            info["error"] = f"{type(e).__name__}: {e}"
+        done.set()
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    if not done.wait(timeout_s):
+        print(
+            json.dumps(
+                {
+                    "metric": f"tpch_sf{sf}_device_init_timeout",
+                    "value": -1,
+                    "unit": "seconds",
+                    "vs_baseline": 0.0,
+                }
+            ),
+            flush=True,
+        )
+        os._exit(4)
+    print(f"device init: {info}", file=sys.stderr, flush=True)
+
+
 def main() -> None:
     sf = float(os.environ.get("BENCH_SF", "0.05"))
     queries = os.environ.get("BENCH_QUERIES", "")
@@ -72,10 +110,14 @@ def main() -> None:
     budget = float(os.environ.get("BENCH_BUDGET_S", "420"))
     _start_watchdog(budget + 120.0, sf)
 
-    import jax
+    # Persistent XLA compile cache: 22 cold query compiles dominate the first
+    # run on a fresh chip; cached programs make repeat runs near-instant.
+    os.environ.setdefault("DFTPU_COMPILE_CACHE", "/root/repo/.xla_cache")
 
     from datafusion_distributed_tpu.data.tpchgen import register_tpch
     from datafusion_distributed_tpu.sql.context import SessionContext
+
+    _probe_devices(min(180.0, budget / 2), sf)
 
     qlist = (
         [q.strip() for q in queries.split(",") if q.strip()]
@@ -111,6 +153,10 @@ def main() -> None:
                 else:
                     df.collect_table()
                 dt = time.perf_counter() - t0
+                print(
+                    f"{q} attempt {_attempt}: {dt:.3f}s", file=sys.stderr,
+                    flush=True,
+                )
                 best = min(best, dt)
                 if time.perf_counter() - started > budget:
                     break

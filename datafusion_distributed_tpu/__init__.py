@@ -14,16 +14,31 @@ Layering (mirrors SURVEY.md §1, re-expressed TPU-first):
 - runtime/   coordinator/worker task runtime
 - sql/       SQL frontend (parser -> logical plan -> physical plan)
 - io/        host-side Parquet/Arrow <-> device Table
-- models/    benchmark workloads (TPC-H, ClickBench) and data generators
+- data/      benchmark datasets (TPC-H/TPC-DS/ClickBench generators)
 """
 
 import os as _os
 
 import jax as _jax
 
-# A query engine needs real 64-bit integers (join keys at SF>=100 exceed
-# int32) and float64 accumulation for result parity with the CPU reference.
-_jax.config.update("jax_enable_x64", True)
+# Precision policy: 32-bit TPU-native compute by default; DFTPU_PRECISION=x64
+# restores exact f64/i64 (see precision.py for the full rationale).
+from datafusion_distributed_tpu import precision  # noqa: F401
+
+# Persistent XLA compilation cache (opt-in via DFTPU_COMPILE_CACHE=<dir>):
+# 22 distinct TPC-H programs cost 20-40 s each to compile cold over the TPU
+# tunnel; caching them across runs is the difference between a bench run
+# fitting its budget or not. Opt-in only: XLA:CPU AOT cache entries embed
+# host machine features and reloading them on a different (virtual) host
+# risks SIGILL, so tests never want this.
+_cache_dir = _os.environ.get("DFTPU_COMPILE_CACHE")
+if _cache_dir and _cache_dir != "0":
+    try:
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        _jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # pragma: no cover - older jax config name guard
+        pass
 
 # Honor JAX_PLATFORMS when a platform plugin force-selected itself at
 # registration time (the environment's TPU-tunnel plugin sets

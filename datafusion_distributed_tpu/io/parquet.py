@@ -138,7 +138,16 @@ def arrow_to_host_columns(
             if not null_mask.all():
                 col = pc.fill_null(col, 0)
             arr = col.to_numpy(zero_copy_only=False)
-            data[f.name] = np.asarray(arr).astype(f.dtype.np_dtype)
+            # Keep the column's native (wide) width here: Column.from_numpy
+            # owns the narrowing and range-checks it loudly in tpu precision
+            # mode. An astype here would wrap int64 join keys / timestamps
+            # silently before the guard could see the wide dtype.
+            if np.issubdtype(np.asarray(arr).dtype, np.integer):
+                data[f.name] = np.asarray(arr)
+            else:
+                data[f.name] = np.asarray(arr).astype(
+                    f.dtype.logical_np_dtype
+                )
         validity[f.name] = null_mask
     return data, validity, dicts, schema
 

@@ -113,15 +113,19 @@ def hash_rows(cols: list[np.ndarray], valids: list[Optional[np.ndarray]],
     payloads = []
     kinds = np.zeros(len(cols), dtype=np.int32)
     for i, (c, dt) in enumerate(zip(cols, dtypes)):
-        if dt in (DataType.INT64,):
+        # Dispatch on the ACTUAL array dtype, not the logical DataType: in
+        # tpu precision mode logical INT64/FLOAT64 columns are stored as
+        # int32/float32 on device, and parity means hashing those exact bits.
+        adt = np.asarray(c).dtype
+        if adt == np.int64:
             payloads.append(np.ascontiguousarray(c, dtype=np.int64))
             kinds[i] = 0
-        elif dt == DataType.FLOAT64:
+        elif adt == np.float64:
             payloads.append(
                 np.ascontiguousarray(c, dtype=np.float64).view(np.int64)
             )
             kinds[i] = 0
-        elif dt == DataType.FLOAT32:
+        elif adt == np.float32:
             bits = np.ascontiguousarray(c, dtype=np.float32).view(np.uint32)
             payloads.append(bits.astype(np.int64))
             kinds[i] = 1

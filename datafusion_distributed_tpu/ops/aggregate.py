@@ -31,9 +31,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from datafusion_distributed_tpu.ops.hash import hash_columns
+from datafusion_distributed_tpu import precision
+from datafusion_distributed_tpu.ops.hash import fold_payload, hash_columns
 from datafusion_distributed_tpu.ops.table import Column, Table
 from datafusion_distributed_tpu.schema import DataType
+
+_LANE = precision.LANE_INT
+_ACC_INT = precision.ACC_INT
 
 @dataclass(frozen=True)
 class AggSpec:
@@ -77,16 +81,15 @@ def build_group_table(
     if lane_plan is None:
         lane_plan = [v is not None for v in key_valids]
 
-    # Keys folded to int64 payloads. Nullability is an explicit extra lane in
-    # the compare matrix (not an in-band sentinel, which a real key value
-    # could collide with): column i with lane_plan[i] contributes lanes
+    # Keys folded to fixed-width integer lanes (int32 in tpu precision mode,
+    # int64 in x64 mode). Nullability is an explicit extra lane in the
+    # compare matrix (not an in-band sentinel, which a real key value could
+    # collide with): column i with lane_plan[i] contributes lanes
     # [payload-with-nulls-zeroed, is_valid].
     keys64 = []
     valid_lane_of: list[Optional[int]] = []  # per key col: its validity lane idx
     for c, v in zip(key_cols, key_valids):
-        payload = c.astype(jnp.int64) if c.dtype != jnp.float64 else c.view(jnp.int64)
-        if c.dtype == jnp.float32:
-            payload = c.view(jnp.int32).astype(jnp.int64)
+        payload = fold_payload(c, _LANE)
         if v is not None:
             payload = jnp.where(v, payload, 0)
         keys64.append(payload)
@@ -95,15 +98,15 @@ def build_group_table(
         if want:
             valid_lane_of[i] = len(keys64)
             keys64.append(
-                v.astype(jnp.int64) if v is not None
-                else jnp.ones(n, dtype=jnp.int64)
+                v.astype(_LANE) if v is not None
+                else jnp.ones(n, dtype=_LANE)
             )
 
     h0 = hash_columns(list(key_cols), list(key_valids))
     slot0 = (h0 & mask).astype(jnp.int32)
 
     n_lanes = len(keys64)
-    slot_keys0 = jnp.zeros((num_slots, n_lanes), dtype=jnp.int64)
+    slot_keys0 = jnp.zeros((num_slots, n_lanes), dtype=_LANE)
     slot_used0 = jnp.zeros(num_slots, dtype=jnp.bool_)
     keys_mat = jnp.stack(keys64, axis=1)  # [N, k]
 
@@ -145,7 +148,10 @@ def build_group_table(
         )
         return resolved, slot, gid, slot_keys, slot_used, rounds + 1
 
-    state = (resolved0, slot0, gid0, slot_keys0, slot_used0, jnp.asarray(0))
+    state = (
+        resolved0, slot0, gid0, slot_keys0, slot_used0,
+        jnp.asarray(0, dtype=jnp.int32),
+    )
     resolved, slot, gid, slot_keys, slot_used, _ = jax.lax.while_loop(
         cond, body, state
     )
@@ -161,7 +167,7 @@ def build_group_table(
             out_valid.append(key_valid)
         else:
             out_valid.append(None)
-        if c.dtype == jnp.float64:
+        if c.dtype == jnp.float64:  # x64 mode only
             out_keys.append(payload.view(jnp.float64))
         elif c.dtype == jnp.float32:
             out_keys.append(payload.astype(jnp.int32).view(jnp.float32))
@@ -183,8 +189,13 @@ def hash_aggregate(
     aggs: Sequence[AggSpec],
     num_slots: int,
     mode: str = "single",  # "single" | "partial" | "final"
+    prec_flags: Optional[list] = None,
 ) -> tuple[Table, jnp.ndarray]:
     """GROUP BY aggregation. Returns (result table, overflow flag).
+
+    ``prec_flags``, when given, collects traced bools flagging integer SUM
+    results that left int32's exact range (tpu precision mode only; the
+    executor raises a non-retryable error for these).
 
     Modes mirror DataFusion's AggregateMode as used by the reference planner:
       partial -> emits sum/count/min/max accumulator columns per agg
@@ -209,7 +220,8 @@ def hash_aggregate(
 
     for spec in aggs:
         out_cols.update(
-            _eval_agg(spec, table, gid, live, num_slots, mode, seg_sum)
+            _eval_agg(spec, table, gid, live, num_slots, mode, seg_sum,
+                      prec_flags)
         )
 
     # Pack used slots to the front.
@@ -220,7 +232,8 @@ def hash_aggregate(
     return packed, gt.overflow
 
 
-def global_aggregate(table: Table, aggs: Sequence[AggSpec], mode: str = "single") -> Table:
+def global_aggregate(table: Table, aggs: Sequence[AggSpec], mode: str = "single",
+                     prec_flags: Optional[list] = None) -> Table:
     """Aggregation with no GROUP BY: one output row (capacity 8 keeps the
     result TPU-lane-friendly). Shares the per-aggregate evaluation with
     hash_aggregate, with every live row mapped to group 0."""
@@ -234,12 +247,14 @@ def global_aggregate(table: Table, aggs: Sequence[AggSpec], mode: str = "single"
 
     cols: dict[str, Column] = {}
     for spec in aggs:
-        cols.update(_eval_agg(spec, table, gid, live, cap, mode, seg_sum))
+        cols.update(_eval_agg(spec, table, gid, live, cap, mode, seg_sum,
+                              prec_flags))
     return Table(tuple(cols.keys()), tuple(cols.values()),
                  jnp.asarray(1, dtype=jnp.int32))
 
 
-def _eval_agg(spec, table, gid, live, num_slots, mode, seg_sum):
+def _eval_agg(spec, table, gid, live, num_slots, mode, seg_sum,
+              prec_flags=None):
     """Produce the output column(s) for one AggSpec in the given mode."""
     name = spec.output_name
     if spec.func == "count_star":
@@ -247,7 +262,7 @@ def _eval_agg(spec, table, gid, live, num_slots, mode, seg_sum):
             acc = table.column(f"{name}")
             vals = jnp.where(live, acc.data, 0)
             return {name: Column(seg_sum(vals), None, DataType.INT64)}
-        cnt = seg_sum(jnp.where(live, 1, 0).astype(jnp.int64))
+        cnt = seg_sum(jnp.where(live, 1, 0).astype(DataType.INT64.np_dtype))
         return {name: Column(cnt, None, DataType.INT64)}
 
     if mode == "final" and spec.func in ("sum", "count", "min", "max"):
@@ -257,6 +272,8 @@ def _eval_agg(spec, table, gid, live, num_slots, mode, seg_sum):
         if spec.func in ("sum", "count"):
             vals = jnp.where(valid, acc.data, 0)
             merged = seg_sum(vals)
+            if spec.func == "sum":
+                _check_int32_sum_range(vals, seg_sum, prec_flags)
         elif spec.func == "min":
             init = jnp.full(num_slots, _dtype_max(acc.data.dtype), acc.data.dtype)
             merged = init.at[jnp.where(valid, gid, num_slots)].min(
@@ -267,7 +284,7 @@ def _eval_agg(spec, table, gid, live, num_slots, mode, seg_sum):
             merged = init.at[jnp.where(valid, gid, num_slots)].max(
                 acc.data, mode="drop"
             )
-        nonempty = seg_sum(jnp.where(valid, 1, 0).astype(jnp.int64))
+        nonempty = seg_sum(jnp.where(valid, 1, 0).astype(_ACC_INT))
         if spec.func == "count":
             return {name: Column(merged, None, DataType.INT64)}
         out_valid = nonempty > 0
@@ -289,31 +306,33 @@ def _eval_agg(spec, table, gid, live, num_slots, mode, seg_sum):
     vgid = jnp.where(valid, gid, num_slots)
 
     if spec.func == "count":
-        cnt = seg_sum(jnp.where(valid, 1, 0).astype(jnp.int64))
+        cnt = seg_sum(jnp.where(valid, 1, 0).astype(DataType.INT64.np_dtype))
         return {name: Column(cnt, None, DataType.INT64)}
 
     if spec.func == "sum" or (spec.func == "avg" and mode == "partial"):
         acc_dtype = (
-            jnp.float64 if col.dtype.is_float else jnp.int64
+            DataType.FLOAT64.np_dtype if col.dtype.is_float
+            else DataType.INT64.np_dtype
         )
         vals = jnp.where(valid, col.data, 0).astype(acc_dtype)
         s = seg_sum(vals)
-        nonempty = seg_sum(jnp.where(valid, 1, 0).astype(jnp.int64))
+        _check_int32_sum_range(vals, seg_sum, prec_flags)
+        nonempty = seg_sum(jnp.where(valid, 1, 0).astype(_ACC_INT))
         sum_dtype = DataType.FLOAT64 if col.dtype.is_float else DataType.INT64
         if spec.func == "sum":
             return {name: Column(s, nonempty > 0, sum_dtype)}
         # partial avg: emit sum + count pair
         return {
             f"{name}__sum": Column(
-                s.astype(jnp.float64), nonempty > 0, DataType.FLOAT64
+                s.astype(DataType.FLOAT64.np_dtype), nonempty > 0, DataType.FLOAT64
             ),
             f"{name}__count": Column(nonempty, None, DataType.INT64),
         }
 
     if spec.func == "avg":  # single
-        vals = jnp.where(valid, col.data, 0).astype(jnp.float64)
+        vals = jnp.where(valid, col.data, 0).astype(DataType.FLOAT64.np_dtype)
         s = seg_sum(vals)
-        cnt = seg_sum(jnp.where(valid, 1, 0).astype(jnp.int64))
+        cnt = seg_sum(jnp.where(valid, 1, 0).astype(_ACC_INT))
         avg = s / jnp.where(cnt == 0, 1, cnt)
         return {name: Column(avg, cnt > 0, DataType.FLOAT64)}
 
@@ -324,12 +343,28 @@ def _eval_agg(spec, table, gid, live, num_slots, mode, seg_sum):
         else:
             init = jnp.full(num_slots, _dtype_min(col.data.dtype), col.data.dtype)
             red = init.at[vgid].max(col.data, mode="drop")
-        nonempty = seg_sum(jnp.where(valid, 1, 0).astype(jnp.int64))
+        nonempty = seg_sum(jnp.where(valid, 1, 0).astype(_ACC_INT))
         return {
             name: Column(red, nonempty > 0, col.dtype, col.dictionary)
         }
 
     raise NotImplementedError(f"aggregate function {spec.func}")
+
+
+def _check_int32_sum_range(vals, seg_sum, prec_flags):
+    """tpu precision mode: int32 scatter-add wraps silently past 2^31, so
+    estimate each group's sum in float32 alongside and flag when any group's
+    magnitude approaches the boundary (conservative 0.995 factor covers the
+    ~1e-7 relative error of the f32 estimate). No-op in x64 mode."""
+    if prec_flags is None:
+        return
+    if not (
+        jnp.issubdtype(vals.dtype, jnp.integer)
+        and np.dtype(vals.dtype).itemsize == 4
+    ):
+        return
+    est = seg_sum(vals.astype(jnp.float32), dtype=jnp.float32)
+    prec_flags.append(jnp.any(jnp.abs(est) > np.float32(2.0**31 * 0.995)))
 
 
 def _col_dtype(col: Column) -> DataType:

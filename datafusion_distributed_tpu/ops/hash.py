@@ -42,6 +42,17 @@ def fold_to_u32(col: jnp.ndarray) -> jnp.ndarray:
     return col.astype(jnp.uint32)
 
 
+def fold_payload(col: jnp.ndarray, lane_dtype) -> jnp.ndarray:
+    """Fold a key column to a fixed-width integer lane for exact equality
+    compares (claim-loop hash table / join probe). Floats are bit-cast so
+    +0.0/-0.0 and NaN payloads compare bitwise, matching the hash."""
+    if col.dtype == jnp.float64:  # x64 mode only; lane_dtype is int64 there
+        return col.view(jnp.int64).astype(lane_dtype)
+    if col.dtype == jnp.float32:
+        return col.view(jnp.int32).astype(lane_dtype)
+    return col.astype(lane_dtype)
+
+
 def hash_columns(cols: list[jnp.ndarray], valids: list[jnp.ndarray | None]) -> jnp.ndarray:
     """Combined uint32 hash of multiple key columns (nulls hash as a fixed
     tag so SQL's null-equal-null grouping works)."""

@@ -31,8 +31,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from datafusion_distributed_tpu import precision
 from datafusion_distributed_tpu.ops.aggregate import GroupTable, build_group_table
-from datafusion_distributed_tpu.ops.hash import hash_columns
+from datafusion_distributed_tpu.ops.hash import fold_payload, hash_columns
 from datafusion_distributed_tpu.ops.table import Column, Table
 from datafusion_distributed_tpu.schema import DataType
 
@@ -42,11 +43,10 @@ def _fold_keys(cols, valids, lane_plan):
     ``lane_plan[i]`` == True adds a validity lane for key column i (required
     when EITHER side of the join is nullable, so the compare matrices always
     have matching shapes)."""
+    lane = precision.LANE_INT
     lanes = []
     for c, v in zip(cols, valids):
-        payload = c.astype(jnp.int64) if c.dtype != jnp.float64 else c.view(jnp.int64)
-        if c.dtype == jnp.float32:
-            payload = c.view(jnp.int32).astype(jnp.int64)
+        payload = fold_payload(c, lane)
         if v is not None:
             payload = jnp.where(v, payload, 0)
         lanes.append(payload)
@@ -54,14 +54,14 @@ def _fold_keys(cols, valids, lane_plan):
     for v, want in zip(valids, lane_plan):
         if want:
             lanes.append(
-                v.astype(jnp.int64) if v is not None
-                else jnp.ones(n, dtype=jnp.int64)
+                v.astype(lane) if v is not None
+                else jnp.ones(n, dtype=lane)
             )
     return jnp.stack(lanes, axis=1)  # [N, lanes]
 
 
 def probe_group_table(
-    gt_slot_keys_raw: jnp.ndarray,  # [H, lanes] int64 (raw matrix)
+    gt_slot_keys_raw: jnp.ndarray,  # [H, lanes] LANE_INT (raw matrix)
     slot_used: jnp.ndarray,  # [H] bool
     probe_cols: Sequence[jnp.ndarray],
     probe_valids: Sequence[Optional[jnp.ndarray]],
@@ -109,7 +109,7 @@ def probe_group_table(
         return still, slot, found, rounds + 1
 
     still, _, found, _ = jax.lax.while_loop(
-        cond, body, (active0, slot, found0, jnp.asarray(0))
+        cond, body, (active0, slot, found0, jnp.asarray(0, dtype=jnp.int32))
     )
     return found, jnp.any(still)
 
@@ -176,22 +176,19 @@ def build_join_table(
 def _raw_slot_keys(gt: GroupTable, cols, lane_plan) -> jnp.ndarray:
     """Re-fold the group table's per-slot keys into the raw lane matrix the
     probe compares against (same lane layout as _fold_keys)."""
+    lane = precision.LANE_INT
     lanes = []
     h = gt.slot_used.shape[0]
     for keys, kv in zip(gt.slot_keys, gt.slot_key_valid):
-        payload = (
-            keys.astype(jnp.int64) if keys.dtype != jnp.float64 else keys.view(jnp.int64)
-        )
-        if keys.dtype == jnp.float32:
-            payload = keys.view(jnp.int32).astype(jnp.int64)
+        payload = fold_payload(keys, lane)
         if kv is not None:
             payload = jnp.where(kv, payload, 0)
         lanes.append(payload)
     for kv, want in zip(gt.slot_key_valid, lane_plan):
         if want:
             lanes.append(
-                kv.astype(jnp.int64) if kv is not None
-                else jnp.ones(h, dtype=jnp.int64)
+                kv.astype(lane) if kv is not None
+                else jnp.ones(h, dtype=lane)
             )
     return jnp.stack(lanes, axis=1)
 

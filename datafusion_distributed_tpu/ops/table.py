@@ -159,7 +159,24 @@ class Column:
         if n > capacity:
             raise ValueError(f"{n} values > capacity {capacity}")
         buf = np.zeros(capacity, dtype=dtype.np_dtype)
-        buf[:n] = values
+        vals = np.asarray(values)
+        # tpu precision mode stores logical 64-bit ints as int32; narrowing
+        # must be loud, never a silent wrap (join keys at huge scale factors
+        # are the realistic overflow case — see precision.py).
+        if (
+            n
+            and np.issubdtype(vals.dtype, np.integer)
+            and np.issubdtype(buf.dtype, np.integer)
+            and vals.dtype.itemsize > buf.dtype.itemsize
+        ):
+            info = np.iinfo(buf.dtype)
+            lo, hi = vals.min(), vals.max()
+            if lo < info.min or hi > info.max:
+                raise OverflowError(
+                    f"int values [{lo}, {hi}] exceed {buf.dtype} device "
+                    "storage; run with DFTPU_PRECISION=x64 for 64-bit keys"
+                )
+        buf[:n] = vals
         col_validity = None
         if validity is not None:
             v = np.zeros(capacity, dtype=np.bool_)

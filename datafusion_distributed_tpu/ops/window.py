@@ -101,7 +101,7 @@ def window_compute(
     seg_start = jnp.maximum.accumulate(jnp.where(new_part, idx, 0))
     rn = idx - seg_start  # 0-based row_number within partition
     rank0 = jnp.maximum.accumulate(jnp.where(new_order, idx, 0)) - seg_start
-    dense_cum = jnp.cumsum(new_order.astype(jnp.int64))
+    dense_cum = jnp.cumsum(new_order.astype(DataType.INT64.np_dtype))
     dense0 = dense_cum - dense_cum[seg_start]
 
     # peer-group end index (for RANGE ..CURRENT ROW frames): the largest
@@ -117,33 +117,33 @@ def window_compute(
     out: dict[str, Column] = {}
     for f in funcs:
         if f.func == "row_number":
-            res = (rn + 1).astype(jnp.int64)
+            res = (rn + 1).astype(DataType.INT64.np_dtype)
             validity = None
         elif f.func == "rank":
-            res = (rank0 + 1).astype(jnp.int64)
+            res = (rank0 + 1).astype(DataType.INT64.np_dtype)
             validity = None
         elif f.func == "dense_rank":
-            res = (dense0 + 1).astype(jnp.int64)
+            res = (dense0 + 1).astype(DataType.INT64.np_dtype)
             validity = None
         elif f.func in ("sum", "avg", "min", "max", "count", "count_star"):
             if f.func == "count_star":
-                vals = live_sorted.astype(jnp.int64)
+                vals = live_sorted.astype(DataType.INT64.np_dtype)
                 valid_sorted = live_sorted
             else:
                 col = table.column(f.input_name)
                 vals = col.data[perm]
                 valid_sorted = col.valid_mask()[perm] & live_sorted
             if f.func in ("count", "count_star"):
-                scan_vals = valid_sorted.astype(jnp.int64)
+                scan_vals = valid_sorted.astype(DataType.INT64.np_dtype)
                 op = "sum"
             elif f.func == "avg":
-                scan_vals = jnp.where(valid_sorted, vals, 0).astype(jnp.float64)
+                scan_vals = jnp.where(valid_sorted, vals, 0).astype(DataType.FLOAT64.np_dtype)
                 op = "sum"
             elif f.func == "sum":
                 acc = (
-                    jnp.float64
+                    DataType.FLOAT64.np_dtype
                     if jnp.issubdtype(vals.dtype, jnp.floating)
-                    else jnp.int64
+                    else DataType.INT64.np_dtype
                 )
                 scan_vals = jnp.where(valid_sorted, vals, 0).astype(acc)
                 op = "sum"
@@ -157,7 +157,7 @@ def window_compute(
                 op = "max"
             running = _segmented_scan(scan_vals, new_part, op)
             cnt_running = _segmented_scan(
-                valid_sorted.astype(jnp.int64), new_part, "sum"
+                valid_sorted.astype(DataType.INT64.np_dtype), new_part, "sum"
             )
             if order_keys and f.frame == "rows":
                 # ROWS frame: strictly per-row running values

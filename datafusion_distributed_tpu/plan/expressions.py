@@ -566,7 +566,7 @@ class Extract(PhysicalExpr):
         c = self.child.evaluate(table)
         y, m, d = _civil_from_days(c.data)
         out = {"year": y, "month": m, "day": d}[self.part]
-        return ExprValue(out.astype(jnp.int64), c.validity, DataType.INT64)
+        return ExprValue(out.astype(DataType.INT64.np_dtype), c.validity, DataType.INT64)
 
     def output_field(self, schema: Schema) -> Field:
         f = self.child.output_field(schema)

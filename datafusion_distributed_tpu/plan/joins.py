@@ -129,7 +129,8 @@ class HashJoinExec(ExecutionPlan):
 
         # Residual path: expand pairs (inner), filter, then fold back.
         pidx = Column(
-            jnp.arange(probe.capacity, dtype=jnp.int64), None, DataType.INT64
+            jnp.arange(probe.capacity, dtype=DataType.INT64.np_dtype),
+            None, DataType.INT64,
         )
         probe2 = probe.with_column(_PROBE_IDX, pidx)
         pairs, overflow = hash_join(
@@ -258,9 +259,13 @@ class CrossJoinExec(ExecutionPlan):
         l = self.left.execute(ctx)
         r = self.right.execute(ctx)
         cap = self.out_capacity
-        total64 = l.num_rows.astype(jnp.int64) * r.num_rows.astype(jnp.int64)
-        ctx.record_overflow(self, total64 > cap)
-        total = jnp.minimum(total64, cap).astype(jnp.int32)
+        # Division-based overflow test: l*r > cap iff l > cap // r. Avoids
+        # a 64-bit product (unavailable in tpu precision mode).
+        rn = jnp.maximum(r.num_rows, 1)
+        overflow = (r.num_rows > 0) & (l.num_rows > cap // rn)
+        ctx.record_overflow(self, overflow)
+        # product fits int32 whenever overflow is False (cap is int32-sized)
+        total = jnp.where(overflow, cap, l.num_rows * r.num_rows).astype(jnp.int32)
         j = jnp.arange(cap, dtype=jnp.int32)
         li = jnp.clip(j // jnp.maximum(r.num_rows, 1), 0, l.capacity - 1)
         ri = jnp.clip(j % jnp.maximum(r.num_rows, 1), 0, r.capacity - 1)

@@ -75,10 +75,18 @@ class ExecContext:
     def record_overflow(self, node: "ExecutionPlan", flag) -> None:
         self.overflow_flags.append((node.label(), flag))
 
+    def record_precision_error(self, node: "ExecutionPlan", flag) -> None:
+        """A 32-bit accumulator left its exact range (tpu precision mode).
+        Distinct from capacity overflow: growing the hash table cannot fix
+        it, so the executor raises a non-retryable error instead."""
+        self.overflow_flags.append((_PRECISION_TAG + node.label(), flag))
+
     def record_metric(self, node: "ExecutionPlan", name: str, value) -> None:
         if self.config.get("collect_metrics", True):
             self.metrics.append((node.node_id, name, value))
 
+
+_PRECISION_TAG = "precision!"
 
 _NODE_COUNTER = itertools.count()
 
@@ -380,14 +388,20 @@ class HashAggregateExec(ExecutionPlan):
 
     def _execute(self, ctx: ExecContext) -> Table:
         t = self.child.execute(ctx)
+        prec_flags: list = []
         if not self.group_names:
             from datafusion_distributed_tpu.ops.aggregate import global_aggregate
 
-            return global_aggregate(t, self.aggs, self.mode)
-        out, overflow = hash_aggregate(
-            t, self.group_names, self.aggs, self.num_slots, self.mode
-        )
-        ctx.record_overflow(self, overflow)
+            out = global_aggregate(t, self.aggs, self.mode,
+                                   prec_flags=prec_flags)
+        else:
+            out, overflow = hash_aggregate(
+                t, self.group_names, self.aggs, self.num_slots, self.mode,
+                prec_flags=prec_flags,
+            )
+            ctx.record_overflow(self, overflow)
+        for f in prec_flags:
+            ctx.record_precision_error(self, f)
         return out
 
     def display(self):
@@ -552,11 +566,22 @@ def execute_plan(
         metric_names.clear()
         metric_names.extend((nid, name) for nid, name, _ in ctx.metrics)
         metric_vals = [v for _, _, v in ctx.metrics]
-        flags = [f for _, f in ctx.overflow_flags]
+        cap_flags = [
+            f for name, f in ctx.overflow_flags
+            if not name.startswith(_PRECISION_TAG)
+        ]
+        prec_flags = [
+            f for name, f in ctx.overflow_flags
+            if name.startswith(_PRECISION_TAG)
+        ]
         any_overflow = (
-            jnp.any(jnp.stack(flags)) if flags else jnp.asarray(False)
+            jnp.any(jnp.stack(cap_flags)) if cap_flags else jnp.asarray(False)
         )
-        return out, any_overflow, metric_vals
+        any_precision = (
+            jnp.any(jnp.stack(prec_flags)) if prec_flags
+            else jnp.asarray(False)
+        )
+        return out, any_overflow, any_precision, metric_vals
 
     cache_key = (
         plan.node_id,
@@ -577,11 +602,21 @@ def execute_plan(
         if use_cache:
             _COMPILE_CACHE[cache_key] = cached
     fn, overflow_box, metric_names = cached
-    out, any_overflow, metric_vals = fn(inputs)
+    out, any_overflow, any_precision, metric_vals = fn(inputs)
     if check_overflow and bool(any_overflow):
         raise RuntimeError(
             f"hash table overflow in plan (nodes: "
-            f"{[name for name, _ in overflow_box]}); re-plan with more slots"
+            f"{[name for name, _ in overflow_box if not name.startswith(_PRECISION_TAG)]}); "
+            "re-plan with more slots"
+        )
+    if bool(any_precision):
+        # deliberately does NOT contain the word "overflow": the session's
+        # capacity-retry loop must not retry this (a bigger hash table can't
+        # restore int32 exactness).
+        raise RuntimeError(
+            "int32 accumulator range exceeded in plan (nodes: "
+            f"{[name for name, _ in overflow_box if name.startswith(_PRECISION_TAG)]}); "
+            "run with DFTPU_PRECISION=x64 for 64-bit accumulation"
         )
     if metrics_store is not None:
         node_metrics: dict = {}

@@ -1,0 +1,90 @@
+"""Compute-precision policy: 32-bit TPU-native compute by default.
+
+TPU hardware has no native f64/i64: XLA emulates both (an order of magnitude
+slower) and they double HBM traffic. Round 1 globally forced
+``jax_enable_x64`` and never completed a query on the chip (BENCH_r01); this
+module replaces that with an explicit policy, resolved once at import time
+from the ``DFTPU_PRECISION`` environment variable:
+
+- ``tpu`` (default): ``jax_enable_x64`` stays OFF. Every device array —
+  columns, accumulators, temporaries — is 32-bit; JAX itself guarantees no
+  64-bit op can appear in a jaxpr (tests/test_precision.py audits this).
+  Logical INT64/FLOAT64 schema types are stored as int32/float32 on device;
+  the host->device boundary range-checks integer narrowing
+  (`ops/table.py Column.from_numpy`), so silent truncation is impossible.
+  Float aggregation accumulates in f32; result parity vs the f64 oracle is
+  validated at a documented tolerance (`oracle_rtol`, ~eps_f32*sqrt(N)).
+- ``x64``: exact mode — the round-1 behavior (f64/i64 device columns,
+  bit-exact parity with the pandas oracle). Useful on CPU and for parity
+  debugging; hostile to TPU.
+
+The reference has no analogue (CPU f64 is free there); the closest concept
+is its per-datatype byte-width cost table
+(`/root/reference/src/distributed_planner/statistics/default_bytes_for_datatype.rs`),
+which likewise treats precision/width as an engine-level policy.
+
+The mode is import-time only: flipping ``jax_enable_x64`` after arrays exist
+corrupts dtype invariants, so ``set_mode`` intentionally does not exist.
+Tests that need the other mode run in a subprocess.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+MODE = os.environ.get("DFTPU_PRECISION", "tpu").strip().lower()
+if MODE not in ("tpu", "x64"):
+    raise ValueError(
+        f"DFTPU_PRECISION must be 'tpu' or 'x64', got {MODE!r}"
+    )
+
+if MODE == "x64":
+    jax.config.update("jax_enable_x64", True)
+
+#: Device storage dtype per logical DataType value (see schema.DataType).
+#: Narrowed entries apply in tpu mode only.
+_NARROW = {
+    "int64": np.int32,
+    "float64": np.float32,
+}
+
+
+def narrow_np_dtype(wide: np.dtype) -> np.dtype:
+    """Map a logical numpy dtype to its device storage dtype for this mode."""
+    if MODE == "x64":
+        return np.dtype(wide)
+    return np.dtype(_NARROW.get(np.dtype(wide).name, wide))
+
+
+#: dtype for folded key lanes in the claim-loop hash table / join probe
+#: (ops/aggregate.py, ops/join.py). 32-bit halves compare-matrix HBM traffic.
+LANE_INT = np.int64 if MODE == "x64" else np.int32
+#: integer accumulator (counts, rank numbering, metric counters)
+ACC_INT = np.int64 if MODE == "x64" else np.int32
+#: float accumulator (SUM/AVG); see oracle_rtol for the f32 error model
+ACC_FLOAT = np.float64 if MODE == "x64" else np.float32
+
+
+def oracle_rtol() -> float:
+    """Float tolerance for result-parity comparison against an f64 oracle.
+
+    tpu mode: f32 scatter-add over N addends accumulates ~eps_f32*sqrt(N)
+    relative error (random-sign model); 5e-4 covers N up to ~10^7 with
+    safety margin while still catching real logic errors (which deviate
+    by orders of magnitude more).
+    """
+    return 1e-6 if MODE == "x64" else 5e-4
+
+
+def oracle_atol() -> float:
+    return 1e-6 if MODE == "x64" else 1e-4
+
+
+def test_rtol() -> float:
+    """Tolerance for engine-vs-engine or engine-vs-small-oracle comparisons
+    in unit tests (smaller inputs than the TPC-H suite, so tighter than
+    oracle_rtol)."""
+    return 1e-12 if MODE == "x64" else 2e-5

@@ -19,7 +19,12 @@ import numpy as np
 from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from datafusion_distributed_tpu import precision
 from datafusion_distributed_tpu.ops.table import Table
+from datafusion_distributed_tpu.plan.physical import _PRECISION_TAG
+
+# per-task metric counters (row/byte counts); 32-bit in tpu precision mode
+_METRIC_DTYPE = precision.ACC_INT
 from datafusion_distributed_tpu.plan.physical import (
     DistributedTaskContext,
     ExecContext,
@@ -87,18 +92,32 @@ def execute_on_mesh(
         metric_names.extend((nid, name) for nid, name, _ in ctx.metrics)
         if ctx.metrics:
             mvec = jnp.stack(
-                [v.astype(jnp.int64) for _, _, v in ctx.metrics]
+                [v.astype(_METRIC_DTYPE) for _, _, v in ctx.metrics]
             )[None, :]
         else:
-            mvec = jnp.zeros((1, 0), dtype=jnp.int64)
-        flags = [f for _, f in ctx.overflow_flags]
+            mvec = jnp.zeros((1, 0), dtype=_METRIC_DTYPE)
+        cap_flags = [
+            f for name, f in ctx.overflow_flags
+            if not name.startswith(_PRECISION_TAG)
+        ]
+        prec_flags = [
+            f for name, f in ctx.overflow_flags
+            if name.startswith(_PRECISION_TAG)
+        ]
         any_overflow = (
-            jnp.any(jnp.stack(flags)) if flags else jnp.asarray(False)
+            jnp.any(jnp.stack(cap_flags)) if cap_flags else jnp.asarray(False)
         )
         any_overflow = (
             jax.lax.pmax(any_overflow.astype(jnp.int32), AXIS) > 0
         )
-        return out, any_overflow, mvec
+        any_precision = (
+            jnp.any(jnp.stack(prec_flags)) if prec_flags
+            else jnp.asarray(False)
+        )
+        any_precision = (
+            jax.lax.pmax(any_precision.astype(jnp.int32), AXIS) > 0
+        )
+        return out, any_overflow, any_precision, mvec
 
     in_specs = jax.tree.map(lambda _: P(AXIS), stacked_inputs)
     cache_key = (plan.node_id, tuple(d.id for d in mesh.devices.flat))
@@ -111,18 +130,25 @@ def execute_on_mesh(
                 run,
                 mesh=mesh,
                 in_specs=(in_specs,),
-                out_specs=(P(), P(), P(AXIS)),
+                out_specs=(P(), P(), P(), P(AXIS)),
                 check_rep=False,
             )
         )
         cached = (fn, overflow_names, metric_names)
         _MESH_COMPILE_CACHE[cache_key] = cached
     fn, overflow_names, metric_names = cached
-    out, any_overflow, mvec = fn(stacked_inputs)
+    out, any_overflow, any_precision, mvec = fn(stacked_inputs)
     if check_overflow and bool(any_overflow):
         raise RuntimeError(
             f"exchange/hash capacity overflow on mesh (nodes: "
-            f"{overflow_names}); re-plan with larger capacities"
+            f"{[n for n in overflow_names if not n.startswith(_PRECISION_TAG)]}); "
+            "re-plan with larger capacities"
+        )
+    if bool(any_precision):
+        raise RuntimeError(
+            "int32 accumulator range exceeded on mesh (nodes: "
+            f"{[n for n in overflow_names if n.startswith(_PRECISION_TAG)]}); "
+            "run with DFTPU_PRECISION=x64 for 64-bit accumulation"
         )
     if metrics_store is not None:
         import numpy as np_

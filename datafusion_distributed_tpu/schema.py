@@ -37,6 +37,16 @@ class DataType(enum.Enum):
 
     @property
     def np_dtype(self) -> np.dtype:
+        """Device storage dtype: the logical width narrowed per the active
+        precision mode (INT64->int32, FLOAT64->float32 in tpu mode; see
+        precision.py)."""
+        from datafusion_distributed_tpu import precision
+
+        return precision.narrow_np_dtype(_NP_DTYPES[self])
+
+    @property
+    def logical_np_dtype(self) -> np.dtype:
+        """The mode-independent logical dtype (host/IO width)."""
         return np.dtype(_NP_DTYPES[self])
 
     @property

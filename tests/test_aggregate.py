@@ -3,6 +3,12 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from datafusion_distributed_tpu import precision as _precision
+
+# f32 compute in tpu precision mode: summation-order differences are ~eps
+FLOAT_RTOL = _precision.test_rtol()
+
 import pandas as pd
 import pyarrow as pa
 import pytest
@@ -36,7 +42,7 @@ def test_groupby_sum_count():
         .reset_index()
     )
     np.testing.assert_array_equal(got["k"], exp["k"])
-    np.testing.assert_allclose(got["sv"], exp["sv"], rtol=1e-12)
+    np.testing.assert_allclose(got["sv"], exp["sv"], rtol=FLOAT_RTOL)
     np.testing.assert_array_equal(got["n"], exp["n"])
 
 
@@ -58,7 +64,7 @@ def test_groupby_min_max_avg():
     )
     np.testing.assert_array_equal(got["mn"], exp["mn"])
     np.testing.assert_array_equal(got["mx"], exp["mx"])
-    np.testing.assert_allclose(got["av"], exp["av"], rtol=1e-12)
+    np.testing.assert_allclose(got["av"], exp["av"], rtol=FLOAT_RTOL)
 
 
 def test_multi_key_with_strings_and_nulls():
@@ -113,11 +119,11 @@ def test_partial_then_final_equals_single():
     assert not bool(o3)
     fin = fin.to_pandas().sort_values("k").reset_index(drop=True)
     np.testing.assert_array_equal(fin["k"], single["k"])
-    np.testing.assert_allclose(fin["sv"], single["sv"], rtol=1e-12)
+    np.testing.assert_allclose(fin["sv"], single["sv"], rtol=FLOAT_RTOL)
     np.testing.assert_array_equal(fin["cv"], single["cv"])
     np.testing.assert_array_equal(fin["mn"], single["mn"])
     np.testing.assert_array_equal(fin["mx"], single["mx"])
-    np.testing.assert_allclose(fin["av"], single["av"], rtol=1e-12)
+    np.testing.assert_allclose(fin["av"], single["av"], rtol=FLOAT_RTOL)
 
 
 def test_overflow_flag():

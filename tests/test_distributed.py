@@ -9,6 +9,12 @@ paths as a TPU pod slice.
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from datafusion_distributed_tpu import precision as _precision
+
+# f32 compute in tpu precision mode: summation-order differences are ~eps
+FLOAT_RTOL = _precision.test_rtol()
+
 import pandas as pd
 import pyarrow as pa
 import pytest
@@ -136,9 +142,9 @@ def test_distributed_aggregate_matches_single(mesh):
         .reset_index().sort_values("k").reset_index(drop=True)
     )
     np.testing.assert_array_equal(got["k"], exp["k"])
-    np.testing.assert_allclose(got["sv"], exp["sv"], rtol=1e-9)
+    np.testing.assert_allclose(got["sv"], exp["sv"], rtol=FLOAT_RTOL)
     np.testing.assert_array_equal(got["n"], exp["n"])
-    np.testing.assert_array_equal(got["mn"], exp["mn"])
+    np.testing.assert_allclose(got["mn"], exp["mn"], rtol=FLOAT_RTOL)
 
 
 def test_distributed_sql_join_matches_single(mesh):
@@ -157,7 +163,7 @@ def test_distributed_sql_join_matches_single(mesh):
         ctx.sql(sql).collect_distributed_table(mesh=mesh)
     ).to_pandas()
     np.testing.assert_array_equal(got["k"], single["k"])
-    np.testing.assert_allclose(got["s"], single["s"], rtol=1e-9)
+    np.testing.assert_allclose(got["s"], single["s"], rtol=FLOAT_RTOL)
     np.testing.assert_array_equal(got["n"], single["n"])
 
 

@@ -160,7 +160,7 @@ def test_is_null_not_negate_cast():
     data, _ = _eval(Negate(Col("x")), t)
     assert data[0] == -1
     data, _ = _eval(Cast(Col("x"), DataType.FLOAT64), t)
-    assert data.dtype == np.float64
+    assert data.dtype == DataType.FLOAT64.np_dtype
 
 
 def test_date_literal_comparison():

@@ -19,8 +19,13 @@ def test_hash_parity_with_device_kernel():
 
     rng = np.random.default_rng(0)
     n = 5000
-    a = rng.integers(-(2**62), 2**62, n, dtype=np.int64)
-    b = rng.normal(size=n)
+    # Draw within the active precision mode's storage width: the native
+    # hasher sees the same (possibly narrowed) arrays the device holds.
+    int_info = np.iinfo(DataType.INT64.np_dtype)
+    a = rng.integers(int_info.min, int_info.max, n, dtype=np.int64).astype(
+        DataType.INT64.np_dtype
+    )
+    b = rng.normal(size=n).astype(DataType.FLOAT64.np_dtype)
     c = rng.integers(0, 1000, n).astype(np.int32)
     valid_b = rng.random(n) > 0.1
 
@@ -35,6 +40,47 @@ def test_hash_parity_with_device_kernel():
         [DataType.INT64, DataType.FLOAT64, DataType.INT32],
     )
     np.testing.assert_array_equal(dev, nat)
+
+
+def _numpy_reference_hash(payload_u32_lanes, valids):
+    """Mode-independent numpy mirror of ops.hash.hash_columns (and the C++
+    dftpu_hash_rows): murmur3 fmix32 avalanche + per-column odd multiplier."""
+    def mix(h):
+        h = h ^ (h >> np.uint32(16))
+        h = (h * np.uint32(0x85EBCA6B)).astype(np.uint32)
+        h = h ^ (h >> np.uint32(13))
+        h = (h * np.uint32(0xC2B2AE35)).astype(np.uint32)
+        return h ^ (h >> np.uint32(16))
+
+    h = np.full(len(payload_u32_lanes[0]), 0x9E3779B9, dtype=np.uint32)
+    for i, (lane, v) in enumerate(zip(payload_u32_lanes, valids)):
+        lane = lane.astype(np.uint32)
+        if v is not None:
+            lane = np.where(v, lane, np.uint32(0xDEADBEEF))
+        mult = np.uint32(0x01000193 + 2 * i)
+        h = ((h ^ mix(lane)) * mult).astype(np.uint32)
+    return mix(h)
+
+
+def test_hash_64bit_branch_parity_with_numpy_reference():
+    """The C++ hasher's 64-bit fold branch (hi^lo) must stay correct even
+    when the active precision mode never produces 64-bit device columns."""
+    rng = np.random.default_rng(2)
+    n = 3000
+    a = rng.integers(-(2**62), 2**62, n, dtype=np.int64)
+    b = rng.normal(size=n).astype(np.float64)
+    valid_b = rng.random(n) > 0.2
+
+    u_a = a.astype(np.uint64)
+    lane_a = (u_a ^ (u_a >> np.uint64(32))).astype(np.uint32)
+    u_b = b.view(np.uint64)
+    lane_b = (u_b ^ (u_b >> np.uint64(32))).astype(np.uint32)
+    exp = _numpy_reference_hash([lane_a, lane_b], [None, valid_b])
+
+    nat = native.hash_rows(
+        [a, b], [None, valid_b], [DataType.INT64, DataType.FLOAT64]
+    )
+    np.testing.assert_array_equal(nat, exp)
 
 
 def test_shuffle_buckets_csr():

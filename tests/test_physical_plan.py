@@ -1,6 +1,12 @@
 """Physical plan IR: single-task execution, operator composition."""
 
 import numpy as np
+
+from datafusion_distributed_tpu import precision as _precision
+
+# f32 compute in tpu precision mode: summation-order differences are ~eps
+FLOAT_RTOL = _precision.test_rtol()
+
 import pandas as pd
 import pyarrow as pa
 import pyarrow.parquet as pq
@@ -67,7 +73,7 @@ def test_scan_filter_project_aggregate_sort_limit():
         .sort_values("s", ascending=False).head(3).reset_index(drop=True)
     )
     np.testing.assert_array_equal(out["k"], exp["k"])
-    np.testing.assert_allclose(out["s"], exp["s"], rtol=1e-12)
+    np.testing.assert_allclose(out["s"], exp["s"], rtol=FLOAT_RTOL)
     np.testing.assert_array_equal(out["n"], exp["n"])
 
 
@@ -87,7 +93,7 @@ def test_global_aggregate_no_groups():
     assert int(out["sw"][0]) == int(df.w.sum())
     assert int(out["n"][0]) == len(df)
     assert int(out["mn"][0]) == int(df.w.min())
-    np.testing.assert_allclose(out["av"][0], df.v.mean(), rtol=1e-12)
+    np.testing.assert_allclose(out["av"][0], df.v.mean(), rtol=FLOAT_RTOL)
 
 
 def test_sort_multi_key_with_nulls():
@@ -189,6 +195,6 @@ def test_final_mode_schema_after_partial():
     out = execute_plan(fin).to_pandas().sort_values("k").reset_index(drop=True)
     df = arrow.to_pandas().groupby("k").agg(
         sv=("v", "sum"), av=("v", "mean"), mn=("w", "min")).reset_index()
-    np.testing.assert_allclose(out["sv"], df["sv"], rtol=1e-12)
-    np.testing.assert_allclose(out["av"], df["av"], rtol=1e-12)
+    np.testing.assert_allclose(out["sv"], df["sv"], rtol=FLOAT_RTOL)
+    np.testing.assert_allclose(out["av"], df["av"], rtol=FLOAT_RTOL)
     np.testing.assert_array_equal(out["mn"], df["mn"])

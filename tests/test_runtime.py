@@ -8,6 +8,12 @@ through the worker path.
 import time
 
 import numpy as np
+
+from datafusion_distributed_tpu import precision as _precision
+
+# f32 compute in tpu precision mode: summation-order differences are ~eps
+FLOAT_RTOL = _precision.test_rtol()
+
 import pyarrow as pa
 import pytest
 
@@ -99,7 +105,7 @@ def test_coordinator_executes_distributed_plan():
         .sort_values("k").reset_index(drop=True)
     )
     np.testing.assert_array_equal(out["k"], exp["k"])
-    np.testing.assert_allclose(out["sv"], exp["sv"], rtol=1e-9)
+    np.testing.assert_allclose(out["sv"], exp["sv"], rtol=FLOAT_RTOL)
     np.testing.assert_array_equal(out["n"], exp["n"])
     # metrics were collected per task
     assert len(coord.metrics) > 0
@@ -163,7 +169,7 @@ def test_sql_through_coordinator():
     dplan = df.distributed_plan(NT)
     out = DataFrame._strip_quals(_cluster(2).execute(dplan)).to_pandas()
     np.testing.assert_array_equal(out["k"], single["k"])
-    np.testing.assert_allclose(out["s"], single["s"], rtol=1e-9)
+    np.testing.assert_allclose(out["s"], single["s"], rtol=FLOAT_RTOL)
 
 
 def test_metrics_and_explain_analyze():
@@ -260,7 +266,7 @@ def test_grpc_localhost_cluster():
             .sort_values("k").reset_index(drop=True)
         )
         np.testing.assert_array_equal(out["k"], exp["k"])
-        np.testing.assert_allclose(out["sv"], exp["sv"], rtol=1e-9)
+        np.testing.assert_allclose(out["sv"], exp["sv"], rtol=FLOAT_RTOL)
         np.testing.assert_array_equal(out["n"], exp["n"])
         # observability over gRPC too
         infos = [cluster.get_worker(u).get_info() for u in cluster.get_urls()]

@@ -1,6 +1,12 @@
 """Window function tests: SQL end-to-end vs pandas, distributed parity."""
 
 import numpy as np
+
+from datafusion_distributed_tpu import precision as _precision
+
+# f32 compute in tpu precision mode: summation-order differences are ~eps
+FLOAT_RTOL = _precision.test_rtol()
+
 import pandas as pd
 import pyarrow as pa
 import pytest
@@ -55,8 +61,8 @@ def test_partition_aggregate_no_order(ctx):
     df["av"] = df.groupby("grp")["v"].transform("mean")
     df["cnt"] = df.groupby("grp")["v"].transform("size")
     df = df.sort_values(["grp", "v"], kind="stable").reset_index(drop=True)
-    np.testing.assert_allclose(out["sv"], df["sv"], rtol=1e-9)
-    np.testing.assert_allclose(out["av"], df["av"], rtol=1e-9)
+    np.testing.assert_allclose(out["sv"], df["sv"], rtol=FLOAT_RTOL)
+    np.testing.assert_allclose(out["av"], df["av"], rtol=FLOAT_RTOL)
     np.testing.assert_array_equal(out["cnt"], df["cnt"])
 
 
@@ -73,7 +79,7 @@ def test_running_sum_with_peers(ctx):
     df["rs"] = df.groupby(["grp", "ord"])["rs"].transform("last")
     got = out.groupby(["grp", "ord"])["rs"].first()
     exp = df.groupby(["grp", "ord"])["rs"].first()
-    np.testing.assert_allclose(got.to_numpy(), exp.to_numpy(), rtol=1e-9)
+    np.testing.assert_allclose(got.to_numpy(), exp.to_numpy(), rtol=FLOAT_RTOL)
 
 
 def test_window_over_aggregate(ctx):
@@ -87,8 +93,8 @@ def test_window_over_aggregate(ctx):
     g = df.groupby(["grp", "ord"]).agg(sv=("v", "sum")).reset_index()
     g["total"] = g.groupby("grp")["sv"].transform("sum")
     g = g.sort_values(["grp", "ord"]).reset_index(drop=True)
-    np.testing.assert_allclose(out["sv"], g["sv"], rtol=1e-9)
-    np.testing.assert_allclose(out["total"], g["total"], rtol=1e-9)
+    np.testing.assert_allclose(out["sv"], g["sv"], rtol=FLOAT_RTOL)
+    np.testing.assert_allclose(out["total"], g["total"], rtol=FLOAT_RTOL)
 
 
 def test_rank_filter_topn_per_group(ctx):
@@ -121,4 +127,4 @@ def test_window_distributed_matches_single(ctx):
     assert len(got) == len(single)
     for c in ["grp", "ord", "rk"]:
         np.testing.assert_array_equal(got[c], single[c])
-    np.testing.assert_allclose(got["rs"], single["rs"], rtol=1e-9)
+    np.testing.assert_allclose(got["rs"], single["rs"], rtol=FLOAT_RTOL)

@@ -405,9 +405,17 @@ def q22(T):
 ORACLES = {f"q{i}": globals()[f"q{i}"] for i in range(1, 23)}
 
 
-def compare_results(got: pd.DataFrame, exp: pd.DataFrame, rtol=1e-6, atol=1e-6):
+def compare_results(got: pd.DataFrame, exp: pd.DataFrame, rtol=None, atol=None):
     """Order-insensitive multiset comparison with float tolerance.
+    Default tolerances come from the active precision mode (f32 compute in
+    tpu mode accumulates ~eps*sqrt(N); see precision.oracle_rtol).
     Raises AssertionError on mismatch."""
+    from datafusion_distributed_tpu import precision
+
+    if rtol is None:
+        rtol = precision.oracle_rtol()
+    if atol is None:
+        atol = precision.oracle_atol()
     assert len(got) == len(exp), f"row count {len(got)} != {len(exp)}"
     assert len(got.columns) == len(exp.columns), (
         f"column count {list(got.columns)} vs {list(exp.columns)}"
