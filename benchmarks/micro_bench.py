@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""Kernel/exchange micro-benchmarks (the criterion-bench analogue:
+`/root/reference/benchmarks/benches/{shuffle,transport,local_repartition,
+broadcast_cache_scenarios}.rs`).
+
+Measures the engine's hot primitives in isolation so hot-path regressions
+are visible without a full TPC run:
+
+    agg      claim-loop hash aggregate (build + segmented reduce)
+    join     hash join build + probe + expand
+    sort     multi-key lexicographic sort
+    shuffle  mesh all_to_all hash shuffle (8 virtual devices on CPU)
+    coalesce group coalesce (ppermute rounds) vs all_gather
+    wire     transport frame pack/unpack (zstd vs none)
+
+Prints one JSON line per bench: {"bench", "rows_per_s", "ms"}.
+
+Run: python benchmarks/micro_bench.py [--rows N] [--device cpu|tpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _timeit(fn, *args, repeats: int = 3):
+    import jax
+
+    out = fn(*args)  # compile + warm
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1 << 18)
+    ap.add_argument("--device", default="cpu", choices=["cpu", "tpu"])
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args()
+
+    if args.device == "cpu":
+        os.environ.setdefault(
+            "XLA_FLAGS",
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8",
+        )
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    if args.device == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import numpy as np
+    import pyarrow as pa
+
+    from datafusion_distributed_tpu.io.parquet import arrow_to_table
+    from datafusion_distributed_tpu.ops.aggregate import (
+        AggSpec, hash_aggregate,
+    )
+    from datafusion_distributed_tpu.ops.join import build_join_table, hash_join
+    from datafusion_distributed_tpu.ops.sort import SortKey, sort_table
+    from datafusion_distributed_tpu.ops.table import round_up_pow2
+
+    n = args.rows
+    rng = np.random.default_rng(0)
+    results = []
+
+    def report(name: str, seconds: float, rows: int = n):
+        results.append({
+            "bench": name,
+            "ms": round(seconds * 1e3, 3),
+            "rows_per_s": round(rows / seconds) if seconds > 0 else None,
+        })
+        print(json.dumps(results[-1]), flush=True)
+
+    # ---- hash aggregate ---------------------------------------------------
+    t = arrow_to_table(pa.table({
+        "k": rng.integers(0, n // 16, n),
+        "v": rng.normal(size=n),
+    }))
+    slots = round_up_pow2(max(n // 8, 16))
+    agg = jax.jit(lambda tt: hash_aggregate(
+        tt, ["k"], [AggSpec("sum", "v", "sv"),
+                    AggSpec("count_star", None, "c")], slots,
+    ))
+    report("agg_claim_loop", _timeit(agg, t, repeats=args.repeats))
+
+    # ---- hash join --------------------------------------------------------
+    nb = n // 4
+    build = arrow_to_table(pa.table({
+        "k": rng.permutation(nb), "bv": rng.normal(size=nb),
+    }))
+    probe = arrow_to_table(pa.table({
+        "k": rng.integers(0, nb, n), "pv": rng.normal(size=n),
+    }))
+    out_cap = round_up_pow2(n)
+
+    def join(p, b):
+        bs = build_join_table(b, ["k"], round_up_pow2(2 * nb))
+        return hash_join(p, bs, ["k"], "inner", out_cap,
+                         build_prefix="b_")
+
+    report("join_build_probe", _timeit(jax.jit(join), probe, build,
+                                       repeats=args.repeats))
+
+    # ---- sort -------------------------------------------------------------
+    st = arrow_to_table(pa.table({
+        "a": rng.integers(0, 1000, n), "b": rng.normal(size=n),
+    }))
+    srt = jax.jit(lambda tt: sort_table(
+        tt, [SortKey("a"), SortKey("b", ascending=False)]
+    ))
+    report("sort_two_keys", _timeit(srt, st, repeats=args.repeats))
+
+    # ---- mesh exchanges ---------------------------------------------------
+    if len(jax.devices()) >= 8:
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from datafusion_distributed_tpu.parallel.exchange import (
+            broadcast_exchange,
+            group_coalesce_exchange,
+            partition_table,
+            shuffle_exchange,
+        )
+        from datafusion_distributed_tpu.runtime.mesh_executor import (
+            AXIS, make_mesh,
+        )
+
+        nt = 8
+        mesh = make_mesh(nt)
+        et = arrow_to_table(pa.table({
+            "k": rng.integers(0, n // 16, n),
+            "v": rng.normal(size=n),
+            "w": rng.normal(size=n),
+        }))
+        parts = partition_table(et, nt)
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *parts)
+        per_dest = round_up_pow2(max(2 * n // (nt * nt), 64))
+
+        def mk(fn):
+            def step(s):
+                local = jax.tree.map(lambda x: x[0], s)
+                out = fn(local)
+                return jax.tree.map(
+                    lambda x: x[None] if hasattr(x, "ndim") else x, out
+                )
+            return jax.jit(shard_map(
+                step, mesh=mesh, in_specs=(P(AXIS),), out_specs=P(AXIS),
+                check_rep=False,
+            ))
+
+        shuf = mk(lambda t_: shuffle_exchange(t_, ["k"], AXIS, nt, per_dest))
+        report("shuffle_all_to_all", _timeit(shuf, stacked,
+                                             repeats=args.repeats))
+        bcast = mk(lambda t_: broadcast_exchange(t_, AXIS, nt))
+        report("broadcast_all_gather", _timeit(bcast, stacked,
+                                               repeats=args.repeats))
+        gco = mk(lambda t_: group_coalesce_exchange(t_, AXIS, nt, 2))
+        report("coalesce_n_to_2_ppermute", _timeit(gco, stacked,
+                                                   repeats=args.repeats))
+
+    # ---- transport framing ------------------------------------------------
+    from datafusion_distributed_tpu.runtime import transport
+    from datafusion_distributed_tpu.runtime.codec import encode_table
+
+    blob = encode_table(t)
+    for codec in ("zstd", "none"):
+        t0 = time.perf_counter()
+        frame = transport.pack_frame({"k": 1}, {"t": blob}, codec=codec)
+        _, blobs = transport.unpack_frame(frame)
+        dt = time.perf_counter() - t0
+        results.append({
+            "bench": f"wire_roundtrip_{codec}",
+            "ms": round(dt * 1e3, 3),
+            "mb_per_s": round(len(blob) / dt / 1e6, 1),
+            "ratio": round(len(frame) / max(len(blob), 1), 3),
+        })
+        print(json.dumps(results[-1]), flush=True)
+
+    summary = {
+        "metric": "micro_bench_suite",
+        "value": len(results),
+        "unit": "benches",
+        "device": jax.devices()[0].platform,
+    }
+    print(json.dumps(summary), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,135 @@
+"""Terminal cluster monitor — the reference `console/` (ratatui TUI) analogue.
+
+Discovers the cluster from a seed worker via the observability service and
+redraws worker + task state at a fixed poll interval
+(`/root/reference/console/src/main.rs:14-47` polls GetClusterWorkers once
+and GetTaskProgress every 100 ms). Pure ANSI — no curses dependency — so it
+runs over any ssh/tmux session next to the bench.
+
+Usage:
+    python -m datafusion_distributed_tpu.console grpc://host:port [...]
+or programmatically against any resolver/channels pair:
+    Console(resolver, channels).run()
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Optional
+
+from datafusion_distributed_tpu.runtime.observability import (
+    ObservabilityService,
+    sample_system_metrics,
+)
+
+_CLEAR = "\x1b[2J\x1b[H"
+_BOLD = "\x1b[1m"
+_DIM = "\x1b[2m"
+_RESET = "\x1b[0m"
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024:
+            return f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}TiB"
+
+
+class Console:
+    def __init__(self, resolver, channels, poll_s: float = 0.5,
+                 out=None):
+        self.obs = ObservabilityService(resolver, channels)
+        self.poll_s = poll_s
+        self.out = out or sys.stdout
+        self.tracked_keys: list = []  # TaskKeys to poll progress for
+
+    def track(self, keys) -> None:
+        self.tracked_keys = list(keys)
+
+    def render_frame(self) -> str:
+        """One frame of the display (separated from run() for testing)."""
+        lines = []
+        lines.append(
+            f"{_BOLD}datafusion-distributed-tpu cluster console{_RESET}  "
+            f"{_DIM}{time.strftime('%H:%M:%S')}{_RESET}"
+        )
+        workers = self.obs.get_cluster_workers()
+        lines.append(f"\n{_BOLD}workers ({len(workers)}){_RESET}")
+        lines.append(
+            f"  {'url':<28} {'tasks':>5} {'ver':>7} {'status':>8}"
+        )
+        for w in workers:
+            if "error" in w:
+                lines.append(
+                    f"  {w.get('url', '?'):<28} {'-':>5} {'-':>7} "
+                    f"{'DOWN':>8}  {_DIM}{w['error'][:40]}{_RESET}"
+                )
+                continue
+            lines.append(
+                f"  {w.get('url', '?'):<28} "
+                f"{w.get('tasks_cached', 0):>5} "
+                f"{w.get('version', '-'):>7} "
+                f"{'up':>8}"
+            )
+        if self.tracked_keys:
+            prog = self.obs.get_task_progress(self.tracked_keys)
+            lines.append(f"\n{_BOLD}tasks ({len(prog)}){_RESET}")
+            for key, p in prog.items():
+                lines.append(
+                    f"  {key}  rows={p.get('output_rows', '?')} "
+                    f"worker={p.get('worker', '?')}"
+                )
+        sm = sample_system_metrics()
+        lines.append(
+            f"\n{_DIM}console rss={_fmt_bytes(sm.rss_bytes)} "
+            f"cpu={sm.cpu_seconds:.1f}s{_RESET}"
+        )
+        return "\n".join(lines)
+
+    def run(self, frames: Optional[int] = None) -> None:
+        """Redraw loop; frames=None runs until interrupted."""
+        count = 0
+        try:
+            while frames is None or count < frames:
+                self.out.write(_CLEAR + self.render_frame() + "\n")
+                self.out.flush()
+                count += 1
+                if frames is None or count < frames:
+                    time.sleep(self.poll_s)
+        except KeyboardInterrupt:
+            pass
+
+
+def main(argv: Optional[list] = None) -> None:
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv:
+        print(__doc__)
+        raise SystemExit(2)
+
+    class _StaticResolver:
+        def __init__(self, urls):
+            self.urls = urls
+
+        def get_urls(self):
+            return self.urls
+
+    class _GrpcChannels:
+        def __init__(self):
+            self._clients: dict = {}
+
+        def get_worker(self, url):
+            from datafusion_distributed_tpu.runtime.grpc_worker import (
+                GrpcWorkerClient,
+            )
+
+            if url not in self._clients:
+                self._clients[url] = GrpcWorkerClient(url)
+            return self._clients[url]
+
+    Console(_StaticResolver(argv), _GrpcChannels()).run()
+
+
+if __name__ == "__main__":
+    main()

@@ -300,10 +300,34 @@ def _eval_agg(spec, table, gid, live, num_slots, mode, seg_sum,
         avg = ssum / jnp.where(scnt == 0, 1, scnt)
         return {name: Column(avg, out_valid, DataType.FLOAT64)}
 
+    if spec.func in _VARIANCE_FUNCS and mode == "final":
+        s = table.column(f"{name}__sum")
+        sq = table.column(f"{name}__sumsq")
+        c = table.column(f"{name}__count")
+        valid = live & s.valid_mask()
+        ssum = seg_sum(jnp.where(valid, s.data, 0.0))
+        ssumsq = seg_sum(jnp.where(valid, sq.data, 0.0))
+        scnt = seg_sum(jnp.where(live, c.data, 0))
+        return {name: _variance_result(spec.func, ssum, ssumsq, scnt)}
+
     # partial/single over raw input
     col = table.column(spec.input_name)
     valid = col.valid_mask() & live
     vgid = jnp.where(valid, gid, num_slots)
+
+    if spec.func in _VARIANCE_FUNCS:
+        f = DataType.FLOAT64.np_dtype
+        vals = jnp.where(valid, col.data, 0).astype(f)
+        s = seg_sum(vals)
+        sq = seg_sum(vals * vals)
+        cnt = seg_sum(jnp.where(valid, 1, 0).astype(DataType.INT64.np_dtype))
+        if mode == "partial":
+            return {
+                f"{name}__sum": Column(s, cnt > 0, DataType.FLOAT64),
+                f"{name}__sumsq": Column(sq, cnt > 0, DataType.FLOAT64),
+                f"{name}__count": Column(cnt, None, DataType.INT64),
+            }
+        return {name: _variance_result(spec.func, s, sq, cnt)}
 
     if spec.func == "count":
         cnt = seg_sum(jnp.where(valid, 1, 0).astype(DataType.INT64.np_dtype))
@@ -349,6 +373,32 @@ def _eval_agg(spec, table, gid, live, num_slots, mode, seg_sum,
         }
 
     raise NotImplementedError(f"aggregate function {spec.func}")
+
+
+#: SQL variance family. Computed via the (sum, sumsq, count) decomposition —
+#: mergeable across partial/final stages like avg's (sum, count). The naive
+#: formula cancels catastrophically when stddev << mean; acceptable for the
+#: benchmark domains (quantities/prices), exact-enough in x64 mode.
+_VARIANCE_FUNCS = {"stddev", "stddev_samp", "stddev_pop", "var_samp",
+                   "var_pop"}
+
+
+def _variance_result(func: str, s, sq, cnt):
+    """(sum, sumsq, count) -> variance/stddev Column with SQL null rules
+    (samp needs n>=2, pop needs n>=1)."""
+    f = DataType.FLOAT64.np_dtype
+    pop = func.endswith("_pop")
+    sqrt = func.startswith("stddev")
+    n = cnt.astype(f)
+    safe_n = jnp.maximum(n, 1.0)
+    mean = s.astype(f) / safe_n
+    m2 = sq.astype(f) - n * mean * mean  # sum((x-mean)^2), up to rounding
+    m2 = jnp.maximum(m2, 0.0)
+    denom = safe_n if pop else jnp.maximum(n - 1.0, 1.0)
+    var = m2 / denom
+    out = jnp.sqrt(var) if sqrt else var
+    valid = cnt >= (1 if pop else 2)
+    return Column(out, valid, DataType.FLOAT64)
 
 
 def _check_int32_sum_range(vals, seg_sum, prec_flags):

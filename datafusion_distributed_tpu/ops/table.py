@@ -437,13 +437,6 @@ def concat_tables(tables: Sequence[Table], capacity: Optional[int] = None) -> Ta
                 raise ValueError(
                     f"concat dtype mismatch on {names[ci]!r}: {a.dtype} vs {b.dtype}"
                 )
-            if a.dictionary != b.dictionary:
-                # Codes are only comparable under a shared dictionary; loaders
-                # must unify dictionaries (io.catalog does) before concat.
-                raise ValueError(
-                    f"concat dictionary mismatch on {names[ci]!r}; re-encode "
-                    "against a unified dictionary first"
-                )
     # Overflow check when row counts are concrete (host path); under jit the
     # caller owns capacity sizing, as everywhere else in the engine.
     concrete = [t.num_rows for t in tables if not isinstance(t.num_rows, jax.core.Tracer)]
@@ -461,17 +454,58 @@ def concat_tables(tables: Sequence[Table], capacity: Optional[int] = None) -> Ta
     total_rows = acc
     for ci, name in enumerate(names):
         src_dtype = first.columns[ci].dtype
-        dictionary = first.columns[ci].dictionary
+        dictionary, luts = unify_dictionaries(
+            [t.columns[ci].dictionary for t in tables]
+        )
         has_validity = any(t.columns[ci].validity is not None for t in tables)
         data = jnp.zeros(total_cap, dtype=src_dtype.np_dtype)
         validity = jnp.zeros(total_cap, dtype=jnp.bool_) if has_validity else None
-        for t, off in zip(tables, offsets):
+        for t, off, lut in zip(tables, offsets, luts):
             col = t.columns[ci]
             live = t.row_mask()
             dst = jnp.where(live, off + jnp.arange(t.capacity, dtype=jnp.int32), total_cap)
-            data = data.at[dst].set(col.data, mode="drop")
+            vals = col.data
+            if lut is not None:
+                vals = jnp.asarray(lut)[jnp.clip(vals, 0, len(lut) - 1)]
+            data = data.at[dst].set(vals, mode="drop")
             if has_validity:
                 v = col.valid_mask()
                 validity = validity.at[dst].set(v, mode="drop")
         out_cols.append(Column(data, validity, src_dtype, dictionary))
     return Table(names, tuple(out_cols), total_rows)
+
+
+def unify_dictionaries(dicts):
+    """Pick a common dictionary for a set of string columns and per-input
+    code-remap LUTs (None = codes pass through). The union is SORTED, so
+    remapped codes preserve lexicographic order — callers use this for
+    concat, cross-dictionary comparison, and COALESCE alike.
+
+    Different Dictionary objects arise legitimately: each worker task's
+    SUBSTRING/UPPER/CONCAT evaluation derives its own dictionary, and SQL
+    NULL literals (ROLLUP arms, FULL OUTER padding) carry none at all. Codes
+    only compare under one vocabulary, so concat re-encodes into the sorted
+    union (the host-side analogue of the reference's dictionary re-encode
+    before the wire, `impl_execute_task.rs:244-274`)."""
+    present = [d for d in dicts if d is not None]
+    if not present:
+        return None, [None] * len(dicts)
+    unique = {d.dict_id: d for d in present}
+    if len(unique) == 1:
+        return present[0], [None] * len(dicts)
+    vals = [d.values.astype(str) for d in unique.values()]
+    if all(np.array_equal(v, vals[0]) for v in vals[1:]):
+        # same vocabulary, distinct objects (per-task derivations): codes
+        # already agree
+        return present[0], [None] * len(dicts)
+    union_vals = np.unique(np.concatenate(vals))
+    union = Dictionary(union_vals.astype(object))
+    luts = []
+    for d in dicts:
+        if d is None or len(d) == 0:
+            luts.append(None if d is None else np.zeros(1, dtype=np.int32))
+            continue
+        luts.append(
+            np.searchsorted(union_vals, d.values.astype(str)).astype(np.int32)
+        )
+    return union, luts

@@ -49,6 +49,12 @@ def shuffle_exchange(
     `network_shuffle.rs`: consumer i reads partition range [i*P,(i+1)*P) of
     every producer — here the all_to_all does exactly that swap in one ICI
     step). Output capacity = num_tasks * per_dest_capacity.
+
+    Bucketing is SORT-based (one stable argsort by destination), not the
+    O(C*T) one-hot cumsum matrix, so cost is ~flat in task count; and the
+    wire payload is ONE fused all_to_all per element-width class (every
+    column bit-cast to uint lanes and stacked), not one collective per
+    column — latency is ~flat in column count.
     """
     cap = table.capacity
     live = table.row_mask()
@@ -58,46 +64,63 @@ def shuffle_exchange(
     dest = (h % np.uint32(num_tasks)).astype(jnp.int32)
     dest = jnp.where(live, dest, num_tasks)  # dead rows go nowhere
 
-    # position of each row within its destination bucket
-    onehot = (
-        dest[:, None] == jnp.arange(num_tasks, dtype=jnp.int32)[None, :]
-    )  # [C, T]
-    within = jnp.cumsum(onehot.astype(jnp.int32), axis=0) - onehot.astype(
-        jnp.int32
-    )
-    pos_in_bucket = jnp.sum(within * onehot, axis=1)  # [C]
-    bucket_counts = jnp.sum(onehot, axis=0, dtype=jnp.int32)  # [T]
+    # sort-based bucketing: rows grouped by destination, dead rows last
+    order = jnp.argsort(dest, stable=True).astype(jnp.int32)  # [C]
+    sorted_dest = dest[order]
+    # start offset of each destination bucket in the sorted order
+    starts = jnp.searchsorted(
+        sorted_dest, jnp.arange(num_tasks + 1, dtype=jnp.int32)
+    ).astype(jnp.int32)  # [T+1]
+    bucket_counts = starts[1:] - starts[:-1]  # [T]
     overflow = jnp.any(bucket_counts > per_dest_capacity)
-
-    # scatter rows into the [T, per_dest_capacity] send buffer
-    flat_idx = dest * per_dest_capacity + jnp.minimum(
-        pos_in_bucket, per_dest_capacity - 1
-    )
+    ranks = jnp.arange(cap, dtype=jnp.int32) - starts[
+        jnp.clip(sorted_dest, 0, num_tasks)
+    ]  # position within own bucket, for rows in sorted order
     flat_idx = jnp.where(
-        (dest < num_tasks) & (pos_in_bucket < per_dest_capacity),
-        flat_idx,
+        (sorted_dest < num_tasks) & (ranks < per_dest_capacity),
+        sorted_dest * per_dest_capacity
+        + jnp.minimum(ranks, per_dest_capacity - 1),
         num_tasks * per_dest_capacity,  # dropped
     )
 
-    new_cols = []
-    for col in table.columns:
-        send = jnp.zeros(
-            num_tasks * per_dest_capacity, dtype=col.data.dtype
-        ).at[flat_idx].set(col.data, mode="drop")
-        send = send.reshape(num_tasks, per_dest_capacity)
-        recv = jax.lax.all_to_all(send, axis, 0, 0, tiled=False)
-        # recv: [T_src, per_dest_capacity] rows this task received
-        data = recv.reshape(num_tasks * per_dest_capacity)
+    # fuse every column (and validity lane) into stacked uint payloads,
+    # grouped by element width; ONE all_to_all per width class
+    lanes: list[tuple[int, jnp.ndarray]] = []  # (width, u-lane in sorted order)
+    layout: list[tuple[str, int, int]] = []  # (kind, col_idx, lane_idx)
+    for ci, col in enumerate(table.columns):
+        u = _bitcast_unsigned(col.data)[order]
+        layout.append(("data", ci, len(lanes)))
+        lanes.append((u.dtype.itemsize, u))
         if col.validity is not None:
-            vsend = jnp.zeros(
-                num_tasks * per_dest_capacity, dtype=jnp.bool_
-            ).at[flat_idx].set(col.validity, mode="drop")
-            vrecv = jax.lax.all_to_all(
-                vsend.reshape(num_tasks, per_dest_capacity), axis, 0, 0
-            )
-            validity = vrecv.reshape(num_tasks * per_dest_capacity)
+            v = col.validity[order].astype(jnp.uint8)
+            layout.append(("valid", ci, len(lanes)))
+            lanes.append((1, v))
+
+    recv_by_lane: dict[int, jnp.ndarray] = {}
+    for width in sorted({w for w, _ in lanes}):
+        idxs = [i for i, (w, _) in enumerate(lanes) if w == width]
+        stack = jnp.stack([lanes[i][1] for i in idxs], axis=1)  # [C, L]
+        nl = len(idxs)
+        send = jnp.zeros(
+            (num_tasks * per_dest_capacity, nl), dtype=stack.dtype
+        ).at[flat_idx].set(stack, mode="drop")
+        send = send.reshape(num_tasks, per_dest_capacity, nl)
+        recv = jax.lax.all_to_all(send, axis, 0, 0, tiled=False)
+        recv = recv.reshape(num_tasks * per_dest_capacity, nl)
+        for li, i in enumerate(idxs):
+            recv_by_lane[i] = recv[:, li]
+
+    new_cols = []
+    data_of: dict[int, jnp.ndarray] = {}
+    valid_of: dict[int, jnp.ndarray] = {}
+    for kind, ci, lane_idx in layout:
+        if kind == "data":
+            data_of[ci] = recv_by_lane[lane_idx]
         else:
-            validity = None
+            valid_of[ci] = recv_by_lane[lane_idx].astype(jnp.bool_)
+    for ci, col in enumerate(table.columns):
+        data = _bitcast_back(data_of[ci], col.data.dtype)
+        validity = valid_of.get(ci)
         new_cols.append(Column(data, validity, col.dtype, col.dictionary))
 
     # received per-source counts -> liveness mask + compaction
@@ -110,6 +133,25 @@ def shuffle_exchange(
     out = _compact_with_mask(out, live_mask)
     overflow = jax.lax.pmax(overflow.astype(jnp.int32), axis) > 0
     return out, overflow
+
+
+def _bitcast_unsigned(a: jnp.ndarray) -> jnp.ndarray:
+    """Bit-preserving view as a same-width unsigned integer lane."""
+    w = a.dtype.itemsize
+    if a.dtype == jnp.bool_:
+        return a.astype(jnp.uint8)
+    target = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}[w]
+    if a.dtype == target:
+        return a
+    return a.view(target)
+
+
+def _bitcast_back(u: jnp.ndarray, dtype) -> jnp.ndarray:
+    if dtype == jnp.bool_:
+        return u.astype(jnp.bool_)
+    if u.dtype == dtype:
+        return u
+    return u.view(dtype)
 
 
 def broadcast_exchange(table: Table, axis: str, num_tasks: int) -> Table:
@@ -137,6 +179,61 @@ def coalesce_exchange(table: Table, axis: str, num_tasks: int) -> Table:
     stage usually runs at task count 1, others see identical data — SPMD).
     The reference's NetworkCoalesceExec concatenates producer task streams."""
     return broadcast_exchange(table, axis, num_tasks)
+
+
+def group_coalesce_exchange(
+    table: Table, axis: str, num_tasks: int, num_consumers: int
+) -> Table:
+    """True N:M coalesce: consumer task j receives the CONTIGUOUS producer
+    group [j*g, (j+1)*g), g = ceil(N/M) — the reference's
+    `network_coalesce.rs:83-99` div_ceil group arithmetic; short groups
+    contribute empty streams and tasks >= M end up empty.
+
+    Implementation: g ppermute rounds (round r routes producer j*g+r ->
+    consumer j — an injective permutation, so it rides ICI point-to-point
+    links). Peak buffer is g*C per task instead of the all_gather's T*C, so
+    memory no longer scales with total task count when M > 1.
+    """
+    g = -(-num_tasks // num_consumers)  # div_ceil
+    if g == 1:
+        return table  # M >= N: every producer is its own (only) group member
+    me = jax.lax.axis_index(axis)
+    cap = table.capacity
+
+    recv_parts: list[Table] = []
+    for r in range(g):
+        # producer p = j*g + r sends to consumer j (skip out-of-range p)
+        perm = []
+        used_src = set()
+        for j in range(num_consumers):
+            src = j * g + r
+            if src < num_tasks:
+                perm.append((src, j))
+                used_src.add(src)
+        # ppermute requires nothing of unlisted tasks; their recv is zeros
+        part_cols = []
+        for col in table.columns:
+            data = jax.lax.ppermute(col.data, axis, perm)
+            validity = (
+                jax.lax.ppermute(col.validity, axis, perm)
+                if col.validity is not None else None
+            )
+            part_cols.append(Column(data, validity, col.dtype, col.dictionary))
+        nrows = jax.lax.ppermute(table.num_rows, axis, perm)
+        # tasks that received nothing this round hold zeroed buffers with
+        # nrows == 0 (ppermute zero-fills unaddressed receivers)
+        recv_parts.append(Table(table.names, tuple(part_cols), nrows))
+
+    from datafusion_distributed_tpu.ops.table import concat_tables
+
+    out = concat_tables(recv_parts, capacity=g * cap)
+    # tasks >= num_consumers received no group: force empty
+    is_consumer = me < num_consumers
+    out = Table(
+        out.names, out.columns,
+        jnp.where(is_consumer, out.num_rows, 0).astype(jnp.int32),
+    )
+    return out
 
 
 def _compact_with_mask(table: Table, keep: jnp.ndarray) -> Table:

@@ -22,10 +22,11 @@ from typing import Optional, Sequence
 
 import jax.numpy as jnp
 
-from datafusion_distributed_tpu.ops.table import Table, round_up_pow2
+from datafusion_distributed_tpu.ops.table import Column, Table, round_up_pow2
 from datafusion_distributed_tpu.parallel.exchange import (
     broadcast_exchange,
     coalesce_exchange,
+    group_coalesce_exchange,
     shuffle_exchange,
 )
 from datafusion_distributed_tpu.plan.physical import ExecContext, ExecutionPlan
@@ -49,6 +50,16 @@ class ExchangeExec(ExecutionPlan):
 
     def schema(self):
         return self.child.schema()
+
+    def execute(self, ctx: ExecContext):
+        """Memoized: an exchange's collective runs exactly once per traced
+        program (see ExecContext.exchange_cache)."""
+        cached = ctx.exchange_cache.get(self.node_id)
+        if cached is not None:
+            return cached
+        out = super().execute(ctx)
+        ctx.exchange_cache[self.node_id] = out
+        return out
 
     def _require_axis(self, ctx: ExecContext) -> str:
         axis = ctx.config.get("mesh_axis")
@@ -131,22 +142,182 @@ class PartitionReplicatedExec(ExchangeExec):
 
 
 class CoalesceExchangeExec(ExchangeExec):
-    """All tasks' rows gathered into one logical table (replicated)."""
+    """Producer tasks' rows coalesced for the consumer stage.
+
+    ``num_consumers == 1`` (default): gathered into one logical table,
+    replicated on every task (the consumer stage is the SPMD root).
+    ``num_consumers = M > 1``: true N:M — consumer task j holds the
+    contiguous producer group [j*g, (j+1)*g), g = div_ceil(N, M) (the
+    reference's `network_coalesce.rs` arithmetic); memory per task is
+    g*C instead of N*C."""
+
+    def __init__(self, child: ExecutionPlan, num_tasks: int,
+                 num_consumers: int = 1):
+        super().__init__(child, num_tasks)
+        self.num_consumers = num_consumers
 
     def with_new_children(self, children):
-        n = CoalesceExchangeExec(children[0], self.num_tasks)
+        n = CoalesceExchangeExec(
+            children[0], self.num_tasks, self.num_consumers
+        )
         n.stage_id = self.stage_id
         return n
 
     def output_capacity(self):
+        if self.num_consumers > 1:
+            g = -(-self.num_tasks // self.num_consumers)
+            return self.child.output_capacity() * g
         return self.child.output_capacity() * self.num_tasks
 
     def _execute(self, ctx: ExecContext) -> Table:
         t = self.child.execute(ctx)
-        return coalesce_exchange(t, self._require_axis(ctx), self.num_tasks)
+        axis = self._require_axis(ctx)
+        if self.num_consumers > 1:
+            return group_coalesce_exchange(
+                t, axis, self.num_tasks, self.num_consumers
+            )
+        return coalesce_exchange(t, axis, self.num_tasks)
 
     def display(self):
-        return f"CoalesceExchange tasks={self.num_tasks}"
+        m = (f" consumers={self.num_consumers}"
+             if self.num_consumers > 1 else "")
+        return f"CoalesceExchange tasks={self.num_tasks}{m}"
+
+
+class IsolatedArmExec(ExecutionPlan):
+    """One UNION arm assigned to a single task — the TPU-native analogue of
+    the reference's ChildrenIsolatorUnionExec child->task assignment
+    (`children_isolator_union.rs:39-100`). A replicated arm would otherwise
+    be computed identically on EVERY task and deduplicated after the fact
+    (x T wasted compute); isolation computes it exactly once:
+
+    - mesh tier: `lax.cond(axis_index == assigned, run_arm, empty)` — SPMD
+      control flow diverges per device, the arm's FLOPs execute on one chip
+      (arms contain no collectives by construction: exchanges end stages)
+    - host tier: task specialization ships the arm only to its assigned
+      worker (other tasks get an empty scan), mirroring the reference's
+      task-specialized plan stripping (`query_coordinator.rs:346-382`)
+    """
+
+    def __init__(self, child: ExecutionPlan, assigned_task: int):
+        super().__init__()
+        self.child = child
+        self.assigned_task = assigned_task
+
+    def children(self):
+        return [self.child]
+
+    def with_new_children(self, children):
+        return IsolatedArmExec(children[0], self.assigned_task)
+
+    def schema(self):
+        return self.child.schema()
+
+    def output_capacity(self):
+        return self.child.output_capacity()
+
+    def _execute(self, ctx: ExecContext) -> Table:
+        import jax
+
+        axis = ctx.config.get("mesh_axis")
+        if axis is None:
+            # host tier: static task index (specialization usually removed
+            # this node already; this is the in-process fallback)
+            if ctx.task.task_count > 1 and (
+                ctx.task.task_index != self.assigned_task
+            ):
+                return self._empty_like(ctx)
+            return self.child.execute(ctx)
+        me = jax.lax.axis_index(axis)
+
+        # Exchanges inside the arm contain COLLECTIVES, which every task
+        # must execute unconditionally (a collective inside one lax.cond
+        # branch deadlocks/aborts). Pre-execute them into the shared cache
+        # with the REAL context (their overflow flags propagate normally);
+        # the conditioned part is then only the arm's post-exchange local
+        # compute — which is exactly the duplicated-work segment isolation
+        # exists to eliminate.
+        for ex in self.child.collect(
+            lambda n: getattr(n, "is_exchange", False)
+        ):
+            ex.execute(ctx)
+
+        # Probe the arm under a throwaway context (sharing the exchange
+        # cache): its outputs are used for SHAPES/DTYPES only, so XLA
+        # dead-code-eliminates the probe's compute; its overflow/metric
+        # lists tell us the side-channel structure the cond branches must
+        # return explicitly (tracers may not escape a branch via ctx lists).
+        probe_ctx = ExecContext(
+            task=ctx.task, inputs=ctx.inputs, config=ctx.config,
+            exchange_cache=ctx.exchange_cache,
+        )
+        probe = self.child.execute(probe_ctx)
+        flag_names = [name for name, _ in probe_ctx.overflow_flags]
+        metric_keys = [(nid, name) for nid, name, _ in probe_ctx.metrics]
+        metric_dtypes = [v.dtype for _, _, v in probe_ctx.metrics]
+
+        def run_arm(_):
+            c2 = ExecContext(
+                task=ctx.task, inputs=ctx.inputs, config=ctx.config,
+                exchange_cache=ctx.exchange_cache,
+            )
+            t = self.child.execute(c2)
+            return (
+                t,
+                tuple(f for _, f in c2.overflow_flags),
+                tuple(v for _, _, v in c2.metrics),
+            )
+
+        def empty_arm(_):
+            cols = tuple(
+                Column(
+                    jnp.zeros(c.data.shape, c.data.dtype),
+                    jnp.zeros(c.validity.shape, jnp.bool_)
+                    if c.validity is not None else None,
+                    c.dtype,
+                    c.dictionary,
+                )
+                for c in probe.columns
+            )
+            t = Table(probe.names, cols, jnp.zeros((), dtype=jnp.int32))
+            return (
+                t,
+                tuple(jnp.zeros((), jnp.bool_) for _ in flag_names),
+                tuple(jnp.zeros((), d) for d in metric_dtypes),
+            )
+
+        out, flags, metrics = jax.lax.cond(
+            me == self.assigned_task, run_arm, empty_arm, None
+        )
+        for name, f in zip(flag_names, flags):
+            ctx.overflow_flags.append((name, f))
+        for (nid, name), v in zip(metric_keys, metrics):
+            ctx.metrics.append((nid, name, v))
+        return out
+
+    def _empty_like(self, ctx: ExecContext) -> Table:
+        probe_ctx = ExecContext(
+            task=ctx.task, inputs=ctx.inputs, config=ctx.config
+        )
+        t = self.child.execute(probe_ctx)
+        return Table(t.names, t.columns, jnp.zeros((), dtype=jnp.int32))
+
+    def display(self):
+        return f"IsolatedArm task={self.assigned_task}"
+
+
+def assign_arms_to_tasks(weights: Sequence[float], num_tasks: int) -> list:
+    """Weighted child->task assignment (greedy LPT): heaviest arm first to
+    the least-loaded task. Covers the reference's tasks <, =, > children
+    cases (`children_isolator_union.rs:39-83`): with fewer arms than tasks
+    some tasks receive none; with more, tasks receive several."""
+    loads = [0.0] * num_tasks
+    assignment = [0] * len(weights)
+    for i in sorted(range(len(weights)), key=lambda i: -weights[i]):
+        task = min(range(num_tasks), key=lambda t: loads[t])
+        assignment[i] = task
+        loads[task] += weights[i]
+    return assignment
 
 
 class BroadcastExchangeExec(ExchangeExec):

@@ -50,6 +50,15 @@ class ExprValue:
         return self.validity
 
 
+def _remap_codes(codes: jnp.ndarray, lut) -> jnp.ndarray:
+    """Apply a unify_dictionaries LUT (None = identity)."""
+    if lut is None:
+        return codes
+    if len(lut) == 0:
+        return jnp.zeros(codes.shape, dtype=jnp.int32)
+    return jnp.asarray(lut)[jnp.clip(codes, 0, len(lut) - 1)]
+
+
 def _merge_validity(*vs: Optional[jnp.ndarray]) -> Optional[jnp.ndarray]:
     present = [v for v in vs if v is not None]
     if not present:
@@ -246,12 +255,20 @@ class BinaryOp(PhysicalExpr):
             if op == ">=":
                 return codes >= pos_left
             raise NotImplementedError(op)
-        # column vs column: only valid when dictionaries are unified
-        if l.dictionary != r.dictionary:
-            raise ValueError(
-                "string column comparison requires a unified dictionary"
-            )
-        return _apply_cmp(self.op, l.data, r.data)
+        # column vs column. Same dictionary: codes compare directly (sorted
+        # dictionaries preserve order). Different dictionaries (e.g. one side
+        # is UPPER(...) with a derived dictionary): map both code spaces to
+        # ranks in the sorted union vocabulary at trace time — equal strings
+        # land on equal ranks and order is preserved, so every comparison op
+        # works (shared helper: ops.table.unify_dictionaries).
+        if l.dictionary is None or r.dictionary is None:
+            raise ValueError("string column comparison requires dictionaries")
+        from datafusion_distributed_tpu.ops.table import unify_dictionaries
+
+        _, luts = unify_dictionaries([l.dictionary, r.dictionary])
+        a = _remap_codes(l.data, luts[0])
+        b = _remap_codes(r.data, luts[1])
+        return _apply_cmp(self.op, a, b)
 
     def output_field(self, schema: Schema) -> Field:
         lf = self.left.output_field(schema)
@@ -378,8 +395,32 @@ class Cast(PhysicalExpr):
         c = self.child.evaluate(table)
         if c.dtype == self.to:
             return c
-        if c.dtype == DataType.STRING or self.to == DataType.STRING:
-            raise NotImplementedError("string casts happen at plan time")
+        if c.dtype == DataType.STRING:
+            # dictionary-LUT cast: parse each vocab entry host-side at trace
+            # time, device gathers by code (unparseable entries -> null)
+            if c.dictionary is None:
+                raise NotImplementedError("string cast without dictionary")
+            vals = c.dictionary.values.astype(str)
+            parsed = np.zeros(max(len(vals), 1), dtype=self.to.np_dtype)
+            ok = np.zeros(max(len(vals), 1), dtype=np.bool_)
+            for i, v in enumerate(vals):
+                try:
+                    if self.to == DataType.DATE32:
+                        parsed[i] = parse_date(v)
+                    elif self.to.is_float:
+                        parsed[i] = float(v)
+                    else:
+                        parsed[i] = int(float(v))
+                    ok[i] = True
+                except (ValueError, OverflowError):
+                    pass
+            idx = jnp.clip(c.data, 0, max(len(vals) - 1, 0))
+            data = jnp.asarray(parsed)[idx]
+            valid = jnp.asarray(ok)[idx]
+            validity = _merge_validity(c.validity, valid)
+            return ExprValue(data, validity, self.to)
+        if self.to == DataType.STRING:
+            raise NotImplementedError("cast to string is not supported")
         return ExprValue(c.data.astype(self.to.np_dtype), c.validity, self.to)
 
     def output_field(self, schema: Schema) -> Field:
@@ -554,7 +595,9 @@ def _civil_from_days(z: jnp.ndarray):
 
 @dataclass
 class Extract(PhysicalExpr):
-    """EXTRACT(year|month|day FROM date_col)."""
+    """EXTRACT(part FROM x). DATE32 children are days since epoch; integer
+    children are interpreted as epoch SECONDS (the ClickBench convention:
+    `extract(minute from to_timestamp_seconds("EventTime"))`)."""
 
     part: str
     child: PhysicalExpr
@@ -564,8 +607,23 @@ class Extract(PhysicalExpr):
 
     def evaluate(self, table: Table) -> ExprValue:
         c = self.child.evaluate(table)
-        y, m, d = _civil_from_days(c.data)
-        out = {"year": y, "month": m, "day": d}[self.part]
+        if c.dtype == DataType.DATE32:
+            days = c.data
+            secs_of_day = None
+        else:
+            days = jnp.floor_divide(c.data.astype(jnp.int32), 86400)
+            secs_of_day = jnp.mod(c.data.astype(jnp.int32), 86400)
+        if self.part in ("hour", "minute", "second"):
+            if secs_of_day is None:
+                secs_of_day = jnp.zeros_like(days)
+            out = {
+                "hour": secs_of_day // 3600,
+                "minute": (secs_of_day // 60) % 60,
+                "second": secs_of_day % 60,
+            }[self.part]
+        else:
+            y, m, d = _civil_from_days(days)
+            out = {"year": y, "month": m, "day": d}[self.part]
         return ExprValue(out.astype(DataType.INT64.np_dtype), c.validity, DataType.INT64)
 
     def output_field(self, schema: Schema) -> Field:
@@ -574,6 +632,40 @@ class Extract(PhysicalExpr):
 
     def display(self) -> str:
         return f"EXTRACT({self.part} FROM {self.child.display()})"
+
+
+@dataclass
+class DateTrunc(PhysicalExpr):
+    """DATE_TRUNC(unit, x) over epoch-seconds integers (ClickBench) or
+    DATE32 days: truncate to the unit boundary, keeping the input dtype."""
+
+    unit: str
+    child: PhysicalExpr
+
+    _SECONDS = {"second": 1, "minute": 60, "hour": 3600, "day": 86400}
+
+    def children(self):
+        return [self.child]
+
+    def evaluate(self, table: Table) -> ExprValue:
+        c = self.child.evaluate(table)
+        unit = self.unit.lower()
+        if c.dtype == DataType.DATE32:
+            if unit in ("second", "minute", "hour", "day"):
+                return c
+            raise NotImplementedError(f"DATE_TRUNC {unit} on date32")
+        step = self._SECONDS.get(unit)
+        if step is None:
+            raise NotImplementedError(f"DATE_TRUNC unit {unit}")
+        data = c.data - jnp.mod(c.data, step)
+        return ExprValue(data, c.validity, c.dtype)
+
+    def output_field(self, schema: Schema) -> Field:
+        f = self.child.output_field(schema)
+        return Field(self.display(), f.dtype, f.nullable)
+
+    def display(self) -> str:
+        return f"DATE_TRUNC('{self.unit}', {self.child.display()})"
 
 
 @dataclass
@@ -621,6 +713,298 @@ class Substring(PhysicalExpr):
     def display(self) -> str:
         ln = f" FOR {self.length}" if self.length is not None else ""
         return f"SUBSTRING({self.child.display()} FROM {self.start}{ln})"
+
+
+@dataclass
+class Coalesce(PhysicalExpr):
+    """COALESCE(a, b, ...): first non-null value per row. String children
+    resolve through a union dictionary built at trace time (the derived-
+    dictionary pattern of Substring)."""
+
+    args: tuple
+
+    def children(self):
+        return list(self.args)
+
+    def evaluate(self, table: Table) -> ExprValue:
+        vals = [a.evaluate(table) for a in self.args]
+        if any(v.dtype == DataType.STRING for v in vals):
+            return self._evaluate_strings(vals, table)
+        out_dtype = vals[0].dtype
+        for v in vals[1:]:
+            out_dtype = _promote(out_dtype, v.dtype)
+        data = vals[-1].data.astype(out_dtype.np_dtype)
+        validity = vals[-1].valid_mask()
+        for v in reversed(vals[:-1]):
+            take = v.valid_mask()
+            data = jnp.where(take, v.data.astype(out_dtype.np_dtype), data)
+            validity = take | validity
+        if all(v.validity is None for v in vals):
+            validity = None
+        elif any(v.validity is None for v in vals):
+            validity = None  # some child is always valid -> result is too
+        return ExprValue(data, validity, out_dtype)
+
+    def _evaluate_strings(self, vals, table: Table) -> ExprValue:
+        if not all(v.dtype == DataType.STRING for v in vals):
+            raise ValueError("COALESCE mixes string and non-string types")
+        from datafusion_distributed_tpu.ops.table import unify_dictionaries
+
+        union, luts = unify_dictionaries([v.dictionary for v in vals])
+        data = jnp.zeros(table.capacity, dtype=np.int32)
+        validity = jnp.zeros(table.capacity, dtype=jnp.bool_)
+        for v, lut in zip(reversed(vals), reversed(luts)):
+            codes = _remap_codes(v.data, lut)
+            take = v.valid_mask()
+            data = jnp.where(take, codes, data)
+            validity = take | validity
+        out_validity = None if any(v.validity is None for v in vals) else (
+            validity
+        )
+        return ExprValue(data, out_validity, DataType.STRING, union)
+
+    def output_field(self, schema: Schema) -> Field:
+        f0 = self.args[0].output_field(schema)
+        out = f0.dtype
+        for a in self.args[1:]:
+            fa = a.output_field(schema)
+            if out != DataType.STRING or fa.dtype != DataType.STRING:
+                out = _promote(out, fa.dtype)
+        nullable = all(a.output_field(schema).nullable for a in self.args)
+        return Field(self.display(), out, nullable)
+
+    def display(self) -> str:
+        inner = ", ".join(a.display() for a in self.args)
+        return f"COALESCE({inner})"
+
+
+@dataclass
+class Abs(PhysicalExpr):
+    child: PhysicalExpr
+
+    def children(self):
+        return [self.child]
+
+    def evaluate(self, table: Table) -> ExprValue:
+        c = self.child.evaluate(table)
+        return ExprValue(jnp.abs(c.data), c.validity, c.dtype)
+
+    def output_field(self, schema: Schema) -> Field:
+        f = self.child.output_field(schema)
+        return Field(self.display(), f.dtype, f.nullable)
+
+    def display(self) -> str:
+        return f"ABS({self.child.display()})"
+
+
+@dataclass
+class Round(PhysicalExpr):
+    child: PhysicalExpr
+    digits: int = 0
+
+    def children(self):
+        return [self.child]
+
+    def evaluate(self, table: Table) -> ExprValue:
+        c = self.child.evaluate(table)
+        if c.dtype.is_integer:
+            return c
+        scale = 10.0 ** self.digits
+        data = jnp.round(c.data * scale) / scale
+        return ExprValue(data, c.validity, c.dtype)
+
+    def output_field(self, schema: Schema) -> Field:
+        f = self.child.output_field(schema)
+        return Field(self.display(), f.dtype, f.nullable)
+
+    def display(self) -> str:
+        return f"ROUND({self.child.display()}, {self.digits})"
+
+
+@dataclass
+class StringCase(PhysicalExpr):
+    """UPPER/LOWER on a dictionary string column: host-side dictionary
+    transform + code remap (same pattern as Substring)."""
+
+    child: PhysicalExpr
+    upper: bool
+
+    def children(self):
+        return [self.child]
+
+    def evaluate(self, table: Table) -> ExprValue:
+        c = self.child.evaluate(table)
+        if c.dtype != DataType.STRING or c.dictionary is None:
+            raise ValueError("UPPER/LOWER requires a dictionary string column")
+        vals = c.dictionary.values.astype(str)
+        derived = np.char.upper(vals) if self.upper else np.char.lower(vals)
+        uniq, inverse = np.unique(derived, return_inverse=True)
+        new_dict = Dictionary(uniq.astype(object))
+        if len(vals) == 0:
+            return ExprValue(c.data, c.validity, DataType.STRING, new_dict)
+        lut = jnp.asarray(inverse.astype(np.int32))
+        codes = lut[jnp.clip(c.data, 0, len(vals) - 1)]
+        return ExprValue(codes, c.validity, DataType.STRING, new_dict)
+
+    def output_field(self, schema: Schema) -> Field:
+        f = self.child.output_field(schema)
+        return Field(self.display(), DataType.STRING, f.nullable)
+
+    def display(self) -> str:
+        fn = "UPPER" if self.upper else "LOWER"
+        return f"{fn}({self.child.display()})"
+
+
+@dataclass
+class StrLength(PhysicalExpr):
+    """LENGTH(str): dictionary-LUT transform (host computes per-vocab-entry
+    lengths at trace time; device gathers by code)."""
+
+    child: PhysicalExpr
+
+    def children(self):
+        return [self.child]
+
+    def evaluate(self, table: Table) -> ExprValue:
+        c = self.child.evaluate(table)
+        if c.dtype != DataType.STRING or c.dictionary is None:
+            raise ValueError("LENGTH requires a dictionary string column")
+        vals = c.dictionary.values.astype(str)
+        lut = np.asarray([len(v) for v in vals], dtype=np.int32)
+        if len(lut) == 0:
+            data = jnp.zeros(c.data.shape, dtype=jnp.int32)
+        else:
+            data = jnp.asarray(lut)[jnp.clip(c.data, 0, len(lut) - 1)]
+        return ExprValue(data, c.validity, DataType.INT32)
+
+    def output_field(self, schema: Schema) -> Field:
+        f = self.child.output_field(schema)
+        return Field(self.display(), DataType.INT32, f.nullable)
+
+    def display(self) -> str:
+        return f"LENGTH({self.child.display()})"
+
+
+@dataclass
+class RegexpReplace(PhysicalExpr):
+    """REGEXP_REPLACE(str, pattern, replacement): host re.sub over the
+    dictionary at trace time, derived dictionary + code remap."""
+
+    child: PhysicalExpr
+    pattern: str
+    replacement: str
+
+    def children(self):
+        return [self.child]
+
+    def evaluate(self, table: Table) -> ExprValue:
+        c = self.child.evaluate(table)
+        if c.dtype != DataType.STRING or c.dictionary is None:
+            raise ValueError(
+                "REGEXP_REPLACE requires a dictionary string column"
+            )
+        rx = re.compile(self.pattern)
+        # SQL regex replacement uses \1 backrefs; python re.sub shares that
+        repl = self.replacement
+        vals = c.dictionary.values.astype(str)
+        derived = np.asarray([rx.sub(repl, v) for v in vals], dtype=object)
+        uniq, inverse = np.unique(derived.astype(str), return_inverse=True)
+        new_dict = Dictionary(uniq.astype(object))
+        if len(vals) == 0:
+            return ExprValue(c.data, c.validity, DataType.STRING, new_dict)
+        lut = jnp.asarray(inverse.astype(np.int32))
+        codes = lut[jnp.clip(c.data, 0, len(vals) - 1)]
+        return ExprValue(codes, c.validity, DataType.STRING, new_dict)
+
+    def output_field(self, schema: Schema) -> Field:
+        f = self.child.output_field(schema)
+        return Field(self.display(), DataType.STRING, f.nullable)
+
+    def display(self) -> str:
+        return (
+            f"REGEXP_REPLACE({self.child.display()}, "
+            f"{self.pattern!r}, {self.replacement!r})"
+        )
+
+
+_CONCAT_COMBO_CAP = 1 << 22
+_CONCAT_DICT_CACHE: dict = {}
+
+
+@dataclass
+class ConcatStrings(PhysicalExpr):
+    """CONCAT over string columns/literals: the combined dictionary is the
+    cross product of the children's dictionaries (built host-side at trace
+    time), and per-row codes compose positionally — device work stays a
+    couple of integer ops + one gather. Bounded by the combo cap; wide-NDV
+    concatenations should dictionary-encode upstream first."""
+
+    args: tuple
+
+    def children(self):
+        return list(self.args)
+
+    def evaluate(self, table: Table) -> ExprValue:
+        vals = [a.evaluate(table) for a in self.args]
+        dict_parts = []  # (index into vals, values array)
+        for i, v in enumerate(vals):
+            if v.dtype != DataType.STRING or v.dictionary is None:
+                raise ValueError("CONCAT requires string children")
+            dict_parts.append((i, v.dictionary.values.astype(str)))
+        sizes = [max(len(d), 1) for _, d in dict_parts]
+        total = 1
+        for s in sizes:
+            total *= s
+        if total > _CONCAT_COMBO_CAP:
+            raise ValueError(
+                f"CONCAT dictionary cross product {total} exceeds cap "
+                f"{_CONCAT_COMBO_CAP}"
+            )
+        # combo index = sum(code_i * stride_i), strides right-to-left
+        strides = [1] * len(sizes)
+        for i in range(len(sizes) - 2, -1, -1):
+            strides[i] = strides[i + 1] * sizes[i + 1]
+        # the derived dictionary depends only on the input dictionaries —
+        # memoize per dict-id tuple so per-task re-traces (workers, retries)
+        # don't redo the cross-product host work
+        cache_key = tuple(
+            v.dictionary.dict_id for v in vals
+        )
+        cached = _CONCAT_DICT_CACHE.get(cache_key)
+        if cached is None:
+            import itertools as _it
+
+            combos = [""] * total
+            for flat, parts in enumerate(
+                _it.product(*[d if len(d) else [""] for _, d in dict_parts])
+            ):
+                combos[flat] = "".join(parts)
+            uniq, inverse = np.unique(
+                np.asarray(combos, dtype=object).astype(str),
+                return_inverse=True,
+            )
+            cached = (Dictionary(uniq.astype(object)),
+                      inverse.astype(np.int32))
+            if len(_CONCAT_DICT_CACHE) > 64:
+                _CONCAT_DICT_CACHE.clear()
+            _CONCAT_DICT_CACHE[cache_key] = cached
+        new_dict, inverse_np = cached
+        lut = jnp.asarray(inverse_np)
+        flat_code = jnp.zeros(table.capacity, dtype=jnp.int32)
+        for (i, d), size, stride in zip(dict_parts, sizes, strides):
+            code = jnp.clip(vals[i].data, 0, size - 1)
+            flat_code = flat_code + code * np.int32(stride)
+        codes = lut[jnp.clip(flat_code, 0, total - 1)]
+        validity = _merge_validity(*[v.validity for v in vals])
+        return ExprValue(codes, validity, DataType.STRING, new_dict)
+
+    def output_field(self, schema: Schema) -> Field:
+        nullable = any(a.output_field(schema).nullable for a in self.args)
+        return Field(self.display(), DataType.STRING, nullable)
+
+    def display(self) -> str:
+        inner = ", ".join(a.display() for a in self.args)
+        return f"CONCAT({inner})"
 
 
 @dataclass

@@ -71,6 +71,11 @@ class ExecContext:
     # executor returns these as program outputs and stitches them into a
     # MetricsStore host-side (runtime/metrics.py).
     metrics: list = dc_field(default_factory=list)
+    # exchange-node memoization (node_id -> Table): collectives must execute
+    # exactly once per program and OUTSIDE any lax.cond (all tasks
+    # participate unconditionally); IsolatedArmExec relies on this to
+    # pre-execute an arm's exchanges before conditioning its local compute
+    exchange_cache: dict = dc_field(default_factory=dict)
 
     def record_overflow(self, node: "ExecutionPlan", flag) -> None:
         self.overflow_flags.append((node.label(), flag))
@@ -172,13 +177,18 @@ class MemoryScanExec(ExecutionPlan):
     """
 
     def __init__(self, tasks: Sequence[Table], schema: Schema,
-                 pinned: bool = False):
+                 pinned: bool = False, replicated: bool = False):
         super().__init__()
         self.tasks = list(tasks)
         self._schema = schema
         # pinned: this scan is already task-specialized (holds exactly the
         # executing task's slice); ignore task_index on load
         self.pinned = pinned
+        # replicated: one logical table served identically to EVERY task
+        # (coalesce/broadcast exchange outputs) — load ignores task_index,
+        # and the coordinator may run a stage reading only replicated scans
+        # as a single task (its output is the complete result)
+        self.replicated = replicated
 
     def children(self):
         return []
@@ -194,7 +204,7 @@ class MemoryScanExec(ExecutionPlan):
         return max(t.capacity for t in self.tasks)
 
     def load(self, task: DistributedTaskContext) -> Table:
-        if self.pinned:
+        if self.pinned or self.replicated:
             return self.tasks[0]
         if task.task_index >= len(self.tasks):
             # Tasks beyond the data slices read nothing (the reference's
@@ -413,12 +423,22 @@ class HashAggregateExec(ExecutionPlan):
 
 
 def _agg_output_fields(a: AggSpec, child_schema: Schema, mode: str) -> list[Field]:
+    from datafusion_distributed_tpu.ops.aggregate import _VARIANCE_FUNCS
+
     if a.func == "count_star" or a.func == "count":
         return [Field(a.output_name, DataType.INT64, nullable=False)]
     if a.func == "avg":
         if mode == "partial":
             return [
                 Field(f"{a.output_name}__sum", DataType.FLOAT64, True),
+                Field(f"{a.output_name}__count", DataType.INT64, False),
+            ]
+        return [Field(a.output_name, DataType.FLOAT64, True)]
+    if a.func in _VARIANCE_FUNCS:
+        if mode == "partial":
+            return [
+                Field(f"{a.output_name}__sum", DataType.FLOAT64, True),
+                Field(f"{a.output_name}__sumsq", DataType.FLOAT64, True),
                 Field(f"{a.output_name}__count", DataType.INT64, False),
             ]
         return [Field(a.output_name, DataType.FLOAT64, True)]

@@ -90,6 +90,55 @@ class DistributedConfig:
     broadcast_threshold_rows: int = 1 << 17  # build sides smaller: broadcast
     shuffle_skew_factor: int = 4
     max_tasks_per_stage: int = 0  # 0 = num_tasks
+    # wire-format knobs (reference: distributed_config.rs compression=lz4,
+    # worker_connection_buffer_budget_bytes=64MiB; zstd here — lz4 is not in
+    # this image)
+    compression: str = "zstd"  # "zstd" | "none"
+    worker_connection_buffer_budget_bytes: int = 64 << 20
+    shuffle_chunk_bytes: int = 1 << 20
+    # task-count estimation (reference: file_scan_config_bytes_per_partition
+    # 16MiB + dynamic_task_count): leaves sized by bytes, not mesh size
+    bytes_per_task: int = 16 << 20
+    dynamic_task_count: bool = False
+    # cost multiplier applied per cardinality-affecting node when scaling
+    # consumer task counts (cardinality_task_count_factor analogue)
+    cardinality_task_count_factor: float = 1.0
+    # size task counts from leaf bytes (FileScanConfigTaskEstimator
+    # semantics, task_estimator.rs:235-258): tasks = ceil(bytes /
+    # bytes_per_task), capped at num_tasks. Host/coordinator tier only —
+    # a mesh SPMD program's task count is the physical device count.
+    size_tasks_to_data: bool = False
+
+
+def estimate_leaf_bytes(plan: ExecutionPlan) -> int:
+    """Total estimated input bytes across the plan's leaves."""
+    import os as _os
+
+    from datafusion_distributed_tpu.planner.statistics import row_width
+
+    total = 0
+    for leaf in plan.collect(lambda n: not n.children()):
+        if isinstance(leaf, MemoryScanExec):
+            rows = sum(int(t.num_rows) for t in leaf.tasks)
+            total += rows * row_width(leaf.schema())
+        elif isinstance(leaf, ParquetScanExec):
+            for group in leaf.file_groups:
+                for f in group:
+                    try:
+                        total += _os.path.getsize(f)
+                    except OSError:
+                        pass
+    return total
+
+
+def effective_num_tasks(plan: ExecutionPlan, config: DistributedConfig) -> int:
+    """Bytes-based task count (the reference's ceil(total_bytes /
+    bytes_per_partition) leaf estimation), clamped to [1, num_tasks]."""
+    if not config.size_tasks_to_data or config.bytes_per_task <= 0:
+        return config.num_tasks
+    bytes_total = estimate_leaf_bytes(plan)
+    want = -(-bytes_total // config.bytes_per_task) if bytes_total else 1
+    return max(1, min(int(want), config.num_tasks))
 
 
 def distribute_plan(
@@ -97,6 +146,11 @@ def distribute_plan(
 ) -> ExecutionPlan:
     """Rewrite a single-node plan into a staged distributed plan whose root
     output is replicated (safe to read from any task)."""
+    t_eff = effective_num_tasks(plan, config)
+    if t_eff != config.num_tasks:
+        from dataclasses import replace as _replace
+
+        config = _replace(config, num_tasks=t_eff)
     out, dist = _inject(plan, config)
     if dist == Distribution.PARTITIONED:
         out = CoalesceExchangeExec(out, config.num_tasks)
@@ -191,18 +245,29 @@ def _inject(plan: ExecutionPlan, cfg: DistributedConfig):
 
     if isinstance(plan, UnionExec):
         from datafusion_distributed_tpu.plan.exchanges import (
-            PartitionReplicatedExec,
+            IsolatedArmExec,
+            assign_arms_to_tasks,
         )
 
         children = []
-        for c in plan.children():
+        replicated_idx = []
+        for i, c in enumerate(plan.children()):
             cc, cdist = _inject(c, cfg)
             if cdist == Distribution.REPLICATED:
-                # a replicated arm unioned as-is would contribute its rows
-                # from every task (T duplicates after the root coalesce);
-                # re-partition it by row index first
-                cc = PartitionReplicatedExec(cc, t)
+                replicated_idx.append(len(children))
             children.append(cc)
+        if replicated_idx:
+            # child isolation (ChildrenIsolatorUnionExec analogue): each
+            # replicated arm is COMPUTED on exactly one task — weighted
+            # greedy assignment; running it everywhere and row-slicing after
+            # the fact (round-1's PartitionReplicated) pays the arm's FLOPs
+            # T times
+            weights = [
+                float(children[i].output_capacity()) for i in replicated_idx
+            ]
+            assigned = assign_arms_to_tasks(weights, t)
+            for i, task in zip(replicated_idx, assigned):
+                children[i] = IsolatedArmExec(children[i], task)
         return UnionExec(children), Distribution.PARTITIONED
 
     if not plan.children():
@@ -359,3 +424,51 @@ def display_staged_plan(plan: ExecutionPlan) -> str:
 
     walk(plan, 0)
     return "\n".join(lines)
+
+
+def display_staged_plan_graphviz(plan: ExecutionPlan) -> str:
+    """Graphviz DOT rendering with one cluster per stage (the reference's
+    display_plan_graphviz, `stage.rs:618-685`). Render with
+    `dot -Tsvg plan.dot`."""
+    nodes: list[str] = []
+    edges: list[str] = []
+    clusters: dict[int, list[str]] = {}
+
+    def nid(node) -> str:
+        return f"n{node.node_id}"
+
+    def walk(node, stage: int) -> None:
+        label = node.display().replace('"', "'")
+        this_stage = stage
+        if getattr(node, "is_exchange", False) and node.stage_id is not None:
+            this_stage = node.stage_id
+            nodes.append(
+                f'  {nid(node)} [label="{label}", shape=cds, '
+                'style=filled, fillcolor=lightsteelblue];'
+            )
+        else:
+            clusters.setdefault(stage, []).append(
+                f'    {nid(node)} [label="{label}", shape=box];'
+            )
+        for c in node.children():
+            child_stage = this_stage
+            if getattr(node, "is_exchange", False):
+                # an exchange's child opens its producer stage
+                child_stage = (
+                    node.stage_id if node.stage_id is not None else stage
+                )
+            walk(c, child_stage)
+            edges.append(f"  {nid(c)} -> {nid(node)};")
+
+    walk(plan, -1)
+    out = ["digraph staged_plan {", "  rankdir=BT;"]
+    out.extend(nodes)
+    for stage, members in sorted(clusters.items()):
+        name = "root" if stage == -1 else f"stage_{stage}"
+        out.append(f"  subgraph cluster_{name.replace('-', 'm')} {{")
+        out.append(f'    label="{name}";')
+        out.extend(members)
+        out.append("  }")
+    out.extend(edges)
+    out.append("}")
+    return "\n".join(out)

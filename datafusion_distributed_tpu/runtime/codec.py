@@ -25,6 +25,7 @@ from datafusion_distributed_tpu.plan import expressions as pe
 from datafusion_distributed_tpu.plan.exchanges import (
     BroadcastExchangeExec,
     CoalesceExchangeExec,
+    IsolatedArmExec,
     PartitionReplicatedExec,
     ShuffleExchangeExec,
 )
@@ -154,6 +155,29 @@ def encode_expr(e: pe.PhysicalExpr) -> dict:
     if isinstance(e, pe.Substring):
         return {"t": "substr", "c": encode_expr(e.child), "start": e.start,
                 "length": e.length}
+    if isinstance(e, pe.Coalesce):
+        return {"t": "coalesce", "args": [encode_expr(a) for a in e.args]}
+    if isinstance(e, pe.Abs):
+        return {"t": "abs", "c": encode_expr(e.child)}
+    if isinstance(e, pe.Round):
+        return {"t": "round", "c": encode_expr(e.child), "digits": e.digits}
+    if isinstance(e, pe.StringCase):
+        return {"t": "strcase", "c": encode_expr(e.child), "upper": e.upper}
+    if isinstance(e, pe.ConcatStrings):
+        return {"t": "concat", "args": [encode_expr(a) for a in e.args]}
+    if isinstance(e, pe.DateTrunc):
+        return {"t": "datetrunc", "unit": e.unit, "c": encode_expr(e.child)}
+    if isinstance(e, pe.StrLength):
+        return {"t": "strlen", "c": encode_expr(e.child)}
+    if isinstance(e, pe.RegexpReplace):
+        return {"t": "regexp_replace", "c": encode_expr(e.child),
+                "p": e.pattern, "r": e.replacement}
+    # a resolved scalar subquery is a constant by the time plans ship
+    from datafusion_distributed_tpu.sql.logical import ScalarSubqueryExpr
+
+    if isinstance(e, ScalarSubqueryExpr) and getattr(e, "resolved", None):
+        value, dtype = e.resolved
+        return {"t": "lit", "value": value, "dtype": dtype.value}
     raise CodecError(f"cannot encode expression {type(e).__name__}")
 
 
@@ -191,6 +215,22 @@ def decode_expr(o: dict) -> pe.PhysicalExpr:
         return pe.Extract(o["part"], decode_expr(o["c"]))
     if t == "substr":
         return pe.Substring(decode_expr(o["c"]), o["start"], o["length"])
+    if t == "coalesce":
+        return pe.Coalesce(tuple(decode_expr(a) for a in o["args"]))
+    if t == "abs":
+        return pe.Abs(decode_expr(o["c"]))
+    if t == "round":
+        return pe.Round(decode_expr(o["c"]), o["digits"])
+    if t == "strcase":
+        return pe.StringCase(decode_expr(o["c"]), o["upper"])
+    if t == "concat":
+        return pe.ConcatStrings(tuple(decode_expr(a) for a in o["args"]))
+    if t == "datetrunc":
+        return pe.DateTrunc(o["unit"], decode_expr(o["c"]))
+    if t == "strlen":
+        return pe.StrLength(decode_expr(o["c"]))
+    if t == "regexp_replace":
+        return pe.RegexpReplace(decode_expr(o["c"]), o["p"], o["r"])
     raise CodecError(f"cannot decode expression kind {t!r}")
 
 
@@ -206,6 +246,7 @@ def encode_plan(p: ExecutionPlan, store: TableStore) -> dict:
             "tables": [store.put(t) for t in p.tasks],
             "schema": encode_schema(p.schema()),
             "pinned": p.pinned,
+            "replicated": p.replicated,
         }
     if isinstance(p, ParquetScanExec):
         return {
@@ -298,6 +339,9 @@ def encode_plan(p: ExecutionPlan, store: TableStore) -> dict:
     if isinstance(p, PartitionReplicatedExec):
         return {"t": "partrep", "tasks": p.num_tasks, "stage": p.stage_id,
                 "c": encode_plan(p.child, store)}
+    if isinstance(p, IsolatedArmExec):
+        return {"t": "isoarm", "task": p.assigned_task,
+                "c": encode_plan(p.child, store)}
     kind = getattr(p, "codec_kind", None)
     if kind and kind in _USER_CODECS:
         enc, _ = _USER_CODECS[kind]
@@ -310,7 +354,8 @@ def decode_plan(o: dict, store: TableStore) -> ExecutionPlan:
     if t == "memscan":
         tables = [store.get(tid) for tid in o["tables"]]
         return MemoryScanExec(tables, decode_schema(o["schema"]),
-                              pinned=o.get("pinned", False))
+                              pinned=o.get("pinned", False),
+                              replicated=o.get("replicated", False))
     if t == "pqscan":
         from datafusion_distributed_tpu.ops.table import Dictionary
         import numpy as np
@@ -388,6 +433,8 @@ def decode_plan(o: dict, store: TableStore) -> ExecutionPlan:
         n = PartitionReplicatedExec(decode_plan(o["c"], store), o["tasks"])
         n.stage_id = o["stage"]
         return n
+    if t == "isoarm":
+        return IsolatedArmExec(decode_plan(o["c"], store), o["task"])
     if t.startswith("user:"):
         kind = t[5:]
         if kind not in _USER_CODECS:

@@ -32,6 +32,7 @@ from datafusion_distributed_tpu.ops.table import Table, concat_tables, round_up_
 from datafusion_distributed_tpu.plan.exchanges import (
     BroadcastExchangeExec,
     CoalesceExchangeExec,
+    IsolatedArmExec,
     PartitionReplicatedExec,
     ShuffleExchangeExec,
 )
@@ -82,10 +83,26 @@ class Coordinator:
     route_tasks: Optional[Callable] = None  # custom routing hook
     collect_metrics: bool = True
     metrics: dict = field(default_factory=dict)  # TaskKey -> worker metrics
+    # `SET distributed.*` options propagated to every worker with the plan
+    # (the config-over-headers flow, `config_extension_ext.rs:1-82`)
+    config_options: dict = field(default_factory=dict)
+    # user headers forwarded verbatim (`passthrough_headers.rs`)
+    passthrough_headers: dict = field(default_factory=dict)
+    # reject workers whose version differs (rolling-upgrade safety — the
+    # reference's GetWorkerInfo + with_version, `worker_service.rs:175-179`)
+    expected_version: Optional[str] = None
+    # per-task execute-latency sketch, mergeable across queries
+    latency: "object" = None
 
     def execute(self, plan: ExecutionPlan) -> Table:
         """Run a distributed plan (exchange-staged) across the workers and
         return the (replicated) root result."""
+        from datafusion_distributed_tpu.runtime.metrics import LatencySketch
+
+        if self.latency is None:
+            self.latency = LatencySketch()
+        if self.expected_version is not None:
+            self._check_worker_versions()
         query_id = uuid.uuid4().hex
         resolved = self._materialize_exchanges(plan, query_id)
         # the root stage: a single consumer task
@@ -93,6 +110,19 @@ class Coordinator:
             resolved, query_id, stage_id=-1, task_number=0, task_count=1
         )
         return out
+
+    def _check_worker_versions(self) -> None:
+        from datafusion_distributed_tpu.runtime.errors import WorkerError
+
+        for url in self.resolver.get_urls():
+            info = self.channels.get_worker(url).get_info()
+            v = info.get("version")
+            if v != self.expected_version:
+                raise WorkerError(
+                    f"version skew: worker {url} runs {v!r}, coordinator "
+                    f"expects {self.expected_version!r}",
+                    worker_url=url,
+                )
 
     # -- stage materialization ----------------------------------------------
     def _materialize_exchanges(
@@ -106,27 +136,50 @@ class Coordinator:
         if not getattr(plan, "is_exchange", False):
             return plan
 
-        t = plan.num_tasks
         producer = plan.children()[0]
         stage_id = plan.stage_id if plan.stage_id is not None else 0
+        t_prod = self._producer_task_count(plan, producer)
         if isinstance(plan, PartitionReplicatedExec):
             # producer is replicated: one task's output carries everything
             outputs = [
-                self._run_stage_task(producer, query_id, stage_id, 0, t)
+                self._run_stage_task(producer, query_id, stage_id, 0, t_prod)
             ]
         else:
-            outputs = [
-                self._run_stage_task(producer, query_id, stage_id, i, t)
-                for i in range(t)
-            ]
+            outputs = self._run_stage_tasks(
+                producer, query_id, stage_id, t_prod
+            )
+        t = self._consumer_task_count(plan, outputs)
         if isinstance(plan, ShuffleExchangeExec):
             slices = _shuffle_regroup(
                 outputs, plan.key_names, t, plan.per_dest_capacity
             )
+        elif isinstance(plan, CoalesceExchangeExec) and (
+            plan.num_consumers > 1
+        ):
+            # true N:M coalesce: consumer j gets the contiguous producer
+            # group [j*g, (j+1)*g) (network_coalesce.rs div_ceil arithmetic)
+            m = plan.num_consumers
+            g = -(-len(outputs) // m)
+            slices = []
+            for j in range(t):
+                group = outputs[j * g: (j + 1) * g] if j < m else []
+                if group:
+                    slices.append(
+                        concat_tables(
+                            group, capacity=sum(o.capacity for o in group)
+                        )
+                    )
+                else:  # short/absent group: empty stream
+                    ref = outputs[0]
+                    slices.append(Table(ref.names, ref.columns,
+                                        jnp.zeros((), jnp.int32)))
         elif isinstance(plan, (CoalesceExchangeExec, BroadcastExchangeExec)):
+            # one merged logical table, served to EVERY consumer task
+            # (replicated semantics) — no per-task copies, any task count
             cap = sum(o.capacity for o in outputs)
             merged = concat_tables(outputs, capacity=cap)
-            slices = [merged] * t
+            return MemoryScanExec([merged], producer.schema(),
+                                  replicated=True)
         elif isinstance(plan, PartitionReplicatedExec):
             # producer is replicated: each consumer keeps its modulo slice of
             # task 0's output
@@ -135,7 +188,70 @@ class Coordinator:
             raise NotImplementedError(type(plan).__name__)
         return MemoryScanExec(slices, producer.schema())
 
+    # -- task-count policy ---------------------------------------------------
+    def _producer_task_count(self, exchange, producer) -> int:
+        """How many tasks to run for the producer stage: never more than the
+        data slices available in its scans (an earlier exchange may have
+        produced fewer consumer slices than the planned task count)."""
+        scans = [
+            n for n in producer.collect(lambda n: not n.children())
+            if isinstance(n, MemoryScanExec) and not n.pinned
+        ]
+        # isolated union arms pin work to specific task indices; running
+        # fewer tasks than the highest assignment would silently drop arms
+        # (task specialization ships them as empty scans)
+        arms = producer.collect(lambda n: isinstance(n, IsolatedArmExec))
+        need = 1 + max((a.assigned_task for a in arms), default=-1)
+        partitioned = [s for s in scans if not s.replicated]
+        slice_counts = [len(s.tasks) for s in partitioned]
+        if slice_counts:
+            t = min(exchange.num_tasks, max(slice_counts))
+        elif scans:
+            # all inputs replicated: every task would compute the identical
+            # result — run the stage ONCE (the reference co-locates
+            # single-task stages the same way, prepare_dynamic_plan.rs:86-96)
+            t = 1
+        else:
+            t = exchange.num_tasks
+        return min(exchange.num_tasks, max(t, need))
+
+    def _consumer_task_count(self, exchange, outputs) -> int:
+        """Static mode: the planned count (AdaptiveCoordinator recomputes
+        from exact materialized bytes)."""
+        return exchange.num_tasks
+
     # -- task execution ------------------------------------------------------
+    def _run_stage_tasks(
+        self, producer: ExecutionPlan, query_id: str, stage_id: int,
+        task_count: int,
+    ) -> list[Table]:
+        """Fan ALL tasks of a stage out concurrently — one thread per worker
+        (the reference fans tasks out as concurrent async sends,
+        `query_coordinator.rs:140-222`; round 1 ran them in a sequential
+        Python loop, serializing the whole cluster). A failed task cancels
+        the remaining ones (cancellation propagation)."""
+        import concurrent.futures as cf
+
+        workers = max(len(self.resolver.get_urls()), 1)
+        if task_count == 1 or workers == 1:
+            return [
+                self._run_stage_task(producer, query_id, stage_id, i,
+                                     task_count)
+                for i in range(task_count)
+            ]
+        with cf.ThreadPoolExecutor(max_workers=workers) as pool:
+            futs = [
+                pool.submit(self._run_stage_task, producer, query_id,
+                            stage_id, i, task_count)
+                for i in range(task_count)
+            ]
+            try:
+                return [f.result() for f in futs]
+            except BaseException:
+                for f in futs:
+                    f.cancel()
+                raise
+
     def _run_stage_task(
         self,
         stage_plan: ExecutionPlan,
@@ -155,11 +271,17 @@ class Coordinator:
         plan_obj = encode_plan(
             _task_specialized(stage_plan, task_number), store
         )
-        worker.set_plan(key, plan_obj, task_count)
+        worker.set_plan(key, plan_obj, task_count,
+                        config=self.config_options,
+                        headers=self.passthrough_headers)
         try:
             out = worker.execute_task(key)
             if self.collect_metrics:
-                self.metrics[key] = worker.task_progress(key) or {}
+                progress = worker.task_progress(key) or {}
+                self.metrics[key] = progress
+                elapsed = progress.get("elapsed_s")
+                if elapsed is not None and self.latency is not None:
+                    self.latency.record(float(elapsed))
         finally:
             # drop-driven cleanup: the task's cache entry AND its shipped
             # table slices are released as soon as its single partition is
@@ -174,22 +296,54 @@ class Coordinator:
         return out
 
 
+@dataclass
 class AdaptiveCoordinator(Coordinator):
     """Dynamic-planning coordinator (the reference's `dynamic_task_count`
     mode): consumer stages are re-sized from the EXACT LoadInfo of their
     materialized inputs before execution — planning and execution interleave
-    (`prepare_dynamic_plan.rs`), with real statistics instead of samples."""
+    (`prepare_dynamic_plan.rs`), with real statistics instead of samples.
+    Both CAPACITIES (resize_for_inputs) and TASK COUNTS
+    (compute_based_task_count analogue: ceil(exact bytes / bytes_per_task))
+    adapt."""
 
-    def __post_init_adaptive(self):
-        pass
+    #: compute_based_task_count divisor (prepare_dynamic_plan.rs:60-69 uses
+    #: cpu_cost / bytes_per_partition_per_second; here exact bytes / this)
+    bytes_per_task: int = 16 << 20
 
     def execute(self, plan: ExecutionPlan) -> Table:
         self._load_info: dict[int, object] = {}
+        self.task_count_decisions: list[tuple[int, int, int]] = []
+        self._solo_shuffles = _find_solo_shuffles(plan)
         return super().execute(plan)
 
-    def _materialize_exchanges(self, plan, query_id):
-        resolved = super()._materialize_exchanges(plan, query_id)
-        return resolved
+    def _consumer_task_count(self, exchange, outputs) -> int:
+        """Recompute the consumer task count from the EXACT bytes of the
+        materialized producer outputs (dynamic_task_count semantics); the
+        planned count is only an upper bound.
+
+        Only SOLO shuffles adapt (consumer stage fed by exactly one
+        shuffle): a hash-join's co-shuffled sides must agree on `hash % t`
+        or co-partitioning breaks, and that agreement is planned, not local
+        to one exchange (the reference re-plans whole stages for the same
+        reason, `prepare_dynamic_plan.rs`). Coalesce/broadcast outputs are
+        replicated single tables — task counts do not apply to them."""
+        from datafusion_distributed_tpu.planner.statistics import row_width
+
+        if not isinstance(exchange, ShuffleExchangeExec):
+            return exchange.num_tasks
+        if exchange.stage_id not in getattr(self, "_solo_shuffles", set()):
+            return exchange.num_tasks
+        if not outputs or self.bytes_per_task <= 0:
+            return exchange.num_tasks
+        width = row_width(outputs[0].schema())
+        rows = sum(int(o.num_rows) for o in outputs)
+        want = max(1, -(-rows * width // self.bytes_per_task))
+        t = min(exchange.num_tasks, int(want))
+        self.task_count_decisions.append(
+            (exchange.stage_id if exchange.stage_id is not None else -1,
+             exchange.num_tasks, t)
+        )
+        return t
 
     def _run_stage_task(self, stage_plan, query_id, stage_id, task_number,
                         task_count):
@@ -228,6 +382,35 @@ class AdaptiveCoordinator(Coordinator):
         return merged
 
 
+def _find_solo_shuffles(plan: ExecutionPlan) -> set:
+    """ids of ShuffleExchangeExec nodes whose consumer stage is fed by no
+    OTHER shuffle (safe to re-size independently: no co-partition contract
+    with a sibling)."""
+
+    def frontier(node) -> list:
+        out = []
+        for c in node.children():
+            if getattr(c, "is_exchange", False):
+                out.append(c)
+            else:
+                out.extend(frontier(c))
+        return out
+
+    solo: set = set()
+    heads = [plan] + [
+        e.children()[0]
+        for e in plan.collect(lambda n: getattr(n, "is_exchange", False))
+    ]
+    for head in heads:
+        feeds = frontier(head)
+        shuffles = [f for f in feeds if isinstance(f, ShuffleExchangeExec)]
+        if len(shuffles) == 1 and shuffles[0].stage_id is not None:
+            # keyed by stage_id: materialization rebuilds nodes, object
+            # identity does not survive with_new_children
+            solo.add(shuffles[0].stage_id)
+    return solo
+
+
 def _task_specialized(plan: ExecutionPlan, task_number: int) -> ExecutionPlan:
     """Ship only this task's leaf slice (the reference strips other tasks'
     DistributedLeaf variants before sending, `query_coordinator.rs:346-382`).
@@ -235,6 +418,18 @@ def _task_specialized(plan: ExecutionPlan, task_number: int) -> ExecutionPlan:
     preserved because MemoryScanExec.load clamps by list length."""
 
     def walk(node: ExecutionPlan) -> ExecutionPlan:
+        if isinstance(node, IsolatedArmExec):
+            if node.assigned_task != task_number:
+                # ChildrenIsolatorUnion semantics: this arm belongs to
+                # another task; ship an empty scan instead of the subtree
+                schema = node.schema()
+                empty = Table.empty(schema, 8, None)
+                return MemoryScanExec([empty], schema, pinned=True)
+            return walk(node.child)
+        if isinstance(node, MemoryScanExec) and node.replicated:
+            # every task reads the same merged table
+            return MemoryScanExec([node.tasks[0]], node.schema(),
+                                  pinned=True)
         if isinstance(node, MemoryScanExec) and not node.pinned:
             if task_number < len(node.tasks):
                 chosen = node.tasks[task_number]

@@ -5,8 +5,12 @@ The reference's workers are tonic gRPC services speaking a protobuf contract
 GetWorkerInfo) with Arrow Flight framing on the data plane. Here the same
 worker object (runtime/worker.py) is exposed over gRPC generic handlers:
 
-    control plane: SetPlan (plan JSON + shipped table slices as Arrow IPC)
-    data plane:    ExecuteTask -> Arrow IPC stream bytes
+    control plane: SetPlan (binary frame: plan JSON header + zstd Arrow-IPC
+                   table slices — runtime/transport.py)
+    data plane:    ExecuteTask -> server-streamed chunked binary frame;
+                   gRPC flow control gives per-stream backpressure, the
+                   64 MiB connection budget caps read-ahead, cancellation
+                   propagates via stream teardown
     observability: GetInfo / TaskProgress
 
 `GrpcWorkerClient` implements the same duck-typed surface as `Worker`, so
@@ -18,10 +22,11 @@ LocalWorkerConnection-vs-RemoteWorkerConnection duality of the reference
 
 from __future__ import annotations
 
-import base64
 import json
 from concurrent import futures
 from typing import Optional
+
+from datafusion_distributed_tpu.runtime import transport
 
 from datafusion_distributed_tpu.ops.table import Table
 from datafusion_distributed_tpu.runtime.codec import (
@@ -56,14 +61,15 @@ def _handlers(worker: Worker):
     import grpc
 
     def set_plan(request: bytes, context) -> bytes:
-        msg = json.loads(request.decode())
-        key = _key_from_obj(msg["key"])
+        header, blobs = transport.unpack_frame(request)
+        key = _key_from_obj(header["key"])
         try:
             # materialize shipped table slices into the worker's store
-            for tid, b64 in msg.get("tables", {}).items():
-                table = decode_table(base64.b64decode(b64))
-                worker.table_store.tables[tid] = table
-            worker.set_plan(key, msg["plan"], msg["task_count"])
+            for tid, raw in blobs.items():
+                worker.table_store.tables[tid] = decode_table(raw)
+            worker.set_plan(key, header["plan"], header["task_count"],
+                            config=header.get("config"),
+                            headers=header.get("headers"))
             return json.dumps({"ok": True}).encode()
         except WorkerError as e:
             return json.dumps({"error": e.to_dict()}).encode()
@@ -72,27 +78,38 @@ def _handlers(worker: Worker):
                 {"error": wrap_worker_exception(e, worker.url, key).to_dict()}
             ).encode()
 
-    def execute_task(request: bytes, context) -> bytes:
+    def execute_task(request: bytes, context):
+        """Server-streaming: header+table as one framed payload, sliced into
+        chunks. The client's read pace backpressures via gRPC flow control;
+        a dropped stream (cancellation) stops the yield loop."""
         msg = json.loads(request.decode())
         key = _key_from_obj(msg["key"])
+        codec = msg.get("compression", "zstd")
+        chunk = int(msg.get("chunk_bytes", transport.DEFAULT_CHUNK_BYTES))
         try:
             out = worker.execute_task(key)
             # progress rides the response: the registry entry is invalidated
             # below, so a later TaskProgress call couldn't see it
             progress = worker.task_progress(key)
-            payload = base64.b64encode(encode_table(out)).decode()
-            return json.dumps(
-                {"table": payload, "progress": progress}
-            ).encode()
+            frame = transport.pack_frame(
+                {"progress": progress}, {"table": encode_table(out)},
+                codec=codec,
+            )
         except WorkerError as e:
-            return json.dumps({"error": e.to_dict()}).encode()
+            yield b"E" + json.dumps(e.to_dict()).encode()
+            return
         except Exception as e:
-            return json.dumps(
-                {"error": wrap_worker_exception(e, worker.url, key).to_dict()}
+            yield b"E" + json.dumps(
+                wrap_worker_exception(e, worker.url, key).to_dict()
             ).encode()
+            return
         finally:
             worker.registry.invalidate(key)
             worker.table_store.remove(msg.get("table_ids", []))
+        for piece in transport.iter_chunks(frame, chunk):
+            if not context.is_active():  # consumer cancelled: stop producing
+                return
+            yield b"D" + piece
 
     def get_info(request: bytes, context) -> bytes:
         return json.dumps(worker.get_info()).encode()
@@ -102,9 +119,8 @@ def _handlers(worker: Worker):
         p = worker.task_progress(_key_from_obj(msg["key"]))
         return json.dumps({"progress": p}).encode()
 
-    rpcs = {
+    unary = {
         "SetPlan": set_plan,
-        "ExecuteTask": execute_task,
         "GetInfo": get_info,
         "TaskProgress": task_progress,
     }
@@ -112,8 +128,11 @@ def _handlers(worker: Worker):
         name: grpc.unary_unary_rpc_method_handler(
             fn, request_deserializer=None, response_serializer=None
         )
-        for name, fn in rpcs.items()
+        for name, fn in unary.items()
     }
+    method_handlers["ExecuteTask"] = grpc.unary_stream_rpc_method_handler(
+        execute_task, request_deserializer=None, response_serializer=None
+    )
     return grpc.method_handlers_generic_handler(_SERVICE, method_handlers)
 
 
@@ -146,10 +165,15 @@ class GrpcWorkerClient:
     """Duck-typed as `Worker` for the Coordinator: set_plan / execute_task /
     get_info / task_progress / table_store / registry."""
 
-    def __init__(self, url: str):
+    def __init__(self, url: str, compression: str = "zstd",
+                 buffer_budget_bytes: int = 64 << 20,
+                 chunk_bytes: int = transport.DEFAULT_CHUNK_BYTES):
         import grpc
 
         self.url = url
+        self.compression = transport.effective_codec(compression)
+        self.buffer_budget_bytes = buffer_budget_bytes
+        self.chunk_bytes = chunk_bytes
         target = url.removeprefix("grpc://")
         self._channel = grpc.insecure_channel(
             target,
@@ -175,39 +199,68 @@ class GrpcWorkerClient:
             raise WorkerError.from_dict(msg["error"])
         return msg
 
-    def set_plan(self, key: TaskKey, plan_obj: dict, task_count: int) -> None:
+    def set_plan(self, key: TaskKey, plan_obj: dict, task_count: int,
+                 config: Optional[dict] = None,
+                 headers: Optional[dict] = None) -> None:
         tids = collect_table_ids(plan_obj)
-        tables = {
-            tid: base64.b64encode(
-                encode_table(self.table_store.get(tid))
-            ).decode()
-            for tid in tids
+        blobs = {
+            tid: encode_table(self.table_store.get(tid)) for tid in tids
         }
         self._shipped_ids[key] = tids
-        self._call(
-            "SetPlan",
+        frame = transport.pack_frame(
             {
                 "key": _key_to_obj(key),
                 "plan": plan_obj,
                 "task_count": task_count,
-                "tables": tables,
+                "config": config or {},
+                "headers": headers or {},
             },
+            blobs,
+            codec=self.compression,
         )
+        rpc = self._channel.unary_unary(
+            f"/{_SERVICE}/SetPlan",
+            request_serializer=None, response_deserializer=None,
+        )
+        msg = json.loads(rpc(frame).decode())
+        if "error" in msg:
+            raise WorkerError.from_dict(msg["error"])
         # local copies served their purpose once serialized
         self.table_store.remove(tids)
 
     def execute_task(self, key: TaskKey) -> Table:
-        msg = self._call(
-            "ExecuteTask",
-            {
-                "key": _key_to_obj(key),
-                "table_ids": self._shipped_ids.pop(key, []),
-            },
+        rpc = self._channel.unary_stream(
+            f"/{_SERVICE}/ExecuteTask",
+            request_serializer=None, response_deserializer=None,
         )
+        req = json.dumps({
+            "key": _key_to_obj(key),
+            "table_ids": self._shipped_ids.pop(key, []),
+            "compression": self.compression,
+            "chunk_bytes": self.chunk_bytes,
+        }).encode()
+        stream = rpc(req)
+
+        def chunks():
+            try:
+                for piece in stream:
+                    tag, body = piece[:1], piece[1:]
+                    if tag == b"E":
+                        raise WorkerError.from_dict(json.loads(body.decode()))
+                    yield body
+            except BaseException:
+                stream.cancel()  # cancellation propagates to the producer
+                raise
+
+        # NOTE: gRPC's stream flow control is the read-ahead backpressure
+        # (the reference's 64 MiB budget role); the budget is NOT a cap on
+        # result size — large-but-valid outputs must stream through.
+        frame = transport.collect_chunks(chunks())
+        header, blobs = transport.unpack_frame(frame)
         # server invalidates its registry after the call; progress rides the
         # response and is served from this cache
-        self._progress_cache[key] = msg.get("progress")
-        return decode_table(base64.b64decode(msg["table"]))
+        self._progress_cache[key] = header.get("progress")
+        return decode_table(blobs["table"])
 
     def get_info(self) -> dict:
         return self._call("GetInfo", {})

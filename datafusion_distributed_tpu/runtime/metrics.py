@@ -81,3 +81,93 @@ def _fmt(v) -> str:
     if isinstance(v, float):
         return f"{v:.4f}"
     return str(v)
+
+
+class LatencySketch:
+    """Mergeable log-bucketed latency sketch (the DDSketch role in the
+    reference: per-task latency distributions shipped as sketch bytes and
+    merged coordinator-side, `metrics/latency_metric.rs:3-13`,
+    worker.proto PercentileLatency).
+
+    Buckets are powers of gamma, giving a fixed RELATIVE accuracy
+    (gamma=1.02 -> ~2% error on any quantile) with tiny fixed state —
+    mergeable by adding bucket counts, exactly the property DDSketch is
+    used for."""
+
+    def __init__(self, gamma: float = 1.02, min_value: float = 1e-6):
+        import math
+
+        self.gamma = gamma
+        self.min_value = min_value
+        self._log_gamma = math.log(gamma)
+        self.buckets: dict[int, int] = {}
+        self.count = 0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def record(self, value: float) -> None:
+        import math
+
+        v = max(float(value), self.min_value)
+        idx = int(math.ceil(math.log(v / self.min_value) / self._log_gamma))
+        self.buckets[idx] = self.buckets.get(idx, 0) + 1
+        self.count += 1
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+
+    def merge(self, other: "LatencySketch") -> "LatencySketch":
+        assert other.gamma == self.gamma
+        for idx, c in other.buckets.items():
+            self.buckets[idx] = self.buckets.get(idx, 0) + c
+        self.count += other.count
+        for bound in ("min", "max"):
+            ov = getattr(other, bound)
+            sv = getattr(self, bound)
+            if ov is not None:
+                pick = min if bound == "min" else max
+                setattr(self, bound, ov if sv is None else pick(sv, ov))
+        return self
+
+    def percentile(self, q: float) -> Optional[float]:
+        """q in [0, 1] -> value with <= gamma relative error."""
+        if self.count == 0:
+            return None
+        target = max(1, int(round(q * self.count)))
+        seen = 0
+        for idx in sorted(self.buckets):
+            seen += self.buckets[idx]
+            if seen >= target:
+                # bucket midpoint in log space
+                return self.min_value * self.gamma ** (idx - 0.5)
+        return self.max
+
+    def summary(self) -> dict:
+        return {
+            "count": self.count,
+            "min": self.min,
+            "p50": self.percentile(0.50),
+            "p75": self.percentile(0.75),
+            "p95": self.percentile(0.95),
+            "p99": self.percentile(0.99),
+            "max": self.max,
+        }
+
+    def to_dict(self) -> dict:
+        """Wire format (the sketch-bytes analogue)."""
+        return {
+            "gamma": self.gamma,
+            "min_value": self.min_value,
+            "buckets": {str(k): v for k, v in self.buckets.items()},
+            "count": self.count,
+            "min": self.min,
+            "max": self.max,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LatencySketch":
+        s = cls(gamma=d["gamma"], min_value=d["min_value"])
+        s.buckets = {int(k): v for k, v in d["buckets"].items()}
+        s.count = d["count"]
+        s.min = d["min"]
+        s.max = d["max"]
+        return s

@@ -55,6 +55,25 @@ class TaskData:
     executed_at: Optional[float] = None
     finished_at: Optional[float] = None
     metrics: dict = field(default_factory=dict)
+    # coordinator-propagated session config (config-over-headers analogue,
+    # `config_extension_ext.rs:1-82`) and verbatim user headers
+    # (`passthrough_headers.rs`)
+    config: dict = field(default_factory=dict)
+    headers: dict = field(default_factory=dict)
+
+
+RESERVED_HEADER_PREFIX = "x-dftpu-"
+
+
+def validate_passthrough_headers(headers: dict) -> None:
+    """User headers must not collide with the engine's reserved prefix
+    (the reference rejects `x-datafusion-distributed-*` the same way)."""
+    for k in headers:
+        if k.lower().startswith(RESERVED_HEADER_PREFIX):
+            raise ValueError(
+                f"passthrough header {k!r} uses the reserved prefix "
+                f"{RESERVED_HEADER_PREFIX!r}"
+            )
 
 
 class TaskRegistry:
@@ -123,14 +142,21 @@ class Worker:
         self.table_store = TableStore()
 
     # -- control plane ------------------------------------------------------
-    def set_plan(self, key: TaskKey, plan_obj: dict, task_count: int) -> None:
+    def set_plan(self, key: TaskKey, plan_obj: dict, task_count: int,
+                 config: Optional[dict] = None,
+                 headers: Optional[dict] = None) -> None:
+        if headers:
+            validate_passthrough_headers(headers)
         try:
             plan = decode_plan(plan_obj, self.table_store)
             if self.on_plan is not None:
                 plan = self.on_plan(plan, key)
         except Exception as e:  # structured propagation to the coordinator
             raise wrap_worker_exception(e, self.url, key) from e
-        self.registry.put(TaskData(key=key, plan=plan, task_count=task_count))
+        self.registry.put(TaskData(
+            key=key, plan=plan, task_count=task_count,
+            config=dict(config or {}), headers=dict(headers or {}),
+        ))
 
     # -- data plane ---------------------------------------------------------
     def execute_task(self, key: TaskKey) -> Table:
@@ -150,6 +176,7 @@ class Worker:
             out = execute_plan(
                 data.plan,
                 DistributedTaskContext(key.task_number, data.task_count),
+                config=data.config or None,
                 metrics_store=store,
                 task_label=f"task{key.task_number}",
                 use_cache=False,  # freshly decoded plans never hit the cache

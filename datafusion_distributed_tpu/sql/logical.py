@@ -138,7 +138,7 @@ class LAggregate(LogicalPlan):
 def _agg_dtype(a: AggCall, cs: Schema) -> DataType:
     if a.func in ("count", "count_star"):
         return DataType.INT64
-    if a.func == "avg":
+    if a.func == "avg" or a.func in _VARIANCE_FUNCS:
         return DataType.FLOAT64
     f = a.arg.output_field(cs)
     if a.func == "sum":
@@ -372,6 +372,8 @@ class Binder:
     def _bind_query(self, q, parent_scope: Optional[Scope]) -> LogicalPlan:
         if isinstance(q, ast.SetOp):
             return self._bind_setop(q, parent_scope)
+        if q.group_by and any(_is_rollup(g) for g in q.group_by):
+            return self._bind_query(_expand_rollup(q), parent_scope)
         saved_ctes = dict(self.ctes)
         for name, sub in q.ctes:
             self.ctes[name] = self._bind_query(sub, parent_scope)
@@ -391,13 +393,30 @@ class Binder:
             self.ctes = saved
         if len(left.schema()) != len(right.schema()):
             raise BindError("set operation arity mismatch")
-        # align right's column names to left's
+        # align right's column names to left's, coercing numeric dtypes to
+        # the promoted common type (SQL set-op column typing)
         rs = right.schema()
-        right = LProject(
-            [(pe.Col(rf.name), lf.name)
-             for rf, lf in zip(rs.fields, left.schema().fields)],
-            right,
-        )
+        ls = left.schema()
+        right_exprs = []
+        left_casts = []
+        for rf, lf in zip(rs.fields, ls.fields):
+            re_: pe.PhysicalExpr = pe.Col(rf.name)
+            if rf.dtype != lf.dtype:
+                common = pe._promote(lf.dtype, rf.dtype)
+                if rf.dtype != common:
+                    re_ = pe.Cast(re_, common)
+                if lf.dtype != common:
+                    left_casts.append((lf.name, common))
+            right_exprs.append((re_, lf.name))
+        right = LProject(right_exprs, right)
+        if left_casts:
+            need = dict(left_casts)
+            left = LProject(
+                [(pe.Cast(pe.Col(f.name), need[f.name])
+                  if f.name in need else pe.Col(f.name), f.name)
+                 for f in ls.fields],
+                left,
+            )
         plan: LogicalPlan = LSetOp(q.op, q.all, left, right)
         if q.op == "union" and not q.all:
             plan = LDistinct(plan)
@@ -653,7 +672,19 @@ class Binder:
             # preserved side must be the probe: swap
             out = LJoin(rplan, uplan, "left", rkeys, lkeys)
         elif kind == "full":
-            raise BindError("FULL OUTER JOIN is not supported yet")
+            # FULL OUTER = LEFT JOIN  UNION ALL  (right rows with no match,
+            # left columns padded with typed NULLs) — the mirror of the
+            # reference's HashJoinExec Full mode, built from the primitives
+            # the TPU kernels already have (left + anti).
+            lj = LJoin(uplan, rplan, "left", lkeys, rkeys)
+            anti = LJoin(rplan, uplan, "anti", rkeys, lkeys)
+            null_left = LProject(
+                [(pe.Literal(None, f.dtype), f.name)
+                 for f in uplan.schema().fields]
+                + [(pe.Col(f.name), f.name) for f in rplan.schema().fields],
+                anti,
+            )
+            out = LSetOp("union", True, lj, null_left)
         else:
             out = LJoin(uplan, rplan, kind, lkeys, rkeys)
         for c in post:
@@ -769,8 +800,109 @@ class Binder:
             return self._bind_exists(c.child.query, not c.child.negated, plan, scope)
         if isinstance(c, ast.InSubquery):
             return self._bind_in_subquery(c, plan, scope, outer_refs)
+        if isinstance(c, ast.Between) and not c.negated:
+            # BETWEEN with subquery bounds (TPC-DS q54): split into the two
+            # comparisons and route each through the right binder
+            for shard in (
+                ast.Binary(">=", c.expr, c.low),
+                ast.Binary("<=", c.expr, c.high),
+            ):
+                if _contains_subquery(shard):
+                    plan = self._apply_subquery_pred(
+                        shard, plan, scope, outer_refs
+                    )
+                else:
+                    plan = LFilter(
+                        self._bind_expr(shard, scope, outer_refs), plan
+                    )
+            return plan
+        if isinstance(c, ast.Binary) and c.op == "and":
+            for side in (c.left, c.right):
+                if _contains_subquery(side):
+                    plan = self._apply_subquery_pred(
+                        side, plan, scope, outer_refs
+                    )
+                else:
+                    plan = LFilter(
+                        self._bind_expr(side, scope, outer_refs), plan
+                    )
+            return plan
+        if isinstance(c, ast.Binary) and c.op == "or":
+            # disjunction containing EXISTS/IN-subquery (TPC-DS q35/q45):
+            # each subquery becomes a MARK join; the disjunction then
+            # evaluates over the mark columns as a plain filter
+            return self._apply_disjunctive_subquery(c, plan, scope, outer_refs)
         # scalar subquery inside a comparison
         return self._bind_scalar_pred(c, plan, scope, outer_refs)
+
+    def _apply_disjunctive_subquery(self, c, plan, scope, outer_refs):
+        """Rewrite a boolean expression whose leaves include EXISTS /
+        IN-subquery into mark joins + a boolean filter over the mark columns
+        (the reference gets this from DataFusion's subquery decorrelation,
+        which lowers to the same mark-join shape)."""
+        plan_box = [plan]
+        counter = [0]
+
+        def walk(node):
+            if isinstance(node, ast.Binary) and node.op in ("and", "or"):
+                l = walk(node.left)
+                r = walk(node.right)
+                return pe.BooleanOp(node.op, l, r)
+            if isinstance(node, ast.Unary) and node.op == "not":
+                return pe.Not(walk(node.child))
+            if isinstance(node, ast.Exists):
+                mark = self._mark_join_exists(node, plan_box, scope)
+                return pe.Not(mark) if node.negated else mark
+            if isinstance(node, ast.InSubquery):
+                mark = self._mark_join_in(node, plan_box, scope, outer_refs)
+                return pe.Not(mark) if node.negated else mark
+            return self._bind_expr(node, scope, outer_refs)
+
+        def _mark_name():
+            counter[0] += 1
+            return f"__mark_{id(c) % 100000}_{counter[0]}"
+
+        self.__mark_name = _mark_name  # shared with helpers below
+        pred = walk(c)
+        return LFilter(pred, plan_box[0])
+
+    def _mark_join_exists(self, node: ast.Exists, plan_box, scope):
+        sub_binder = Binder(self.catalog, self.ctes)
+        sub_refs: list = []
+        sub_plan, corr_pairs, residual = sub_binder._bind_correlated(
+            node.query, scope, sub_refs
+        )
+        if not corr_pairs:
+            raise BindError("uncorrelated EXISTS not supported yet")
+        name = self.__mark_name()
+        plan_box[0] = LJoin(
+            plan_box[0], sub_plan, "mark",
+            [pe.Col(outer) for outer, _ in corr_pairs],
+            [inner for _, inner in corr_pairs],
+            residual=residual, mark_name=name,
+        )
+        return pe.Col(name)
+
+    def _mark_join_in(self, node: ast.InSubquery, plan_box, scope, outer_refs):
+        expr = self._bind_expr(node.expr, scope, outer_refs)
+        sub_binder = Binder(self.catalog, self.ctes)
+        sub_refs: list = []
+        sub_plan, corr_pairs, residual = sub_binder._bind_correlated(
+            node.query, scope, sub_refs
+        )
+        out_cols = sub_plan.schema()
+        if len(out_cols) - len(corr_pairs) != 1 and len(out_cols) != 1:
+            raise BindError("IN subquery must produce one column")
+        name = self.__mark_name()
+        plan_box[0] = LJoin(
+            plan_box[0], sub_plan, "mark",
+            [expr] + [pe.Col(outer) for outer, _ in corr_pairs],
+            [pe.Col(out_cols.fields[0].name)] + [
+                inner for _, inner in corr_pairs
+            ],
+            residual=residual, mark_name=name,
+        )
+        return pe.Col(name)
 
     def _bind_exists(self, subq: ast.Query, negated: bool, plan, scope):
         sub_binder = Binder(self.catalog, self.ctes)
@@ -809,12 +941,21 @@ class Binder:
             raise BindError(
                 f"unsupported subquery predicate shape: {type(c).__name__}"
             )
-        if isinstance(c.left, ast.ScalarSubquery):
-            sub_ast, other, flip = c.left, c.right, True
-        elif isinstance(c.right, ast.ScalarSubquery):
-            sub_ast, other, flip = c.right, c.left, False
-        else:
+        # The subquery may sit anywhere inside the comparison (TPC-DS q6:
+        # `price > 1.2 * (select avg(...))`): locate it, bind it, splice the
+        # bound scalar back in, then bind the whole comparison normally.
+        found: list = []
+
+        def hunt(node):
+            if isinstance(node, ast.ScalarSubquery):
+                found.append(node)
+                return node  # do not descend further
+            return None
+
+        _ast_substitute(c, hunt)
+        if len(found) != 1:
             raise BindError("expected scalar subquery in comparison")
+        sub_ast = found[0]
 
         sub_binder = Binder(self.catalog, self.ctes)
         sub_refs: list[OuterRef] = []
@@ -823,20 +964,26 @@ class Binder:
         )
         if residual is not None:
             raise BindError("non-equi correlation in scalar subquery")
-        other_bound = self._bind_expr(other, scope, outer_refs)
-        op = pe._flip_cmp(c.op) if flip else c.op
 
         if not corr_pairs:
             # uncorrelated: evaluate eagerly at execution time
-            sub_expr = ScalarSubqueryExpr(sub_plan)
-            return LFilter(pe.BinaryOp(op, other_bound, sub_expr), plan)
+            spliced = _ast_substitute(
+                c, lambda n: ast.PreBound(ScalarSubqueryExpr(sub_plan))
+                if n is sub_ast else None,
+            )
+            return LFilter(self._bind_expr(spliced, scope, outer_refs), plan)
 
         # correlated scalar aggregate: sub_plan is Aggregate(groups=corr keys)
         scalar_col = pe.Col(sub_plan.schema().fields[-1].name)
         lkeys = [pe.Col(outer) for outer, _ in corr_pairs]
         rkeys = [inner for _, inner in corr_pairs]
         joined = LJoin(plan, sub_plan, "left", lkeys, rkeys)
-        filtered = LFilter(pe.BinaryOp(op, other_bound, scalar_col), joined)
+        spliced = _ast_substitute(
+            c, lambda n: ast.PreBound(scalar_col) if n is sub_ast else None,
+        )
+        filtered = LFilter(
+            self._bind_expr(spliced, scope, outer_refs), joined
+        )
         # project away subquery columns
         keep = [
             (pe.Col(f.name), f.name) for f in plan.schema().fields
@@ -1296,6 +1443,9 @@ class Binder:
     def _bind_post_agg(self, e, scope, group_lookup, agg_map, select_aliases):
         """Bind an expression over the aggregate's output: aggregate calls map
         to their output columns, group-expr subtrees map to group columns."""
+        if isinstance(e, ast.NullOf):
+            _, field, _ = scope.resolve(e.ident)
+            return pe.Literal(None, field.dtype)
         wm = getattr(self, "_window_map", {})
         if id(e) in wm:
             return pe.Col(wm[id(e)])
@@ -1359,17 +1509,99 @@ class Binder:
             both = pe.BooleanOp("and", lo, hi)
             return pe.Not(both) if e.negated else both
         if isinstance(e, ast.CastAst):
-            return pe.Cast(f(e.expr), _cast_type(e.type_name))
+            to = _cast_type(e.type_name)
+            if isinstance(e.expr, ast.StringLit) and to == DataType.DATE32:
+                return pe.Literal(pe.parse_date(e.expr.value), DataType.DATE32)
+            return pe.Cast(f(e.expr), to)
         if isinstance(e, ast.ScalarSubquery):
             # e.g. HAVING sum(x) > (select ... ) — TPC-H q11
             sub = Binder(self.catalog, self.ctes)._bind_query(e.query, None)
             return ScalarSubqueryExpr(sub)
+        if isinstance(e, ast.InListAst):
+            return self._bind_in_list(e, f)
+        if isinstance(e, ast.LikeAst):
+            return pe.Like(f(e.expr), e.pattern, e.negated)
+        if isinstance(e, ast.IsNullAst):
+            return pe.IsNull(f(e.expr), e.negated)
+        if isinstance(e, ast.ExtractAst):
+            return pe.Extract(e.part, f(e.expr))
+        if isinstance(e, ast.SubstringAst):
+            start = e.start.value if isinstance(e.start, ast.NumberLit) else None
+            length = (
+                e.length.value if isinstance(e.length, ast.NumberLit) else None
+            )
+            if start is None:
+                raise BindError("SUBSTRING start must be a literal")
+            return pe.Substring(f(e.expr), start, length)
+        if isinstance(e, ast.FuncCall) and e.over is None:
+            bound = self._bind_scalar_func(e, f)
+            if bound is not None:
+                return bound
         raise BindError(
             f"cannot rebind {type(e).__name__} over aggregate output"
         )
 
+    def _bind_in_list(self, e: ast.InListAst, f) -> pe.PhysicalExpr:
+        values = []
+        for item in e.items:
+            if isinstance(item, ast.StringLit):
+                values.append(item.value)
+            elif isinstance(item, ast.NumberLit):
+                values.append(item.value)
+            elif isinstance(item, ast.DateLit):
+                values.append(item.days)
+            else:
+                d = _as_decimal(item)
+                if d is None:
+                    raise BindError("IN list items must be literals")
+                values.append(int(d) if d == int(d) else float(d))
+        return pe.InList(f(e.expr), tuple(values), e.negated)
+
+    def _bind_scalar_func(self, e, f) -> Optional[pe.PhysicalExpr]:
+        """Bind a scalar FuncCall using ``f`` for its children; None when
+        the name is unknown (callers raise their own error)."""
+        name = e.name.lower()
+        if name == "coalesce":
+            return pe.Coalesce(tuple(f(a) for a in e.args))
+        if name == "abs":
+            return pe.Abs(f(e.args[0]))
+        if name == "round":
+            digits = 0
+            if len(e.args) > 1 and isinstance(e.args[1], ast.NumberLit):
+                digits = int(e.args[1].value)
+            return pe.Round(f(e.args[0]), digits)
+        if name in ("upper", "lower"):
+            return pe.StringCase(f(e.args[0]), name == "upper")
+        if name == "concat":
+            return pe.ConcatStrings(tuple(f(a) for a in e.args))
+        if name in ("length", "char_length", "character_length"):
+            return pe.StrLength(f(e.args[0]))
+        if name == "regexp_replace":
+            pat = e.args[1]
+            rep = e.args[2]
+            if not (isinstance(pat, ast.StringLit)
+                    and isinstance(rep, ast.StringLit)):
+                raise BindError(
+                    "REGEXP_REPLACE pattern/replacement must be literals"
+                )
+            return pe.RegexpReplace(f(e.args[0]), pat.value, rep.value)
+        if name in ("to_timestamp_seconds", "to_timestamp"):
+            # epoch-seconds integers ARE the timestamp representation here
+            return f(e.args[0])
+        if name == "date_trunc":
+            unit = e.args[0]
+            if not isinstance(unit, ast.StringLit):
+                raise BindError("DATE_TRUNC unit must be a string literal")
+            return pe.DateTrunc(unit.value, f(e.args[1]))
+        return None
+
     # -- expression binding ---------------------------------------------------
     def _bind_expr(self, e, scope: Scope, outer_refs) -> pe.PhysicalExpr:
+        if isinstance(e, ast.PreBound):
+            return e.expr
+        if isinstance(e, ast.NullOf):
+            _, field, _ = scope.resolve(e.ident)
+            return pe.Literal(None, field.dtype)
         if isinstance(e, ast.Ident):
             flat, field, depth = scope.resolve(e)
             if depth > 0:
@@ -1398,6 +1630,19 @@ class Binder:
                 return folded if isinstance(folded, pe.PhysicalExpr) else (
                     self._bind_expr(folded, scope, outer_refs)
                 )
+            # column +/- INTERVAL 'n' DAY: date32 is integer days, so the
+            # interval becomes a plain int32 addend (months would need
+            # calendar arithmetic per row; unsupported on columns)
+            if isinstance(e.right, ast.IntervalLit) and e.op in ("+", "-"):
+                if e.right.months != 0:
+                    raise BindError(
+                        "month intervals on date columns are not supported"
+                    )
+                base = self._bind_expr(e.left, scope, outer_refs)
+                delta = e.right.days if e.op == "+" else -e.right.days
+                return pe.BinaryOp(
+                    "+", base, pe.Literal(delta, DataType.INT32)
+                )
             # exact decimal folding of literal arithmetic: SQL decimals make
             # `.06 - 0.01` exactly 0.05; float64 would give 0.049999...
             dec = _fold_decimal_arith(e)
@@ -1419,18 +1664,9 @@ class Binder:
             both = pe.BooleanOp("and", lo, hi)
             return pe.Not(both) if e.negated else both
         if isinstance(e, ast.InListAst):
-            x = self._bind_expr(e.expr, scope, outer_refs)
-            values = []
-            for item in e.items:
-                if isinstance(item, ast.StringLit):
-                    values.append(item.value)
-                elif isinstance(item, ast.NumberLit):
-                    values.append(item.value)
-                elif isinstance(item, ast.DateLit):
-                    values.append(item.days)
-                else:
-                    raise BindError("IN list items must be literals")
-            return pe.InList(x, tuple(values), e.negated)
+            return self._bind_in_list(
+                e, lambda a: self._bind_expr(a, scope, outer_refs)
+            )
         if isinstance(e, ast.LikeAst):
             return pe.Like(
                 self._bind_expr(e.expr, scope, outer_refs), e.pattern, e.negated
@@ -1464,9 +1700,10 @@ class Binder:
             )
             return pe.Case(branches, otherwise)
         if isinstance(e, ast.CastAst):
-            return pe.Cast(
-                self._bind_expr(e.expr, scope, outer_refs), _cast_type(e.type_name)
-            )
+            to = _cast_type(e.type_name)
+            if isinstance(e.expr, ast.StringLit) and to == DataType.DATE32:
+                return pe.Literal(pe.parse_date(e.expr.value), DataType.DATE32)
+            return pe.Cast(self._bind_expr(e.expr, scope, outer_refs), to)
         if isinstance(e, ast.ExtractAst):
             return pe.Extract(
                 e.part, self._bind_expr(e.expr, scope, outer_refs)
@@ -1496,6 +1733,11 @@ class Binder:
                 raise BindError(
                     f"aggregate {e.name} not allowed in this context"
                 )
+            bound = self._bind_scalar_func(
+                e, lambda a: self._bind_expr(a, scope, outer_refs)
+            )
+            if bound is not None:
+                return bound
             raise BindError(f"unknown function {e.name}")
         raise BindError(f"cannot bind {type(e).__name__}")
 
@@ -1536,7 +1778,11 @@ class ScalarSubqueryExpr(pe.PhysicalExpr):
 # AST utilities
 # ---------------------------------------------------------------------------
 
-_AGG_FUNCS = {"sum", "count", "min", "max", "avg"}
+from datafusion_distributed_tpu.ops.aggregate import (  # noqa: E402
+    _VARIANCE_FUNCS,
+)
+
+_AGG_FUNCS = {"sum", "count", "min", "max", "avg"} | _VARIANCE_FUNCS
 _WINDOW_ONLY_FUNCS = {"rank", "dense_rank", "row_number"}
 
 
@@ -1611,6 +1857,105 @@ def _ast_children(node) -> list:
     if isinstance(node, ast.FuncCall):
         return list(node.args)
     return []
+
+
+def _is_rollup(g) -> bool:
+    return isinstance(g, ast.FuncCall) and g.name.lower() == "rollup"
+
+
+def _ast_substitute(node, fn):
+    """Rebuild an AST bottom-up: fn(node) -> replacement or None (recurse).
+    Does NOT descend into nested Query/SetOp (their own scopes own their
+    identifiers)."""
+    import dataclasses as _dc
+
+    if isinstance(node, (ast.Query, ast.SetOp)):
+        return node
+    rep = fn(node)
+    if rep is not None:
+        return rep
+    if isinstance(node, list):
+        return [_ast_substitute(x, fn) for x in node]
+    if isinstance(node, tuple):
+        return tuple(_ast_substitute(x, fn) for x in node)
+    if _dc.is_dataclass(node) and not isinstance(node, type):
+        changes = {}
+        for fld in _dc.fields(node):
+            v = getattr(node, fld.name)
+            nv = _ast_substitute(v, fn)
+            if nv is not v:
+                changes[fld.name] = nv
+        return _dc.replace(node, **changes) if changes else node
+    return node
+
+
+def _expand_rollup(q: "ast.Query"):
+    """GROUP BY ROLLUP(a, b, ...) -> UNION ALL of one aggregation per prefix
+    of the rollup list (finest to grand total). Rolled-away columns become
+    typed NULLs (ast.NullOf) and GROUPING(col) folds to 0/1 per arm — the
+    standard lowering (the reference gets it from DataFusion's logical
+    planner)."""
+    import dataclasses as _dc
+
+    plain = [g for g in q.group_by if not _is_rollup(g)]
+    roll = next(g for g in q.group_by if _is_rollup(g)).args
+    if sum(1 for g in q.group_by if _is_rollup(g)) > 1:
+        raise BindError("multiple ROLLUPs in one GROUP BY")
+
+    arms = []
+    for k in range(len(roll), -1, -1):
+        dropped = {
+            i.name.lower() for i in roll[k:] if isinstance(i, ast.Ident)
+        }
+
+        def fn(node, dropped=dropped):
+            if isinstance(node, ast.FuncCall) and node.name.lower() == (
+                "grouping"
+            ):
+                arg = node.args[0]
+                flag = 1 if (
+                    isinstance(arg, ast.Ident) and arg.name.lower() in dropped
+                ) else 0
+                return ast.NumberLit(flag)
+            if isinstance(node, ast.Ident) and node.name.lower() in dropped:
+                return ast.NullOf(node)
+            return None
+
+        arm = _dc.replace(
+            q,
+            select_items=_ast_substitute(q.select_items, fn),
+            group_by=plain + list(roll[:k]),
+            having=_ast_substitute(q.having, fn) if q.having else None,
+            order_by=[],
+            limit=None,
+            offset=None,
+            ctes=[],
+        )
+        arms.append(arm)
+
+    combined = arms[0]
+    for arm in arms[1:]:
+        combined = ast.SetOp("union", True, combined, arm)
+
+    def order_fn(node):
+        # ORDER BY applies to the union result, where the arm is no longer
+        # known statically; GROUPING(col) is recovered per row as
+        # `CASE WHEN col IS NULL THEN 1 ELSE 0 END` (exact whenever the
+        # group column itself is non-null, which holds for the rollup
+        # dimensions in the TPC-DS suite).
+        if isinstance(node, ast.FuncCall) and node.name.lower() == "grouping":
+            return ast.CaseAst(
+                None,
+                [(ast.IsNullAst(node.args[0], False), ast.NumberLit(1))],
+                ast.NumberLit(0),
+            )
+        return None
+
+    combined.order_by = _ast_substitute(list(q.order_by), order_fn)
+    combined.limit = q.limit
+    combined.offset = q.offset
+    combined.ctes = list(q.ctes)
+    return combined
 
 
 def _contains_subquery(node) -> bool:

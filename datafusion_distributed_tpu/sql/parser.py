@@ -159,6 +159,23 @@ class SubstringAst:
 
 
 @dataclass
+class PreBound:
+    """An already-bound PhysicalExpr spliced into an AST during binder
+    rewrites (scalar-subquery extraction); never produced by the parser."""
+
+    expr: Any
+
+
+@dataclass
+class NullOf:
+    """Typed NULL standing in for a rolled-away group column (produced by
+    the binder's ROLLUP expansion, never by the parser): binds to a NULL
+    literal with the referenced column's dtype."""
+
+    ident: "Ident"
+
+
+@dataclass
 class SelectItem:
     expr: Any
     alias: Optional[str] = None
@@ -832,7 +849,8 @@ class Parser:
             self.expect_sym("(")
             part_tok = self.next()
             part = part_tok.value.lower()
-            if part not in ("year", "month", "day"):
+            if part not in ("year", "month", "day", "hour", "minute",
+                            "second"):
                 self.error(f"unsupported EXTRACT part {part}")
             if not self.eat_kw("from"):
                 self.error("expected FROM in EXTRACT")

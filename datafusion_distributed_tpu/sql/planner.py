@@ -169,10 +169,6 @@ class PhysicalPlanner:
         child = self._plan(node.child, used)
         distinct_aggs = [a for a in node.aggs if a.distinct]
         regular = [a for a in node.aggs if not a.distinct]
-        if distinct_aggs and regular:
-            raise NotImplementedError(
-                "mixing DISTINCT and plain aggregates in one GROUP BY"
-            )
 
         # materialize group + agg input expressions
         mat: list = []
@@ -191,6 +187,55 @@ class PhysicalPlanner:
             mat.append((a.arg, in_name))
             specs.append(AggSpec(a.func, in_name, a.name))
         proj = ProjectionExec(mat, child) if mat else child
+
+        if distinct_aggs and regular:
+            # Mixed DISTINCT + plain aggregates (TPC-DS q28/q94/q95,
+            # ClickBench q9/q22): each part aggregates independently over
+            # the same child; parts stitch back via a 1:1 join on the group
+            # keys (global: cross join of 1-row results). A projection
+            # restores the original output order.
+            from datafusion_distributed_tpu.plan.joins import (
+                CrossJoinExec, HashJoinExec,
+            )
+            from datafusion_distributed_tpu.plan import expressions as pe
+
+            by_name = dict(zip([a.name for a in node.aggs], specs))
+            plain_specs = [by_name[a.name] for a in regular]
+            slots = self._agg_slots(proj.output_capacity())
+            base_slots = 16 if not group_names else slots
+            combined = HashAggregateExec(
+                "single", group_names, plain_specs, proj, base_slots
+            )
+            for i, a in enumerate(distinct_aggs):
+                s = by_name[a.name]
+                dedup = HashAggregateExec(
+                    "single", group_names + [s.input_name], [], proj, slots
+                )
+                cnt = HashAggregateExec(
+                    "single", group_names,
+                    [AggSpec("count", s.input_name, s.output_name)],
+                    dedup, base_slots,
+                )
+                if not group_names:
+                    combined = CrossJoinExec(combined, cnt, out_capacity=16)
+                    continue
+                # rename build-side group keys to avoid name collisions in
+                # the joined schema
+                renamed = ProjectionExec(
+                    [(pe.Col(g), f"__dk{i}_{g}") for g in group_names]
+                    + [(pe.Col(s.output_name), s.output_name)],
+                    cnt,
+                )
+                combined = HashJoinExec(
+                    combined, renamed,
+                    group_names, [f"__dk{i}_{g}" for g in group_names],
+                    "inner", expansion_factor=1.0,
+                    out_capacity=combined.output_capacity(),
+                )
+            order = [(pe.Col(g), g) for g in group_names] + [
+                (pe.Col(a.name), a.name) for a in node.aggs
+            ]
+            return ProjectionExec(order, combined)
 
         if distinct_aggs:
             # COUNT(DISTINCT x): dedup (groups + x), then count per group.
@@ -324,6 +369,7 @@ class PhysicalPlanner:
             # symbolically and break host-side overflow checks).
             value, dtype = _exec_scalar(expr.physical, self.subquery_executor)
             expr.evaluate = _make_scalar_eval(value, dtype)  # type: ignore[method-assign]
+            expr.resolved = (value, dtype)  # lets the wire codec ship it
         for c in expr.children():
             self._resolve_subqueries(c)
 

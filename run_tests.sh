@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Sharded test runner: one pytest process per test file.
+#
+# Rationale: the full suite compiles several hundred XLA programs; on this
+# image the XLA:CPU backend segfaults sporadically deep inside
+# backend_compile after enough compilations in ONE process (observed twice,
+# different tests each time — tracked as an environment issue, not an
+# engine bug; every file passes in isolation). Process-per-file keeps each
+# XLA instance small and makes a crash attributable.
+set -u
+FAILED=()
+for f in tests/test_*.py; do
+    echo "=== $f"
+    if ! python -m pytest "$f" -q --no-header -p no:cacheprovider "$@"; then
+        FAILED+=("$f")
+    fi
+done
+if [ ${#FAILED[@]} -gt 0 ]; then
+    echo "FAILED FILES: ${FAILED[*]}"
+    exit 1
+fi
+echo "ALL FILES PASSED"

@@ -25,6 +25,7 @@ from datafusion_distributed_tpu.io.parquet import arrow_to_table
 from datafusion_distributed_tpu.ops.aggregate import AggSpec
 from datafusion_distributed_tpu.ops.sort import SortKey
 from datafusion_distributed_tpu.parallel.exchange import (
+    group_coalesce_exchange,
     broadcast_exchange,
     partition_table,
     shuffle_exchange,
@@ -97,6 +98,81 @@ def test_shuffle_exchange_repartitions_by_key(mesh):
             assert k not in seen, f"key {k} on two tasks"
             seen[k] = i
     assert total == 800
+
+
+def test_group_coalesce_contiguous_groups(mesh):
+    """N:M coalesce: consumer j holds exactly producers [j*g,(j+1)*g) of
+    the mesh, in order; tasks >= M are empty (network_coalesce.rs
+    div_ceil arithmetic)."""
+    arrow = pa.table({"x": np.arange(160)})
+    t = arrow_to_table(arrow)
+    parts = partition_table(t, NT)
+    stacked = _stack(parts)
+    per_part = [np.asarray(p.to_numpy()["x"]) for p in parts]
+
+    for m in (2, 3, 4):
+        g = -(-NT // m)
+
+        def step(s, m=m):
+            local = jax.tree.map(lambda x: x[0], s)
+            out = group_coalesce_exchange(local, AXIS, NT, m)
+            return jax.tree.map(lambda x: x[None], out)
+
+        fn = shard_map(step, mesh=mesh, in_specs=(P(AXIS),),
+                       out_specs=P(AXIS), check_rep=False)
+        out = jax.jit(fn)(stacked)
+        for j in range(NT):
+            n = int(out.num_rows[j])
+            got = np.sort(np.asarray(out.columns[0].data[j][:n]))
+            if j < m:
+                exp = np.sort(np.concatenate(
+                    per_part[j * g: (j + 1) * g]
+                )) if j * g < NT else np.array([], dtype=got.dtype)
+            else:
+                exp = np.array([], dtype=got.dtype)
+            np.testing.assert_array_equal(got, exp, err_msg=f"m={m} task {j}")
+
+
+def test_union_arm_isolation_on_mesh(mesh):
+    """A REPLICATED union arm (global aggregate) is computed on exactly one
+    task (ChildrenIsolatorUnion analogue) and contributes its rows once."""
+    from datafusion_distributed_tpu.plan.exchanges import IsolatedArmExec
+    from datafusion_distributed_tpu.sql.context import SessionContext
+
+    rng = np.random.default_rng(5)
+    ctx = SessionContext()
+    ctx.register_arrow(
+        "t", pa.table({"k": rng.integers(0, 10, 512).astype(np.int32),
+                       "v": rng.normal(size=512)})
+    )
+    sql = ("select k, sum(v) as sv from t group by k "
+           "union all select -1 as k, sum(v) as sv from t")
+    df = ctx.sql(sql)
+    staged = df.distributed_plan(num_tasks=NT)
+    arms = staged.collect(lambda n: isinstance(n, IsolatedArmExec))
+    assert arms, "replicated union arm was not isolated"
+    single = df.to_pandas().sort_values("k").reset_index(drop=True)
+    dist = df._strip_quals(df.collect_distributed_table(num_tasks=NT))
+    dist = dist.to_pandas().sort_values("k").reset_index(drop=True)
+    np.testing.assert_array_equal(dist["k"], single["k"])
+    np.testing.assert_allclose(dist["sv"], single["sv"], rtol=FLOAT_RTOL)
+
+
+def test_assign_arms_weighted():
+    from datafusion_distributed_tpu.plan.exchanges import assign_arms_to_tasks
+
+    # more tasks than arms: distinct tasks
+    a = assign_arms_to_tasks([10.0, 5.0], 4)
+    assert len(set(a)) == 2
+    # more arms than tasks: balanced loads
+    a = assign_arms_to_tasks([4.0, 3.0, 3.0, 2.0, 2.0], 2)
+    loads = [0.0, 0.0]
+    for w, t_ in zip([4.0, 3.0, 3.0, 2.0, 2.0], a):
+        loads[t_] += w
+    assert abs(loads[0] - loads[1]) <= 2.0
+    # equal tasks and arms: a bijection
+    a = assign_arms_to_tasks([1.0, 1.0, 1.0], 3)
+    assert sorted(a) == [0, 1, 2]
 
 
 def test_broadcast_exchange_replicates(mesh):

@@ -1,0 +1,165 @@
+"""TPC-DS suite: plan coverage for all 99 queries + correctness tiers.
+
+The analogue of the reference's `tests/tpcds_plans_test.rs` (a snapshot per
+query, 12.9k LoC) and `tests/tpcds_correctness_test.rs` (distributed vs
+single-node, sharded 10 ways in CI). Tiers here:
+
+1. plans: every query must parse, bind, physical-plan AND distributed-plan.
+   The supported set is pinned EXACTLY (97/99) — a regression that drops a
+   query fails, and an improvement that lifts one of the two known gaps
+   fails too, keeping the pin honest.
+2. engine correctness: a representative subset runs single-node against an
+   independent pandas oracle.
+3. distributed correctness: the same subset runs on the 8-device virtual
+   mesh and must equal the single-node result (the reference's
+   distributed-vs-single contract).
+"""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from datafusion_distributed_tpu.data.tpcdsgen import gen_tpcds
+from datafusion_distributed_tpu.sql.context import SessionContext
+
+from tpch_oracle import compare_results
+
+QUERIES_DIR = "/root/reference/testdata/tpcds/queries"
+SF = 0.004
+SEED = 11
+
+ALL = [f"q{i}" for i in range(1, 100)]
+
+# Known gaps, asserted exactly (see plan_status for the failure stages):
+#   q41 — correlated subquery over the same table with unqualified columns
+#         (inner `item` must shadow outer `i1`; scope precedence bug)
+#   q49 — FROM-subquery aliased `catalog` + qualified window-output column
+UNSUPPORTED_PLAN = {"q41", "q49"}
+
+# Representative correctness subset: star joins, date-dim filters, rollup,
+# windows, returns, distinct counts — one query per major shape family.
+CORRECTNESS = ["q3", "q7", "q19", "q25", "q42", "q52", "q55", "q59",
+               "q65", "q79", "q96", "q98"]
+
+
+@pytest.fixture(scope="module")
+def ds_env():
+    tables = gen_tpcds(sf=SF, seed=SEED)
+    ctx = SessionContext()
+    for name, arrow in tables.items():
+        ctx.register_arrow(name, arrow)
+    pdf = {name: t.to_pandas() for name, t in tables.items()}
+    return ctx, pdf
+
+
+def _sql(qname: str) -> str:
+    path = os.path.join(QUERIES_DIR, f"{qname}.sql")
+    if not os.path.exists(path):
+        pytest.skip("query text unavailable")
+    return open(path).read()
+
+
+@pytest.mark.parametrize("qname", ALL)
+def test_tpcds_plan_coverage(ds_env, qname):
+    ctx, _ = ds_env
+    try:
+        df = ctx.sql(_sql(qname))
+        df.physical_plan()
+        df.distributed_plan(num_tasks=4)
+        ok = True
+        err = None
+    except Exception as e:  # noqa: BLE001 - status pin, not pass-through
+        ok = False
+        err = e
+    if qname in UNSUPPORTED_PLAN:
+        assert not ok, (
+            f"{qname} now plans — remove it from UNSUPPORTED_PLAN"
+        )
+    else:
+        assert ok, f"{qname} failed to plan: {type(err).__name__}: {err}"
+
+
+@pytest.mark.parametrize("qname", CORRECTNESS)
+def test_tpcds_single_vs_mesh(ds_env, qname):
+    """Distributed (one SPMD mesh program) == single-node, multiset
+    semantics — the reference's tpcds_correctness_test.rs contract."""
+    ctx, _ = ds_env
+    df = ctx.sql(_sql(qname))
+    single = df.to_pandas()
+    dist = df._strip_quals(
+        df.collect_distributed_table(num_tasks=8)
+    ).to_pandas()
+    dist.columns = list(single.columns)
+    compare_results(dist, single)
+
+
+# ---------------------------------------------------------------------------
+# pandas oracles (independent implementations from the query text)
+# ---------------------------------------------------------------------------
+
+
+def _oracle_q42(T):
+    d, ss, i = T["date_dim"], T["store_sales"], T["item"]
+    j = (ss.merge(d, left_on="ss_sold_date_sk", right_on="d_date_sk")
+           .merge(i, left_on="ss_item_sk", right_on="i_item_sk"))
+    j = j[(j.i_manager_id == 1) & (j.d_moy == 11) & (j.d_year == 2000)]
+    g = j.groupby(["d_year", "i_category_id", "i_category"], as_index=False)[
+        "ss_ext_sales_price"].sum()
+    g = g.rename(columns={"ss_ext_sales_price": "sum_agg"})
+    g = g.sort_values(["sum_agg", "d_year", "i_category_id", "i_category"],
+                      ascending=[False, True, True, True])
+    return g.head(100).reset_index(drop=True)
+
+
+def _oracle_q52(T):
+    d, ss, i = T["date_dim"], T["store_sales"], T["item"]
+    j = (ss.merge(d, left_on="ss_sold_date_sk", right_on="d_date_sk")
+           .merge(i, left_on="ss_item_sk", right_on="i_item_sk"))
+    j = j[(j.i_manager_id == 1) & (j.d_moy == 11) & (j.d_year == 2000)]
+    g = j.groupby(["d_year", "i_brand", "i_brand_id"], as_index=False)[
+        "ss_ext_sales_price"].sum()
+    g = g.rename(columns={"ss_ext_sales_price": "ext_price",
+                          "i_brand_id": "brand_id", "i_brand": "brand"})
+    g = g.sort_values(["d_year", "ext_price", "brand_id"],
+                      ascending=[True, False, True])
+    return g[["d_year", "brand_id", "brand", "ext_price"]].head(
+        100).reset_index(drop=True)
+
+
+def _oracle_q55(T):
+    d, ss, i = T["date_dim"], T["store_sales"], T["item"]
+    j = (ss.merge(d, left_on="ss_sold_date_sk", right_on="d_date_sk")
+           .merge(i, left_on="ss_item_sk", right_on="i_item_sk"))
+    j = j[(j.i_manager_id == 28) & (j.d_moy == 11) & (j.d_year == 1999)]
+    g = j.groupby(["i_brand", "i_brand_id"], as_index=False)[
+        "ss_ext_sales_price"].sum()
+    g = g.rename(columns={"ss_ext_sales_price": "ext_price",
+                          "i_brand_id": "brand_id", "i_brand": "brand"})
+    g = g.sort_values(["ext_price", "brand_id"], ascending=[False, True])
+    return g[["brand_id", "brand", "ext_price"]].head(100).reset_index(
+        drop=True)
+
+
+def _oracle_q96(T):
+    ss, hd, t, s = (T["store_sales"], T["household_demographics"],
+                    T["time_dim"], T["store"])
+    j = (ss.merge(hd, left_on="ss_hdemo_sk", right_on="hd_demo_sk")
+           .merge(t, left_on="ss_sold_time_sk", right_on="t_time_sk")
+           .merge(s, left_on="ss_store_sk", right_on="s_store_sk"))
+    j = j[(j.t_hour == 20) & (j.t_minute >= 30) & (j.hd_dep_count == 7)
+          & (j.s_store_name == "ese")]
+    return pd.DataFrame({"cnt": [len(j)]})
+
+
+_DS_ORACLES = {"q42": _oracle_q42, "q52": _oracle_q52, "q55": _oracle_q55,
+               "q96": _oracle_q96}
+
+
+@pytest.mark.parametrize("qname", sorted(_DS_ORACLES))
+def test_tpcds_oracle(ds_env, qname):
+    ctx, pdf = ds_env
+    got = ctx.sql(_sql(qname)).to_pandas()
+    exp = _DS_ORACLES[qname](pdf)
+    compare_results(got, exp)

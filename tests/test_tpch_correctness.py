@@ -1,7 +1,9 @@
-"""TPC-H correctness: engine vs pandas oracle on generated data.
+"""TPC-H SINGLE-NODE correctness: engine vs pandas oracle on generated data.
 
-The analogue of the reference's `tpch_correctness_test.rs` (distributed vs
-single-node result-set equality over all 22 queries, SURVEY.md §4 tier 3).
+This validates the engine itself against an independent oracle; the
+distributed tiers (mesh / coordinator, static + adaptive — the analogue of
+the reference's `tpch_correctness_test.rs`) live in
+tests/test_tpch_distributed.py.
 """
 
 import glob
