@@ -175,6 +175,46 @@ def main() -> None:
         report("coalesce_n_to_2_ppermute", _timeit(gco, stacked,
                                                    repeats=args.repeats))
 
+    # ---- pallas claim-loop vs XLA claim loop ------------------------------
+    from datafusion_distributed_tpu.ops.pallas_hash import (
+        pallas_available, pallas_build_group_ids,
+    )
+
+    if pallas_available():
+        from datafusion_distributed_tpu.ops.aggregate import (
+            build_group_table,
+        )
+        from datafusion_distributed_tpu.ops.hash import hash_columns
+
+        hk = rng.integers(0, n // 64, n).astype(np.int32)
+        slots = round_up_pow2(max(n // 16, 64))
+        keys = [jnp.asarray(hk)]
+        h0 = hash_columns(keys, [None])
+        slot0 = (h0 & np.uint32(slots - 1)).astype(jnp.int32)
+        live_all = jnp.ones(n, dtype=jnp.bool_)
+        keys_mat = jnp.asarray(hk)[:, None]
+
+        # force the XLA path regardless of DFTPU_PALLAS so the comparison
+        # is never pallas-vs-pallas
+        saved = os.environ.pop("DFTPU_PALLAS", None)
+        try:
+            xla_build = jax.jit(lambda: build_group_table(
+                keys, [None], live_all, slots
+            ).group_ids)
+            report("hashbuild_xla_claimloop", _timeit(xla_build,
+                                                      repeats=args.repeats))
+        finally:
+            if saved is not None:
+                os.environ["DFTPU_PALLAS"] = saved
+        interp = jax.devices()[0].platform != "tpu"
+        pl_build = jax.jit(lambda: pallas_build_group_ids(
+            keys_mat, slot0, live_all, slots, interpret=interp
+        )[0])
+        report(
+            "hashbuild_pallas" + ("_interpret" if interp else ""),
+            _timeit(pl_build, repeats=args.repeats),
+        )
+
     # ---- transport framing ------------------------------------------------
     from datafusion_distributed_tpu.runtime import transport
     from datafusion_distributed_tpu.runtime.codec import encode_table

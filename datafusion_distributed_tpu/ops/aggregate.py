@@ -110,6 +110,29 @@ def build_group_table(
     slot_used0 = jnp.zeros(num_slots, dtype=jnp.bool_)
     keys_mat = jnp.stack(keys64, axis=1)  # [N, k]
 
+    if np.dtype(_LANE).itemsize == 4:
+        from datafusion_distributed_tpu.ops import pallas_hash
+
+        if (
+            pallas_hash.use_pallas_hash()
+            and num_slots <= pallas_hash._MAX_VMEM_SLOTS
+            and n <= pallas_hash._MAX_VMEM_ROWS
+        ):
+            # experimental VMEM-resident build (DFTPU_PALLAS=1): grouping
+            # is consistent with the claim loop below, but the slot LAYOUT
+            # may differ (sequential vs min-row-id claim resolution) — see
+            # ops/pallas_hash.py for the trade-off being measured
+            interpret = jax.default_backend() != "tpu"
+            gid_p, tkeys_p, used_p, over_p = (
+                pallas_hash.pallas_build_group_ids(
+                    keys_mat, slot0, live, num_slots, interpret=interpret
+                )
+            )
+            return _group_table_from_raw(
+                gid_p, tkeys_p.astype(_LANE), used_p, over_p,
+                key_cols, key_valids, valid_lane_of,
+            )
+
     # Dead rows are born resolved and never claim a slot.
     resolved0 = ~live
     gid0 = jnp.zeros(n, dtype=jnp.int32)
@@ -156,7 +179,15 @@ def build_group_table(
         cond, body, state
     )
     overflow = ~jnp.all(resolved)
+    return _group_table_from_raw(
+        gid, slot_keys, slot_used, overflow, key_cols, key_valids,
+        valid_lane_of,
+    )
 
+
+def _group_table_from_raw(gid, slot_keys, slot_used, overflow, key_cols,
+                          key_valids, valid_lane_of) -> GroupTable:
+    """Unfold the raw [H, lanes] table back into per-key-column arrays."""
     out_keys = []
     out_valid = []
     for i, (c, v) in enumerate(zip(key_cols, key_valids)):

@@ -1,0 +1,169 @@
+"""Pallas claim-loop hash-table build (experimental TPU kernel).
+
+SURVEY.md §7 hard part (b): the XLA claim loop (ops/aggregate.py
+build_group_table) runs O(probe-chain) ROUNDS, each a full HBM pass over all
+rows plus scatters into the [H, lanes] table. This kernel is the
+VMEM-resident alternative: one sequential pass over the rows with the whole
+table held in VMEM, so each probe is an on-chip read instead of an HBM
+round.
+
+Trade-off being measured (benchmarks/micro_bench.py hashbuild_* rows):
+- XLA claim loop: massively parallel per round, ~rounds × N × lanes HBM
+  traffic; great when chains are short (table ≥ 2×NDV).
+- This kernel: ZERO HBM traffic per probe (table in VMEM, ≤ ~1M slots),
+  but row processing is sequential on the scalar unit — throughput is
+  bounded by probe-chain length × scalar-op latency, not bandwidth.
+
+The engine uses the XLA path by default; DFTPU_PALLAS=1 switches
+build_group_table's group-id assignment to this kernel where legal
+(single-device, table fits VMEM). On CPU the kernel runs in interpret mode
+(correctness tests); perf claims are only meaningful on a real chip — the
+micro-bench prints both paths so BENCH notes can record the verdict either
+way.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# VMEM is ~16 MiB/core. This kernel stages EVERYTHING as single VMEM
+# blocks — the [H, L] table AND the [N, L] keys / [N] slot0/live/gid rows
+# (row blocking over a grid is future work), so both dimensions are gated.
+_MAX_VMEM_SLOTS = 1 << 16
+_MAX_VMEM_ROWS = 1 << 18  # ~4 MiB of i32 rows at 2 lanes + gid/slot0/live
+
+
+def pallas_available() -> bool:
+    try:
+        from jax.experimental import pallas as pl  # noqa: F401
+
+        return True
+    except Exception:  # pragma: no cover
+        return False
+
+
+def use_pallas_hash() -> bool:
+    return os.environ.get("DFTPU_PALLAS", "0") == "1" and pallas_available()
+
+
+@partial(jax.jit, static_argnames=("num_slots", "interpret"))
+def pallas_build_group_ids(
+    keys_mat: jnp.ndarray,  # [N, L] int32 folded key lanes
+    slot0: jnp.ndarray,  # [N] int32 initial probe slot (hash & mask)
+    live: jnp.ndarray,  # [N] bool
+    num_slots: int,
+    interpret: bool = False,
+):
+    """-> (gid [N] i32, slot_keys [H, L] i32, slot_used [H] bool,
+    overflow bool). Sequential insertion semantics: the first live row of a
+    key claims a slot along its probe chain. Grouping is consistent with
+    the XLA claim loop but slot layout may differ (see module docstring)."""
+    from jax.experimental import pallas as pl
+
+    n, lanes = keys_mat.shape
+    h = num_slots
+    assert h & (h - 1) == 0
+    if h > _MAX_VMEM_SLOTS:
+        raise ValueError(f"{h} slots exceed the VMEM budget")
+    if n > _MAX_VMEM_ROWS:
+        raise ValueError(f"{n} rows exceed the VMEM budget (no row blocking)")
+
+    def kernel(keys_ref, slot0_ref, live_ref, gid_ref, tkeys_ref, used_ref,
+               over_ref):
+        # init table
+        tkeys_ref[:, :] = jnp.zeros((h, lanes), jnp.int32)
+        used_ref[:] = jnp.zeros((h,), jnp.int32)
+        over_ref[0] = jnp.int32(0)
+
+        def row(i, _):
+            is_live = live_ref[i] != 0
+
+            # PURE probe: walk the chain reading the table; all mutation
+            # happens once, after the loop (stateful ops inside while
+            # bodies do not discharge reliably into pallas refs)
+            def probe_body(state):
+                slot, done, steps = state
+                occupied = used_ref[slot] != 0
+                match = jnp.bool_(True)
+                for l in range(lanes):
+                    match = match & (tkeys_ref[slot, l] == keys_ref[i, l])
+                resolved = jnp.logical_not(occupied) | (occupied & match)
+                nxt = jnp.where(
+                    resolved, slot, (slot + 1) & jnp.int32(h - 1)
+                )
+                return nxt, resolved, steps + 1
+
+            def probe_cond(state):
+                _, done, steps = state
+                return jnp.logical_not(done) & (steps < h)
+
+            slot, done, _ = jax.lax.while_loop(
+                probe_cond, probe_body,
+                (slot0_ref[i], jnp.bool_(False), jnp.int32(0)),
+            )
+            claim = is_live & done & (used_ref[slot] == 0)
+
+            @pl.when(claim)
+            def _():
+                for l in range(lanes):
+                    tkeys_ref[slot, l] = keys_ref[i, l]
+                used_ref[slot] = jnp.int32(1)
+
+            @pl.when(is_live & done)
+            def _():
+                gid_ref[i] = slot
+
+            @pl.when(is_live & jnp.logical_not(done))
+            def _():
+                over_ref[0] = jnp.int32(1)
+
+            return _
+
+        jax.lax.fori_loop(0, n, row, None)
+
+    gid, tkeys, used, over = pl.pallas_call(
+        kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct((n,), jnp.int32),
+            jax.ShapeDtypeStruct((h, lanes), jnp.int32),
+            jax.ShapeDtypeStruct((h,), jnp.int32),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+        ],
+        interpret=interpret,
+    )(keys_mat.astype(jnp.int32), slot0.astype(jnp.int32),
+      live.astype(jnp.int32))
+    return gid, tkeys, used.astype(jnp.bool_), over[0].astype(jnp.bool_)
+
+
+def build_group_ids_reference(keys_mat, slot0, live, num_slots):
+    """Pure-numpy oracle for the kernel's sequential-insert semantics."""
+    keys_mat = np.asarray(keys_mat)
+    slot0 = np.asarray(slot0)
+    live = np.asarray(live)
+    n, lanes = keys_mat.shape
+    tkeys = np.zeros((num_slots, lanes), np.int32)
+    used = np.zeros(num_slots, bool)
+    gid = np.zeros(n, np.int32)
+    overflow = False
+    for i in range(n):
+        if not live[i]:
+            continue
+        slot = int(slot0[i])
+        for _ in range(num_slots):
+            if not used[slot]:
+                tkeys[slot] = keys_mat[i]
+                used[slot] = True
+                gid[i] = slot
+                break
+            if (tkeys[slot] == keys_mat[i]).all():
+                gid[i] = slot
+                break
+            slot = (slot + 1) & (num_slots - 1)
+        else:
+            overflow = True
+    return gid, tkeys, used, overflow

@@ -1001,6 +1001,8 @@ class Binder:
         """
         q = subq
         conjuncts = _split_conjuncts(q.where) if q.where is not None else []
+        # surface correlations hidden inside OR branches (q41 shape)
+        conjuncts = [x for c in conjuncts for x in _hoist_common_or(c)]
         corr: list[tuple[str, ast.Ident]] = []  # (outer flat, inner ast)
         residual_asts: list = []
         local: list = []
@@ -1071,9 +1073,18 @@ class Binder:
             pairs.append((outer_flat, inner_bound))
         residual = None
         if residual_asts:
-            # bind residual against outer+inner combined scope
-            combined = self._combined_scope(q2, outer_scope)
-            bound = [self._bind_expr(a, combined, None) for a in residual_asts]
+            # bind residual against outer+inner: inner entries SHADOW outer
+            # ones (an unqualified name over two `item` relations must pick
+            # the subquery's own, q41), while outer names stay reachable —
+            # qualified or via the parent scope
+            combined = Scope(
+                self._subquery_scope(q2, None).entries, parent=outer_scope
+            )
+            shadow_refs: list = []
+            bound = [
+                self._bind_expr(a, combined, shadow_refs)
+                for a in residual_asts
+            ]
             residual = bound[0]
             for b in bound[1:]:
                 residual = pe.BooleanOp("and", residual, b)
@@ -1989,6 +2000,41 @@ def _common_or_conjuncts(node: ast.Binary) -> list:
         sets.append(fps)
     common = set.intersection(*sets)
     return [by_fp[fp] for fp in sorted(common)]
+
+
+def _hoist_common_or(c) -> list:
+    """OR whose every branch repeats the same conjuncts ->
+    [common..., OR(branches stripped of them)] — an EQUIVALENT rewrite
+    (unlike _common_or_conjuncts, which only surfaces the implied
+    conjuncts). TPC-DS q41 hides its correlation this way:
+    `(corr AND colorsA) OR (corr AND colorsB)`."""
+    if not (isinstance(c, ast.Binary) and c.op == "or"):
+        return [c]
+    common = _common_or_conjuncts(c)
+    if not common:
+        return [c]
+    common_fps = {_ast_fingerprint(x) for x in common}
+
+    def branches(n):
+        if isinstance(n, ast.Binary) and n.op == "or":
+            return branches(n.left) + branches(n.right)
+        return [n]
+
+    stripped = []
+    for b in branches(c):
+        rest = [
+            x for x in _split_conjuncts(b)
+            if _ast_fingerprint(x) not in common_fps
+        ]
+        if not rest:
+            # one branch reduces to TRUE -> the whole OR is implied by the
+            # common conjuncts
+            return list(common)
+        stripped.append(_join_conjuncts(rest))
+    out = stripped[0]
+    for b in stripped[1:]:
+        out = ast.Binary("or", out, b)
+    return list(common) + [out]
 
 
 def _sort_fetch(q) -> "int | None":

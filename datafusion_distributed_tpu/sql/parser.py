@@ -340,7 +340,10 @@ def tokenize(sql: str) -> list[Token]:
                 j += 1
             word = sql[i:j]
             kind = "kw" if word.lower() in _KEYWORDS else "ident"
-            out.append(Token(kind, word.lower() if kind == "kw" else word, i))
+            # SQL folds UNQUOTED identifiers (quoted ones, lexed above,
+            # stay verbatim) — `FROM (...) CATALOG ... catalog.col` must
+            # match (TPC-DS q49 mixes cases freely)
+            out.append(Token(kind, word.lower(), i))
             i = j
             continue
         for sym in _SYMBOLS:

@@ -32,11 +32,9 @@ SEED = 11
 
 ALL = [f"q{i}" for i in range(1, 100)]
 
-# Known gaps, asserted exactly (see plan_status for the failure stages):
-#   q41 — correlated subquery over the same table with unqualified columns
-#         (inner `item` must shadow outer `i1`; scope precedence bug)
-#   q49 — FROM-subquery aliased `catalog` + qualified window-output column
-UNSUPPORTED_PLAN = {"q41", "q49"}
+# Known gaps, asserted exactly. Empty: all 99 queries parse, bind,
+# physical-plan and distributed-plan.
+UNSUPPORTED_PLAN: set = set()
 
 # Representative correctness subset: star joins, date-dim filters, rollup,
 # windows, returns, distinct counts — one query per major shape family.
