@@ -13,8 +13,10 @@ approximation that ignores the reference's fixed per-query overhead, so
 treat it as a trend indicator until SF10 runs land.
 
 Env knobs:
-  BENCH_SF       scale factor (default 0.05; raise on real HBM)
-  BENCH_QUERIES  comma list (default: all 22)
+  BENCH_SUITE    tpch (default) | tpcds | clickbench
+  BENCH_SF       scale factor (default 0.05; raise on real HBM); for
+                 clickbench this scales the 100k-row default (SF 1 = 2M rows)
+  BENCH_QUERIES  comma list (default: the suite's full set)
   BENCH_TASKS    mesh size for distributed mode (default 1 = single chip)
   BENCH_BUDGET_S wall-clock budget in seconds (default 420). XLA compilation
                  of 22 distinct query programs dominates cold runs; the
@@ -32,13 +34,28 @@ import time
 _PROGRESS = {"per_query": {}, "total": 0.0}  # shared with the watchdog
 
 
-def _report(sf: float, per_query: dict, total: float, suffix: str = "") -> None:
-    baseline_scaled = 10.0 * (sf / 10.0)
-    vs_baseline = baseline_scaled / total if total > 0 else 0.0
+# reference totals (README.md benchmarks table) for vs_baseline scaling:
+# (total_seconds, at_sf, query_count). tpch SF10 = 10 s over 22 q;
+# tpcds SF1 = 29 s over 67 q; clickbench has no published reference
+# number -> vs_baseline 0.0. The baseline scales PER QUERY so partial runs
+# and the 99-vs-67 tpcds query-set mismatch stay apples-to-apples (an
+# approximation: it assumes uniform per-query cost).
+_BASELINES = {"tpch": (10.0, 10.0, 22), "tpcds": (29.0, 1.0, 67)}
+
+
+def _report(sf: float, per_query: dict, total: float, suffix: str = "",
+            suite: str = "tpch") -> None:
+    base = _BASELINES.get(suite)
+    if base and total > 0 and per_query:
+        base_total, base_sf, base_q = base
+        per_q = base_total / base_q
+        vs_baseline = (per_q * len(per_query) * (sf / base_sf)) / total
+    else:
+        vs_baseline = 0.0
     print(
         json.dumps(
             {
-                "metric": f"tpch_sf{sf}_total_wall_clock_"
+                "metric": f"{suite}_sf{sf}_total_wall_clock_"
                           f"{len(per_query)}q{suffix}",
                 "value": round(total, 4) if per_query else -1,
                 "unit": "seconds",
@@ -49,7 +66,7 @@ def _report(sf: float, per_query: dict, total: float, suffix: str = "") -> None:
     )
 
 
-def _start_watchdog(deadline_s: float, sf: float) -> None:
+def _start_watchdog(deadline_s: float, sf: float, suite: str = "tpch") -> None:
     """The TPU-tunnel backend can block indefinitely inside PJRT client init
     (observed in this environment); a watchdog guarantees the driver still
     receives one JSON line, reporting whatever queries completed."""
@@ -57,7 +74,7 @@ def _start_watchdog(deadline_s: float, sf: float) -> None:
 
     def fire():
         _report(sf, _PROGRESS["per_query"], _PROGRESS["total"],
-                suffix="_incomplete")
+                suffix="_incomplete", suite=suite)
         os._exit(3)
 
     t = threading.Timer(deadline_s, fire)
@@ -103,34 +120,65 @@ def _probe_devices(timeout_s: float, sf: float) -> None:
     print(f"device init: {info}", file=sys.stderr, flush=True)
 
 
+_SUITES = {
+    "tpch": ("/root/reference/testdata/tpch/queries",
+             [f"q{i}" for i in range(1, 23)]),
+    "tpcds": ("/root/reference/testdata/tpcds/queries",
+              [f"q{i}" for i in range(1, 100)]),
+    "clickbench": ("/root/reference/testdata/clickbench/queries",
+                   [f"q{i}" for i in range(0, 43)]),
+}
+
+
 def main() -> None:
+    suite = os.environ.get("BENCH_SUITE", "tpch").lower()
+    if suite not in _SUITES:
+        # validate BEFORE the watchdog exists: a typo must fail loudly, not
+        # strand the driver without its one guaranteed JSON line
+        print(json.dumps({
+            "metric": f"invalid_suite_{suite}", "value": -1,
+            "unit": "seconds", "vs_baseline": 0.0,
+        }), flush=True)
+        sys.exit(2)
     sf = float(os.environ.get("BENCH_SF", "0.05"))
     queries = os.environ.get("BENCH_QUERIES", "")
     tasks = int(os.environ.get("BENCH_TASKS", "1"))
     budget = float(os.environ.get("BENCH_BUDGET_S", "420"))
-    _start_watchdog(budget + 120.0, sf)
+    _start_watchdog(budget + 120.0, sf, suite)
 
     # Persistent XLA compile cache: 22 cold query compiles dominate the first
     # run on a fresh chip; cached programs make repeat runs near-instant.
     os.environ.setdefault("DFTPU_COMPILE_CACHE", "/root/repo/.xla_cache")
 
-    from datafusion_distributed_tpu.data.tpchgen import register_tpch
     from datafusion_distributed_tpu.sql.context import SessionContext
 
     _probe_devices(min(180.0, budget / 2), sf)
 
+    qdir, default_queries = _SUITES[suite]
     qlist = (
         [q.strip() for q in queries.split(",") if q.strip()]
         if queries
-        else [f"q{i}" for i in range(1, 23)]
+        else default_queries
     )
 
     started = time.perf_counter()
 
     ctx = SessionContext()
-    register_tpch(ctx, sf=sf, seed=0)
+    if suite == "tpch":
+        from datafusion_distributed_tpu.data.tpchgen import register_tpch
 
-    qdir = "/root/reference/testdata/tpch/queries"
+        register_tpch(ctx, sf=sf, seed=0)
+    elif suite == "tpcds":
+        from datafusion_distributed_tpu.data.tpcdsgen import register_tpcds
+
+        register_tpcds(ctx, sf=sf, seed=0)
+    else:
+        from datafusion_distributed_tpu.data.clickbenchgen import (
+            register_clickbench,
+        )
+
+        register_clickbench(ctx, rows=max(int(100_000 * sf / 0.05), 1000),
+                            seed=0)
     total = 0.0
     failed = 0
     per_query = {}
@@ -170,10 +218,9 @@ def main() -> None:
             failed += 1
             print(f"{q} failed: {type(e).__name__}: {e}", file=sys.stderr)
 
-    # Reference baseline: TPC-H SF10 total = 10 s on 12x c5n.2xlarge
-    # (BASELINE.md); vs_baseline linearly scales it to this SF (see module
-    # docstring for caveats).
-    _report(sf, per_query, total)
+    # vs_baseline scales the reference's published totals to this SF (see
+    # _BASELINES / module docstring for caveats).
+    _report(sf, per_query, total, suite=suite)
     if os.environ.get("BENCH_VERBOSE"):
         print(
             json.dumps({k: round(v, 4) for k, v in per_query.items()}),

@@ -180,14 +180,21 @@ def main() -> None:
         pallas_available, pallas_build_group_ids,
     )
 
-    if pallas_available():
+    from datafusion_distributed_tpu.ops import pallas_hash as _ph
+
+    hb_slots = round_up_pow2(max(n // 16, 64))
+    if (
+        pallas_available()
+        and n <= _ph._MAX_VMEM_ROWS
+        and hb_slots <= _ph._MAX_VMEM_SLOTS
+    ):
         from datafusion_distributed_tpu.ops.aggregate import (
             build_group_table,
         )
         from datafusion_distributed_tpu.ops.hash import hash_columns
 
         hk = rng.integers(0, n // 64, n).astype(np.int32)
-        slots = round_up_pow2(max(n // 16, 64))
+        slots = hb_slots
         keys = [jnp.asarray(hk)]
         h0 = hash_columns(keys, [None])
         slot0 = (h0 & np.uint32(slots - 1)).astype(jnp.int32)
@@ -214,6 +221,10 @@ def main() -> None:
             "hashbuild_pallas" + ("_interpret" if interp else ""),
             _timeit(pl_build, repeats=args.repeats),
         )
+    elif pallas_available():
+        print(json.dumps({"bench": "hashbuild_pallas",
+                          "skipped": "rows/slots exceed the VMEM gate"}),
+              flush=True)
 
     # ---- transport framing ------------------------------------------------
     from datafusion_distributed_tpu.runtime import transport

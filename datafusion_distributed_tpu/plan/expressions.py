@@ -155,6 +155,11 @@ def _promote(a: DataType, b: DataType) -> DataType:
     ]
     if a == b:
         return a
+    # untyped NULL adopts the peer's type (SQL NULL literal typing)
+    if a == DataType.NULL:
+        return b
+    if b == DataType.NULL:
+        return a
     if a == DataType.STRING or b == DataType.STRING:
         return DataType.STRING
     return max(a, b, key=order.index)
@@ -395,6 +400,12 @@ class Cast(PhysicalExpr):
         c = self.child.evaluate(table)
         if c.dtype == self.to:
             return c
+        if c.dtype == DataType.NULL:
+            # an untyped NULL casts to anything: all-null column of the
+            # target type (dictionary-less for STRING; concat unification
+            # adopts a peer vocabulary)
+            data = jnp.zeros(c.data.shape, dtype=self.to.np_dtype)
+            return ExprValue(data, c.valid_mask() & False, self.to)
         if c.dtype == DataType.STRING:
             # dictionary-LUT cast: parse each vocab entry host-side at trace
             # time, device gathers by code (unparseable entries -> null)
@@ -508,7 +519,13 @@ class InList(PhysicalExpr):
             else:
                 data = jnp.isin(c.data, jnp.asarray(codes, dtype=c.data.dtype))
         else:
-            vals = np.asarray(list(self.values), dtype=c.dtype.np_dtype)
+            items = list(self.values)
+            if c.dtype == DataType.DATE32:
+                # date IN ('yyyy-mm-dd', ...) — parse string items to days
+                items = [
+                    parse_date(v) if isinstance(v, str) else v for v in items
+                ]
+            vals = np.asarray(items, dtype=c.dtype.np_dtype)
             data = jnp.isin(c.data, jnp.asarray(vals))
         if self.negated:
             data = ~data

@@ -28,6 +28,9 @@ from datafusion_distributed_tpu.schema import DataType, Field, Schema
 _PROBE_IDX = "__probe_idx"
 
 
+_MAX_DERIVED_JOIN_CAPACITY = 1 << 25
+
+
 class HashJoinExec(ExecutionPlan):
     """Hash join. probe = left child (preserved side), build = right child.
 
@@ -68,7 +71,18 @@ class HashJoinExec(ExecutionPlan):
         )
         if out_capacity is None:
             base = probe.output_capacity()
-            out_capacity = round_up_pow2(max(int(base * expansion_factor), 8))
+            # hard ceiling on the EXPANSION (chained joins multiply
+            # capacities and the overflow retry quadruples expansion
+            # factors — unbounded, the product can demand terabytes;
+            # observed: a 3.3 TB allocation request). Never clamp below the
+            # probe side's own capacity: a 1x join must always fit.
+            ceiling = max(
+                _MAX_DERIVED_JOIN_CAPACITY, round_up_pow2(max(base, 8))
+            )
+            out_capacity = min(
+                round_up_pow2(max(int(base * expansion_factor), 8)),
+                ceiling,
+            )
         self.out_capacity = out_capacity
 
     def children(self):

@@ -34,6 +34,7 @@ class DataType(enum.Enum):
     BOOL = "bool"
     DATE32 = "date32"  # days since unix epoch, int32 storage
     STRING = "string"  # dictionary-encoded: int32 codes + host dictionary
+    NULL = "null"  # untyped SQL NULL literal; promotes to any peer type
 
     @property
     def np_dtype(self) -> np.dtype:
@@ -68,6 +69,7 @@ class DataType(enum.Enum):
 
 
 _NP_DTYPES = {
+    DataType.NULL: np.int32,  # placeholder storage; validity is all-false
     DataType.INT32: np.int32,
     DataType.INT64: np.int64,
     DataType.FLOAT32: np.float32,

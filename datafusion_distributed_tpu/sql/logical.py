@@ -156,6 +156,10 @@ class LJoin(LogicalPlan):
     residual: Optional[pe.PhysicalExpr] = None  # evaluated on joined schema
     mark_name: Optional[str] = None
     null_aware: bool = False  # NOT IN semantics for anti joins
+    # estimated output rows per probe row (the join orderer's NDV-based
+    # fan-out; sizes the physical join's output capacity so many-to-many
+    # joins do not start at 1x and burn overflow retries)
+    fanout_hint: float = 1.0
 
     def schema(self):
         if self.how in ("semi", "anti"):
@@ -668,6 +672,7 @@ class Binder:
                 f"{jc.kind.upper()} JOIN without an equi ON condition"
             )
         kind = jc.kind
+        fanout = self._scan_fanout(rplan, rkeys)
         if kind == "right":
             # preserved side must be the probe: swap
             out = LJoin(rplan, uplan, "left", rkeys, lkeys)
@@ -686,10 +691,50 @@ class Binder:
             )
             out = LSetOp("union", True, lj, null_left)
         else:
-            out = LJoin(uplan, rplan, kind, lkeys, rkeys)
+            out = LJoin(uplan, rplan, kind, lkeys, rkeys,
+                        fanout_hint=fanout)
         for c in post:
             out = LFilter(self._bind_expr(c, scope, outer_refs), out)
         return out
+
+    def _scan_fanout(self, rplan: LogicalPlan, rkeys: list) -> float:
+        """Estimated matches per probe row for a join against ``rplan`` on
+        ``rkeys`` (bound Cols): rows(build) / ndv(build key). Explicit JOINs
+        (q72's catalog_sales x inventory on item_sk) can be many-to-many;
+        starting the output capacity at the NDV-implied expansion avoids
+        burning every overflow retry on a 1x initial guess."""
+        scans: dict[str, LScan] = {}
+
+        def walk(n):
+            if isinstance(n, LScan):
+                scans[n.alias] = n
+            for c in n.children():
+                walk(c)
+
+        walk(rplan)
+        if not scans:
+            return 1.0
+        fanouts = []
+        for k in rkeys:
+            if not isinstance(k, pe.Col) or "." not in k.name:
+                continue
+            alias, _, col = k.name.partition(".")
+            scan = scans.get(alias)
+            if scan is None:
+                continue
+            try:
+                # filter-discounted build rows (same heuristic as
+                # _relation_rows: /3 per filter above the scan) — the full
+                # table row count would overstate the fan-out by the build
+                # side's selectivity
+                rows = self._relation_rows(alias, rplan)
+                ndv = self.catalog.column_ndv(scan.table, col)
+            except Exception:
+                continue
+            if ndv:
+                fanouts.append(max(float(rows) / float(ndv), 1.0))
+        # several equi keys bound the fan-out by the most selective one
+        return min(fanouts) if fanouts else 1.0
 
     def _join_fanout(self, edge, ualiases, urows, alias_tables) -> float:
         """Estimated output rows per probe row if this edge attaches the
@@ -750,7 +795,7 @@ class Binder:
                 joined |= u[1]
                 continue
             candidates.sort()
-            _, _, ui = candidates[0]
+            best_fanout, _, ui = candidates[0]
             u = remaining.pop(ui)
             _, ualiases, _ = u
             lkeys, rkeys, rest = [], [], []
@@ -765,7 +810,8 @@ class Binder:
                 else:
                     rest.append(e)
             edges = rest
-            plan = LJoin(plan, u[0], "inner", lkeys, rkeys)
+            plan = LJoin(plan, u[0], "inner", lkeys, rkeys,
+                         fanout_hint=float(best_fanout))
             joined |= ualiases
         # edges whose endpoints ended up in the same unit: residual filters
         for la, le, ra, re_ in edges:
@@ -2101,7 +2147,9 @@ def _display_name(e, idx: int) -> str:
 
 def _literal_expr(v):
     if v is None:
-        return pe.Literal(None, DataType.FLOAT64)
+        # untyped NULL: the type comes from context (set-op peer, CASE arm,
+        # comparison partner) via _promote's NULL rule
+        return pe.Literal(None, DataType.NULL)
     if isinstance(v, bool):
         return pe.Literal(v, DataType.BOOL)
     if isinstance(v, int):

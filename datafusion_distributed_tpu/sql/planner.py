@@ -301,7 +301,8 @@ class PhysicalPlanner:
             node.how,
             residual=node.residual,
             mark_name=node.mark_name or "__mark",
-            expansion_factor=self.config.join_expansion_factor,
+            expansion_factor=self.config.join_expansion_factor
+            * max(1.0, getattr(node, "fanout_hint", 1.0)),
             null_aware=node.null_aware,
         )
         # strip materialized key columns from inner/left outputs
