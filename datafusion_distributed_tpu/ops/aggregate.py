@@ -219,7 +219,7 @@ def hash_aggregate(
     group_names: Sequence[str],
     aggs: Sequence[AggSpec],
     num_slots: int,
-    mode: str = "single",  # "single" | "partial" | "final"
+    mode: str = "single",  # "single" | "partial" | "final" | "partial_reduce"
     prec_flags: Optional[list] = None,
 ) -> tuple[Table, jnp.ndarray]:
     """GROUP BY aggregation. Returns (result table, overflow flag).
@@ -229,9 +229,14 @@ def hash_aggregate(
     executor raises a non-retryable error for these).
 
     Modes mirror DataFusion's AggregateMode as used by the reference planner:
-      partial -> emits sum/count/min/max accumulator columns per agg
-      final   -> consumes accumulator columns (re-groups, merges)
-      single  -> full aggregation in one step
+      partial        -> emits sum/count/min/max accumulator columns per agg
+      final          -> consumes accumulator columns (re-groups, merges)
+      single         -> full aggregation in one step
+      partial_reduce -> consumes accumulator columns and emits MERGED
+                        accumulator columns (AggregateMode::PartialReduce,
+                        `partial_reduce_below_network_shuffles.rs` /
+                        the progressive reduction-tree example): fewer
+                        partial states cross each exchange hop
     The result table has capacity == num_slots, groups packed to the front.
     """
     live = table.row_mask()
@@ -289,14 +294,19 @@ def _eval_agg(spec, table, gid, live, num_slots, mode, seg_sum,
     """Produce the output column(s) for one AggSpec in the given mode."""
     name = spec.output_name
     if spec.func == "count_star":
-        if mode == "final":
+        if mode in ("final", "partial_reduce"):
             acc = table.column(f"{name}")
             vals = jnp.where(live, acc.data, 0)
             return {name: Column(seg_sum(vals), None, DataType.INT64)}
         cnt = seg_sum(jnp.where(live, 1, 0).astype(DataType.INT64.np_dtype))
         return {name: Column(cnt, None, DataType.INT64)}
 
-    if mode == "final" and spec.func in ("sum", "count", "min", "max"):
+    # sum/count/min/max: the merged accumulator IS the final value, so
+    # partial_reduce and final share one merge (the output column stays a
+    # valid partial state for a later final stage)
+    if mode in ("final", "partial_reduce") and spec.func in (
+        "sum", "count", "min", "max",
+    ):
         # merge accumulator column produced by a partial stage
         acc = table.column(name)
         valid = acc.valid_mask() & live
@@ -321,17 +331,22 @@ def _eval_agg(spec, table, gid, live, num_slots, mode, seg_sum,
         out_valid = nonempty > 0
         return {name: Column(merged, out_valid, _col_dtype(acc), acc.dictionary)}
 
-    if mode == "final" and spec.func == "avg":
+    if mode in ("final", "partial_reduce") and spec.func == "avg":
         s = table.column(f"{name}__sum")
         c = table.column(f"{name}__count")
         valid = live & s.valid_mask()
         ssum = seg_sum(jnp.where(valid, s.data, 0.0))
         scnt = seg_sum(jnp.where(live, c.data, 0))
         out_valid = scnt > 0
+        if mode == "partial_reduce":  # keep the (sum, count) state form
+            return {
+                f"{name}__sum": Column(ssum, out_valid, DataType.FLOAT64),
+                f"{name}__count": Column(scnt, None, DataType.INT64),
+            }
         avg = ssum / jnp.where(scnt == 0, 1, scnt)
         return {name: Column(avg, out_valid, DataType.FLOAT64)}
 
-    if spec.func in _VARIANCE_FUNCS and mode == "final":
+    if spec.func in _VARIANCE_FUNCS and mode in ("final", "partial_reduce"):
         s = table.column(f"{name}__sum")
         sq = table.column(f"{name}__sumsq")
         c = table.column(f"{name}__count")
@@ -339,6 +354,13 @@ def _eval_agg(spec, table, gid, live, num_slots, mode, seg_sum,
         ssum = seg_sum(jnp.where(valid, s.data, 0.0))
         ssumsq = seg_sum(jnp.where(valid, sq.data, 0.0))
         scnt = seg_sum(jnp.where(live, c.data, 0))
+        if mode == "partial_reduce":  # keep the (sum, sumsq, count) state
+            nz = scnt > 0
+            return {
+                f"{name}__sum": Column(ssum, nz, DataType.FLOAT64),
+                f"{name}__sumsq": Column(ssumsq, nz, DataType.FLOAT64),
+                f"{name}__count": Column(scnt, None, DataType.INT64),
+            }
         return {name: _variance_result(spec.func, ssum, ssumsq, scnt)}
 
     # partial/single over raw input

@@ -366,7 +366,7 @@ class HashAggregateExec(ExecutionPlan):
         num_slots: Optional[int] = None,
     ):
         super().__init__()
-        assert mode in ("single", "partial", "final")
+        assert mode in ("single", "partial", "final", "partial_reduce")
         self.mode = mode
         self.group_names = list(group_names)
         self.aggs = list(aggs)
@@ -428,21 +428,21 @@ def _agg_output_fields(a: AggSpec, child_schema: Schema, mode: str) -> list[Fiel
     if a.func == "count_star" or a.func == "count":
         return [Field(a.output_name, DataType.INT64, nullable=False)]
     if a.func == "avg":
-        if mode == "partial":
+        if mode in ("partial", "partial_reduce"):
             return [
                 Field(f"{a.output_name}__sum", DataType.FLOAT64, True),
                 Field(f"{a.output_name}__count", DataType.INT64, False),
             ]
         return [Field(a.output_name, DataType.FLOAT64, True)]
     if a.func in _VARIANCE_FUNCS:
-        if mode == "partial":
+        if mode in ("partial", "partial_reduce"):
             return [
                 Field(f"{a.output_name}__sum", DataType.FLOAT64, True),
                 Field(f"{a.output_name}__sumsq", DataType.FLOAT64, True),
                 Field(f"{a.output_name}__count", DataType.INT64, False),
             ]
         return [Field(a.output_name, DataType.FLOAT64, True)]
-    if mode == "final":
+    if mode in ("final", "partial_reduce"):
         # Final mode consumes the partial stage's accumulator column, which
         # already carries the merged dtype under the output name.
         src = child_schema.field(a.output_name)

@@ -145,7 +145,19 @@ def distribute_plan(
     plan: ExecutionPlan, config: DistributedConfig
 ) -> ExecutionPlan:
     """Rewrite a single-node plan into a staged distributed plan whose root
-    output is replicated (safe to read from any task)."""
+    output is replicated (safe to read from any task).
+
+    If the plan ALREADY contains exchange nodes, the user has hand-placed
+    the network boundaries (e.g. a custom partial-reduction tree): the
+    planner does not distribute further — it only finalizes what was placed
+    (stage stamping + 1:1 elision), mirroring the reference's pre-injected
+    boundary handling (`distributed_query_planner.rs:78-99`). The
+    replicated-root contract still holds: a hand-built tree whose root is
+    partitioned gets the same trailing coalesce the automatic path adds."""
+    if plan.collect(lambda n: getattr(n, "is_exchange", False)):
+        if _root_distribution(plan) == Distribution.PARTITIONED:
+            plan = CoalesceExchangeExec(plan, config.num_tasks)
+        return _prepare(plan)
     t_eff = effective_num_tasks(plan, config)
     if t_eff != config.num_tasks:
         from dataclasses import replace as _replace
@@ -156,6 +168,43 @@ def distribute_plan(
         out = CoalesceExchangeExec(out, config.num_tasks)
     out = _prepare(out)
     return out
+
+
+def _root_distribution(plan: ExecutionPlan) -> Distribution:
+    """Distribution of a pre-injected plan's root output. Exchanges pin it
+    (shuffle / N:M coalesce / replicated->partitioned split = partitioned;
+    N:1 coalesce / broadcast = replicated); compute nodes are deterministic
+    SPMD, so they preserve replication iff every child is replicated."""
+    if isinstance(plan, ShuffleExchangeExec):
+        return Distribution.PARTITIONED
+    if isinstance(plan, CoalesceExchangeExec):
+        return (
+            Distribution.REPLICATED if plan.num_consumers == 1
+            else Distribution.PARTITIONED
+        )
+    if isinstance(plan, BroadcastExchangeExec):
+        return Distribution.REPLICATED
+    if getattr(plan, "is_exchange", False):  # PartitionReplicated etc.
+        return Distribution.PARTITIONED
+    from datafusion_distributed_tpu.plan.exchanges import IsolatedArmExec
+
+    if isinstance(plan, IsolatedArmExec):  # runs on one assigned task only
+        return Distribution.PARTITIONED
+    children = plan.children()
+    if not children:
+        if isinstance(plan, MemoryScanExec):
+            return (
+                Distribution.REPLICATED
+                if plan.replicated or len(plan.tasks) == 1
+                else Distribution.PARTITIONED
+            )
+        return Distribution.PARTITIONED
+    dists = [_root_distribution(c) for c in children]
+    return (
+        Distribution.REPLICATED
+        if all(d == Distribution.REPLICATED for d in dists)
+        else Distribution.PARTITIONED
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -149,3 +149,56 @@ def test_high_collision_pressure():
     exp = pd.Series(k).value_counts().sort_index()
     np.testing.assert_array_equal(got["k"], exp.index)
     np.testing.assert_array_equal(got["n"], exp.values)
+
+
+def test_partial_reduce_tree_equals_single():
+    """4 shards -> partial, pairwise partial_reduce merges, then final ==
+    single (the progressive reduction tree of AggregateMode::PartialReduce,
+    examples/custom_partial_reduction_tree.py)."""
+    from datafusion_distributed_tpu.ops.table import concat_tables
+
+    rng = np.random.default_rng(9)
+    k = rng.integers(0, 15, 4000)
+    v = rng.normal(size=4000)
+    full = arrow_to_table(pa.table({"k": k, "v": v}))
+    aggs = [
+        AggSpec("sum", "v", "sv"),
+        AggSpec("count", "v", "cv"),
+        AggSpec("min", "v", "mn"),
+        AggSpec("max", "v", "mx"),
+        AggSpec("avg", "v", "av"),
+        AggSpec("var_samp", "v", "vr"),
+        AggSpec("count_star", None, "n"),
+    ]
+    single = _run(full, ["k"], aggs, slots=128).sort_values("k").reset_index(
+        drop=True
+    )
+
+    shards = [
+        arrow_to_table(
+            pa.table({"k": k[i::4], "v": v[i::4]}), capacity=2048
+        )
+        for i in range(4)
+    ]
+    partials = [hash_aggregate(s, ["k"], aggs, 128, "partial")[0]
+                for s in shards]
+    # level 1: merge states pairwise, OUTPUT STAYS IN STATE FORM
+    l1 = []
+    for a, b in ((0, 1), (2, 3)):
+        m = concat_tables([partials[a], partials[b]], capacity=256)
+        r, ov = hash_aggregate(m, ["k"], aggs, 128, "partial_reduce")
+        assert not bool(ov)
+        l1.append(r)
+    # level 2: final over the merged states
+    m = concat_tables(l1, capacity=256)
+    fin, ov = hash_aggregate(m, ["k"], aggs, 128, "final")
+    assert not bool(ov)
+    fin = fin.to_pandas().sort_values("k").reset_index(drop=True)
+    np.testing.assert_array_equal(fin["k"], single["k"])
+    np.testing.assert_allclose(fin["sv"], single["sv"], rtol=FLOAT_RTOL)
+    np.testing.assert_array_equal(fin["cv"], single["cv"])
+    np.testing.assert_array_equal(fin["mn"], single["mn"])
+    np.testing.assert_array_equal(fin["mx"], single["mx"])
+    np.testing.assert_allclose(fin["av"], single["av"], rtol=FLOAT_RTOL)
+    np.testing.assert_allclose(fin["vr"], single["vr"], rtol=FLOAT_RTOL * 10)
+    np.testing.assert_array_equal(fin["n"], single["n"])

@@ -299,3 +299,87 @@ def test_distributed_anti_join_replicated_probe(mesh):
         ctx.sql(sql).collect_distributed_table(mesh=mesh)
     ).to_pandas()
     assert sorted(got["k"]) == sorted(single["k"])
+
+
+def test_preinjected_reduction_tree_on_mesh(mesh):
+    """Hand-placed boundaries: the planner must NOT re-distribute a plan
+    that already contains exchanges — only finalize it — and the
+    partial -> N:M coalesce -> partial_reduce -> coalesce -> final tree
+    must match pandas (`examples/custom_partial_reduction_tree.py`,
+    reference `distributed_query_planner.rs:78-99`)."""
+    from datafusion_distributed_tpu.plan.exchanges import CoalesceExchangeExec
+
+    rng = np.random.default_rng(13)
+    n = 6000
+    arrow = pa.table({
+        "k": rng.integers(0, 9, n),
+        "v": rng.normal(size=n),
+    })
+    t = arrow_to_table(arrow)
+    aggs = [AggSpec("avg", "v", "av"), AggSpec("count_star", None, "c")]
+    scan = MemoryScanExec(partition_table(t, NT), t.schema())
+    partial = HashAggregateExec("partial", ["k"], aggs, scan, num_slots=64)
+    narrow = CoalesceExchangeExec(partial, NT, num_consumers=2)
+    reduce_ = HashAggregateExec("partial_reduce", ["k"], aggs, narrow,
+                                num_slots=64)
+    gather = CoalesceExchangeExec(reduce_, NT)
+    final = HashAggregateExec("final", ["k"], aggs, gather, num_slots=64)
+    plan = SortExec([SortKey("k")], final)
+
+    staged = distribute_plan(plan, DistributedConfig(num_tasks=NT))
+    # structure preserved: exactly the two hand-placed exchanges, stamped
+    exchanges = staged.collect(
+        lambda nd: getattr(nd, "is_exchange", False)
+    )
+    assert len(exchanges) == 2
+    assert sorted(e.stage_id for e in exchanges) == [0, 1]
+    modes = [nd.mode for nd in staged.collect(
+        lambda nd: isinstance(nd, HashAggregateExec))]
+    assert modes == ["final", "partial_reduce", "partial"]
+
+    out = execute_on_mesh(staged, mesh).to_pandas()
+    exp = (
+        arrow.to_pandas().groupby("k")
+        .agg(av=("v", "mean"), c=("v", "size")).reset_index()
+        .sort_values("k").reset_index(drop=True)
+    )
+    np.testing.assert_array_equal(out["k"], exp["k"])
+    np.testing.assert_allclose(out["av"], exp["av"], rtol=FLOAT_RTOL)
+    np.testing.assert_array_equal(out["c"], exp["c"])
+
+
+def test_preinjected_partitioned_root_gets_coalesced(mesh):
+    """A hand-built tree ending at a shuffle (partitioned root) must still
+    come back replicated: the planner appends the trailing coalesce the
+    automatic path would have added."""
+    from datafusion_distributed_tpu.plan.exchanges import (
+        CoalesceExchangeExec,
+        ShuffleExchangeExec,
+    )
+
+    rng = np.random.default_rng(21)
+    arrow = pa.table({
+        "k": rng.integers(0, 7, 3000),
+        "v": rng.normal(size=3000),
+    })
+    t = arrow_to_table(arrow)
+    scan = MemoryScanExec(partition_table(t, NT), t.schema())
+    partial = HashAggregateExec(
+        "partial", ["k"], [AggSpec("sum", "v", "s")], scan, num_slots=64
+    )
+    shuffled = ShuffleExchangeExec(partial, ["k"], NT, 512)
+    final = HashAggregateExec(
+        "final", ["k"], [AggSpec("sum", "v", "s")], shuffled, num_slots=64
+    )  # root: partitioned by hash(k) — NOT replicated
+
+    staged = distribute_plan(final, DistributedConfig(num_tasks=NT))
+    assert isinstance(staged, CoalesceExchangeExec)  # auto-appended
+
+    out = execute_on_mesh(staged, mesh).to_pandas().sort_values(
+        "k"
+    ).reset_index(drop=True)
+    exp = (
+        arrow.to_pandas().groupby("k").agg(s=("v", "sum")).reset_index()
+    )
+    np.testing.assert_array_equal(out["k"], exp["k"])
+    np.testing.assert_allclose(out["s"], exp["s"], rtol=FLOAT_RTOL)
