@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import itertools
 from dataclasses import dataclass, replace
-from typing import Optional
+from typing import Optional, Sequence
 
 from datafusion_distributed_tpu.ops.aggregate import AggSpec
 from datafusion_distributed_tpu.ops.sort import SortKey
@@ -201,15 +201,21 @@ class PhysicalPlanner:
 
             by_name = dict(zip([a.name for a in node.aggs], specs))
             plain_specs = [by_name[a.name] for a in regular]
-            slots = self._agg_slots(proj.output_capacity())
+            groups_ndv = self._exprs_ndv(node.child,
+                                         [e for e, _ in node.groups])
+            slots = self._agg_slots(proj.output_capacity(), groups_ndv)
             base_slots = 16 if not group_names else slots
             combined = HashAggregateExec(
                 "single", group_names, plain_specs, proj, base_slots
             )
             for i, a in enumerate(distinct_aggs):
                 s = by_name[a.name]
+                dedup_ndv = self._exprs_ndv(
+                    node.child, [e for e, _ in node.groups] + [a.arg]
+                )
                 dedup = HashAggregateExec(
-                    "single", group_names + [s.input_name], [], proj, slots
+                    "single", group_names + [s.input_name], [], proj,
+                    self._agg_slots(proj.output_capacity(), dedup_ndv),
                 )
                 cnt = HashAggregateExec(
                     "single", group_names,
@@ -240,24 +246,84 @@ class PhysicalPlanner:
         if distinct_aggs:
             # COUNT(DISTINCT x): dedup (groups + x), then count per group.
             inner_groups = group_names + [s.input_name for s in specs]
-            slots = self._agg_slots(proj.output_capacity())
+            inner_ndv = self._exprs_ndv(
+                node.child,
+                [e for e, _ in node.groups] + [a.arg for a in node.aggs],
+            )
+            slots = self._agg_slots(proj.output_capacity(), inner_ndv)
             dedup = HashAggregateExec("single", inner_groups, [], proj, slots)
             outer_specs = [
                 AggSpec("count", s.input_name, s.output_name) for s in specs
             ]
-            slots2 = self._agg_slots(dedup.output_capacity())
+            groups_ndv = self._exprs_ndv(node.child,
+                                         [e for e, _ in node.groups])
+            slots2 = self._agg_slots(dedup.output_capacity(), groups_ndv)
             return HashAggregateExec(
                 "single", group_names, outer_specs, dedup, slots2
             )
 
-        slots = self._agg_slots(proj.output_capacity())
+        groups_ndv = self._exprs_ndv(node.child, [e for e, _ in node.groups])
+        slots = self._agg_slots(proj.output_capacity(), groups_ndv)
         return HashAggregateExec("single", group_names, specs, proj, slots)
 
-    def _agg_slots(self, cap: int) -> int:
-        return min(
+    def _agg_slots(self, cap: int, ndv: Optional[int] = None) -> int:
+        """Hash-table slots for a group-by: capacity-bounded, NDV-driven.
+
+        The reference sizes aggregation hash tables dynamically as groups
+        arrive; with static shapes the table must be pre-sized, and sizing by
+        input *capacity* (round 1) made a 6-group GROUP BY run a 2M-slot
+        claim loop — ~260 GB of HBM traffic on TPC-H q1 (measured on TPU
+        v5e). When the distinct-group estimate is known, size by it instead:
+        2x the planner's slot factor over the estimate keeps the probe chain
+        short, and the session's overflow-retry loop (collect_table) widens
+        by 4x if the estimate was low — the same optimistic-plan /
+        revise-on-overflow posture as join capacities.
+        """
+        by_cap = min(
             round_up_pow2(max(int(cap * self.config.agg_slot_factor), 16)),
             self.config.max_slots,
         )
+        if ndv:
+            by_ndv = round_up_pow2(
+                max(int(ndv * self.config.agg_slot_factor * 2), 16)
+            )
+            return min(by_cap, by_ndv)
+        return by_cap
+
+    def _exprs_ndv(self, child: lg.LogicalPlan,
+                   exprs: Sequence[pe.PhysicalExpr]) -> Optional[int]:
+        """Distinct-count estimate for a tuple of expressions, or None.
+
+        Only direct base-table column references resolve (via the catalog's
+        sampled NDV, the statistics role of DataFusion's table providers in
+        the reference); any derived expression makes the tuple unknown.
+        Products over multiple keys ignore correlation, which only
+        *overestimates* — the safe direction for hash-table sizing (joins
+        can't mint new key values, so per-column base NDV is an upper bound
+        on the post-join distinct count)."""
+        ndv_fn = getattr(self.catalog, "column_ndv", None)
+        if ndv_fn is None:
+            return None
+        aliases: dict[str, str] = {}
+        stack = [child]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, lg.LScan):
+                aliases[n.alias] = n.table
+            stack.extend(n.children())
+        est = 1
+        for e in exprs:
+            if not isinstance(e, pe.Col) or "." not in e.name:
+                return None
+            alias, col = e.name.split(".", 1)
+            table = aliases.get(alias)
+            if table is None:
+                return None
+            ndv = ndv_fn(table, col)
+            if not ndv:
+                return None
+            est *= int(ndv)
+        return est
 
     def _distinct(self, child: ExecutionPlan) -> ExecutionPlan:
         names = child.schema().names
