@@ -29,6 +29,7 @@ from typing import Any, Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from datafusion_distributed_tpu.ops.aggregate import AggSpec, hash_aggregate
 from datafusion_distributed_tpu.ops.sort import SortKey, limit_table, sort_table
@@ -601,7 +602,10 @@ def execute_plan(
             jnp.any(jnp.stack(prec_flags)) if prec_flags
             else jnp.asarray(False)
         )
-        return out, any_overflow, any_precision, metric_vals
+        # ONE packed flag vector: each separate scalar device->host fetch
+        # costs a full tunnel round-trip (~80 ms measured), so both checks
+        # ride a single transfer
+        return out, jnp.stack([any_overflow, any_precision]), metric_vals
 
     cache_key = (
         plan.node_id,
@@ -622,14 +626,16 @@ def execute_plan(
         if use_cache:
             _COMPILE_CACHE[cache_key] = cached
     fn, overflow_box, metric_names = cached
-    out, any_overflow, any_precision, metric_vals = fn(inputs)
-    if check_overflow and bool(any_overflow):
+    out, flags, metric_vals = fn(inputs)
+    flags = np.asarray(flags)  # one fetch for both sentinel checks
+    any_overflow, any_precision = bool(flags[0]), bool(flags[1])
+    if check_overflow and any_overflow:
         raise RuntimeError(
             f"hash table overflow in plan (nodes: "
             f"{[name for name, _ in overflow_box if not name.startswith(_PRECISION_TAG)]}); "
             "re-plan with more slots"
         )
-    if bool(any_precision):
+    if any_precision:
         # deliberately does NOT contain the word "overflow": the session's
         # capacity-retry loop must not retry this (a bigger hash table can't
         # restore int32 exactness).

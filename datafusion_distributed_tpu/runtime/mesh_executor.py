@@ -137,7 +137,16 @@ def execute_on_mesh(
         cached = (fn, overflow_names, metric_names)
         _MESH_COMPILE_CACHE[cache_key] = cached
     fn, overflow_names, metric_names = cached
-    out, any_overflow, any_precision, mvec = fn(stacked_inputs)
+    # The persistent compilation cache aborts the process trying to
+    # serialize multi-device executables on the CPU backend (XLA CHECK
+    # failure in put_executable_and_time, observed jax 0.9 / 8-device
+    # virtual mesh); single-device programs serialize fine. EVERY call may
+    # recompile (jax.jit retraces on new input shapes), so the cache is
+    # disabled around the invocation itself, not just the first call.
+    from jax._src import config as _jcfg
+
+    with _jcfg.enable_compilation_cache(False):
+        out, any_overflow, any_precision, mvec = fn(stacked_inputs)
     if check_overflow and bool(any_overflow):
         raise RuntimeError(
             f"exchange/hash capacity overflow on mesh (nodes: "

@@ -359,6 +359,22 @@ class PhysicalPlanner:
         right, rnames = materialize_keys(right, node.right_keys, "r")
         if node.residual is not None:
             self._resolve_subqueries(node.residual)
+        # Build-side hash table: CSR over DISTINCT keys (ops/join.py), so
+        # slots size by build-key NDV, same rationale (and same
+        # overflow-retry widening, via join_expansion_factor) as _agg_slots.
+        # HashJoinExec builds over its RIGHT child (probe=left, build=right).
+        build_ndv = self._exprs_ndv(node.right, node.right_keys)
+        num_slots = None
+        if build_ndv:
+            num_slots = min(
+                round_up_pow2(2 * max(right.output_capacity(), 8)),
+                round_up_pow2(max(
+                    int(build_ndv * 2
+                        * max(1.0, self.config.join_expansion_factor)),
+                    16,
+                )),
+                1 << 21,
+            )
         join = HashJoinExec(
             left,
             right,
@@ -370,6 +386,7 @@ class PhysicalPlanner:
             expansion_factor=self.config.join_expansion_factor
             * max(1.0, getattr(node, "fanout_hint", 1.0)),
             null_aware=node.null_aware,
+            num_slots=num_slots,
         )
         # strip materialized key columns from inner/left outputs
         if node.how in ("inner", "left"):

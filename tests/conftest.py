@@ -27,3 +27,52 @@ import jax  # noqa: E402
 # initialize the (single-client) TPU tunnel from every test process. Tests run
 # on the virtual CPU mesh only, so pin the platform list back to cpu.
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent XLA compile cache for the suite's SINGLE-device programs (the
+# bulk of its compile time: oracle runs, plan execution, worker paths).
+# Multi-device (mesh-8) executables are deliberately NOT cached — serializing
+# them aborts the process (see the patch below) — so the distributed
+# matrices recompile each run; their per-case cost is bounded by module-
+# scoped fixtures reusing one compiled program per query within a run. The
+# cache lives out-of-repo per-user, keyed by XLA to backend + CPU features,
+# so a container/machine change just misses instead of reloading foreign
+# code. DFTPU_TEST_CACHE=0 disables.
+_test_cache = os.environ.get(
+    "DFTPU_TEST_CACHE",
+    os.path.join(os.path.expanduser("~"), ".cache", "dftpu_test_xla"),
+)
+if _test_cache != "0":
+    os.makedirs(_test_cache, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _test_cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+    # Serializing MULTI-device executables on the CPU backend aborts the
+    # process (XLA CHECK failure inside put_executable_and_time, observed
+    # jax 0.9 with the 8-device virtual mesh). Single-device programs
+    # serialize fine and are most of the suite's compile time. Skip cache
+    # writes for multi-device executables; they then never have cache
+    # entries, so no multi-device reads happen either.
+    from jax._src import compilation_cache as _cc
+
+    _orig_put = _cc.put_executable_and_time
+
+    def _single_device_only_put(cache_key, module_name, executable,
+                                backend, compile_time):
+        try:
+            multi = len(executable.local_devices()) > 1
+        except Exception:
+            import warnings
+
+            warnings.warn(
+                "LoadedExecutable.local_devices() unavailable; persistent "
+                "compile cache writes disabled entirely (suite reverts to "
+                "cold compiles)", RuntimeWarning, stacklevel=2,
+            )
+            multi = True  # unknown shape of API: stay safe, skip write
+        if multi:
+            return None
+        return _orig_put(cache_key, module_name, executable, backend,
+                         compile_time)
+
+    _cc.put_executable_and_time = _single_device_only_put
