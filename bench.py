@@ -3,65 +3,89 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Metric: TPC-H total wall-clock (sum of per-query best-of-2 latencies) at the
-given scale factor, on the available accelerator. Baseline (BASELINE.md): the
-reference engine's TPC-H SF10 total on a 12-node CPU cluster is 10 s.
-vs_baseline = (10 s * SF/10) / our_total — i.e. the baseline linearly
-extrapolated to the benchmarked scale factor. At SF=10 this is the true
-ratio (>1.0 = faster than the reference cluster); at other SFs it is an
-approximation that ignores the reference's fixed per-query overhead, so
-treat it as a trend indicator until SF10 runs land.
+Architecture: a PARENT process that never touches JAX orchestrates a
+disposable CHILD process that does device init + query execution. The
+axon TPU tunnel can block indefinitely inside PJRT client init (observed
+rounds 1-2, and the tunnel is single-client: a killed init wedges the
+lease for minutes). A hung child is killed (SIGINT first so PJRT can
+release the claim, then SIGKILL) and retried with backoff; per-query
+results stream from child to parent through a JSONL event file, so a
+late wedge still reports every completed query.
+
+Per-query detail (stderr + BENCH_DETAIL.json): wall seconds, input bytes
+touched, achieved GB/s, and % of the chip's HBM roofline — so "fast" is
+judgeable against hardware limits, not just the reference's wall-clock.
+
+Metric: TPC-H total wall-clock (sum of per-query best-of-2 latencies) at
+the given scale factor. Baseline (BASELINE.md): the reference engine's
+TPC-H SF10 total on a 12-node CPU cluster is 10 s. vs_baseline scales
+the nearest published reference point to this SF per-query (see
+_BASELINES).
 
 Env knobs:
   BENCH_SUITE    tpch (default) | tpcds | clickbench
-  BENCH_SF       scale factor (default 0.05; raise on real HBM); for
-                 clickbench this scales the 100k-row default (SF 1 = 2M rows)
-  BENCH_QUERIES  comma list (default: the suite's full set)
+  BENCH_SF       scale factor (default 0.05)
+  BENCH_QUERIES  comma list (default: the suite's full set, first-light
+                 queries ordered first)
   BENCH_TASKS    mesh size for distributed mode (default 1 = single chip)
-  BENCH_BUDGET_S wall-clock budget in seconds (default 420). XLA compilation
-                 of 22 distinct query programs dominates cold runs; the
-                 harness stops admitting queries near the budget and always
-                 prints its JSON line with however many completed (the query
-                 count is part of the metric name).
+  BENCH_BUDGET_S wall-clock budget in seconds (default 420)
+  BENCH_HBM_GBPS override the HBM roofline (GB/s) if device_kind unknown
 """
 
 import json
 import os
+import signal
+import subprocess
 import sys
 import time
 
-
-_PROGRESS = {"per_query": {}, "total": 0.0}  # shared with the watchdog
-
+_EVENTS = os.environ.get("BENCH_EVENTS_FILE", "/root/repo/.bench_events.jsonl")
+_DETAIL = "/root/repo/BENCH_DETAIL.json"
 
 # Reference totals (README.md benchmarks table, BASELINE.md) for
 # vs_baseline: per suite, the PUBLISHED (sf, total_seconds, query_count)
 # points — tpch SF1 = 7 s / SF10 = 10 s / SF100 = 42 s over 19 q;
-# tpcds SF1 = 29 s over 67 q; clickbench has no published number ->
-# vs_baseline 0.0. The comparison picks the nearest published SF (log
-# distance) and scales linearly from there, PER QUERY: linear-from-SF10
-# alone would credit the reference with a fictitious 50 ms/query at SF1
-# when its own published SF1 number is 318 ms/query (fixed per-query
-# overhead does not shrink with data size).
+# tpcds SF1 = 29 s over 67 q. The comparison picks the nearest published
+# SF (log distance) and scales linearly from there PER QUERY: the
+# reference's fixed per-query overhead does not shrink with data size.
 _BASELINES = {
     "tpch": [(1.0, 7.0, 22), (10.0, 10.0, 22), (100.0, 42.0, 19)],
     "tpcds": [(1.0, 29.0, 67)],
 }
 
+_SUITES = {
+    "tpch": ("/root/reference/testdata/tpch/queries",
+             [f"q{i}" for i in range(1, 23)], ["q1", "q6"]),
+    "tpcds": ("/root/reference/testdata/tpcds/queries",
+              [f"q{i}" for i in range(1, 100)], ["q3", "q7"]),
+    "clickbench": ("/root/reference/testdata/clickbench/queries",
+                   [f"q{i}" for i in range(0, 43)], ["q0", "q1"]),
+}
 
-def _report(sf: float, per_query: dict, total: float, suffix: str = "",
-            suite: str = "tpch") -> None:
+# Known HBM bandwidth rooflines by TPU device_kind substring, GB/s.
+# (Public spec sheets; used only for %-of-roofline reporting.)
+_HBM_GBPS = [
+    ("v6e", 1640.0), ("v6", 1640.0), ("v5p", 2765.0),
+    ("v5 lite", 819.0), ("v5e", 819.0), ("v5litepod", 819.0),
+    ("v4", 1228.0), ("v3", 900.0), ("v2", 700.0),
+]
+
+
+def _vs_baseline(suite: str, sf: float, per_query: dict, total: float) -> float:
     points = _BASELINES.get(suite)
-    if points and total > 0 and per_query:
-        import math
+    if not (points and total > 0 and per_query):
+        return 0.0
+    import math
 
-        base_sf, base_total, base_q = min(
-            points, key=lambda p: abs(math.log(sf / p[0]))
-        )
-        per_q = base_total / base_q
-        vs_baseline = (per_q * len(per_query) * (sf / base_sf)) / total
-    else:
-        vs_baseline = 0.0
+    base_sf, base_total, base_q = min(
+        points, key=lambda p: abs(math.log(sf / p[0]))
+    )
+    per_q = base_total / base_q
+    return (per_q * len(per_query) * (sf / base_sf)) / total
+
+
+def _report(suite: str, sf: float, per_query: dict, total: float,
+            suffix: str = "") -> None:
     print(
         json.dumps(
             {
@@ -69,110 +93,91 @@ def _report(sf: float, per_query: dict, total: float, suffix: str = "",
                           f"{len(per_query)}q{suffix}",
                 "value": round(total, 4) if per_query else -1,
                 "unit": "seconds",
-                "vs_baseline": round(vs_baseline, 4),
+                "vs_baseline": round(_vs_baseline(suite, sf, per_query, total), 4),
             }
         ),
         flush=True,
     )
 
 
-def _start_watchdog(deadline_s: float, sf: float, suite: str = "tpch") -> None:
-    """The TPU-tunnel backend can block indefinitely inside PJRT client init
-    (observed in this environment); a watchdog guarantees the driver still
-    receives one JSON line, reporting whatever queries completed."""
-    import threading
+# --------------------------------------------------------------------------
+# Child: owns JAX. Streams events (one JSON object per line) to _EVENTS.
+# --------------------------------------------------------------------------
 
-    def fire():
-        _report(sf, _PROGRESS["per_query"], _PROGRESS["total"],
-                suffix="_incomplete", suite=suite)
-        os._exit(3)
-
-    t = threading.Timer(deadline_s, fire)
-    t.daemon = True
-    t.start()
+def _emit(fh, **kw):
+    kw["ts"] = round(time.time(), 3)
+    fh.write(json.dumps(kw) + "\n")
+    fh.flush()
+    os.fsync(fh.fileno())
 
 
-def _probe_devices(timeout_s: float, sf: float) -> None:
-    """PJRT client init over the TPU tunnel can block forever (observed in
-    rounds 1-2). Probe it on a side thread; on timeout, report a distinct
-    metric so a wedged tunnel is distinguishable from slow queries."""
-    import threading
-
-    import jax
-
-    done = threading.Event()
-    info = {}
-
-    def probe():
-        t0 = time.perf_counter()
-        try:
-            info["devices"] = [str(d) for d in jax.devices()]
-            info["init_s"] = round(time.perf_counter() - t0, 1)
-        except Exception as e:  # pragma: no cover
-            info["error"] = f"{type(e).__name__}: {e}"
-        done.set()
-
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    if not done.wait(timeout_s):
-        print(
-            json.dumps(
-                {
-                    "metric": f"tpch_sf{sf}_device_init_timeout",
-                    "value": -1,
-                    "unit": "seconds",
-                    "vs_baseline": 0.0,
-                }
-            ),
-            flush=True,
-        )
-        os._exit(4)
-    print(f"device init: {info}", file=sys.stderr, flush=True)
-
-
-_SUITES = {
-    "tpch": ("/root/reference/testdata/tpch/queries",
-             [f"q{i}" for i in range(1, 23)]),
-    "tpcds": ("/root/reference/testdata/tpcds/queries",
-              [f"q{i}" for i in range(1, 100)]),
-    "clickbench": ("/root/reference/testdata/clickbench/queries",
-                   [f"q{i}" for i in range(0, 43)]),
-}
-
-
-def main() -> None:
+def _child_main() -> None:
     suite = os.environ.get("BENCH_SUITE", "tpch").lower()
-    if suite not in _SUITES:
-        # validate BEFORE the watchdog exists: a typo must fail loudly, not
-        # strand the driver without its one guaranteed JSON line
-        print(json.dumps({
-            "metric": f"invalid_suite_{suite}", "value": -1,
-            "unit": "seconds", "vs_baseline": 0.0,
-        }), flush=True)
-        sys.exit(2)
     sf = float(os.environ.get("BENCH_SF", "0.05"))
-    queries = os.environ.get("BENCH_QUERIES", "")
     tasks = int(os.environ.get("BENCH_TASKS", "1"))
-    budget = float(os.environ.get("BENCH_BUDGET_S", "420"))
-    _start_watchdog(budget + 120.0, sf, suite)
+    deadline = float(os.environ["BENCH_DEADLINE_TS"])
+    qdir, default_queries, _first = _SUITES[suite]
+    queries = os.environ.get("BENCH_QUERIES", "")
+    qlist = ([q.strip() for q in queries.split(",") if q.strip()]
+             if queries else default_queries)
 
-    # Persistent XLA compile cache: 22 cold query compiles dominate the first
-    # run on a fresh chip; cached programs make repeat runs near-instant.
+    fh = open(_EVENTS, "a")
+    # a predecessor child may have been SIGKILLed mid-write, leaving a torn
+    # line; a leading newline isolates it (blank lines are skipped on read)
+    fh.write("\n")
     os.environ.setdefault("DFTPU_COMPILE_CACHE", "/root/repo/.xla_cache")
 
+    import jax  # noqa: E402
+
+    # the axon plugin force-selects jax_platforms="axon,cpu" at registration
+    # time, overriding the env var; pin it back when a platform is requested
+    # (BENCH_PLATFORM=cpu for harness self-tests)
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+    t0 = time.perf_counter()
+    devs = jax.devices()
+    kind = getattr(devs[0], "device_kind", str(devs[0]))
+    _emit(fh, event="init", init_s=round(time.perf_counter() - t0, 2),
+          devices=len(devs), device_kind=str(kind))
+
+    hbm_gbps = None
+    if os.environ.get("BENCH_HBM_GBPS"):
+        hbm_gbps = float(os.environ["BENCH_HBM_GBPS"])
+    else:
+        low = str(kind).lower()
+        for sub, bw in _HBM_GBPS:
+            if sub in low:
+                hbm_gbps = bw
+                break
+
+    import jax.numpy as jnp  # noqa: E402
+
+    from datafusion_distributed_tpu.plan.physical import MemoryScanExec
     from datafusion_distributed_tpu.sql.context import SessionContext
 
-    _probe_devices(min(180.0, budget / 2), sf)
+    def sync_fetch(table):
+        """One device->host scalar fetch that depends on the tail of the
+        computation. On this backend block_until_ready does NOT block;
+        only a fetch truly synchronizes, and fetching full (padded)
+        buffers over the tunnel would swamp the measurement."""
+        acc = jnp.asarray(table.num_rows, dtype=jnp.float32)
+        for c in table.columns:
+            if c.data.size:
+                acc = acc + c.data.ravel()[0].astype(jnp.float32)
+        return float(acc)
 
-    qdir, default_queries = _SUITES[suite]
-    qlist = (
-        [q.strip() for q in queries.split(",") if q.strip()]
-        if queries
-        else default_queries
-    )
+    def plan_input_bytes(plan) -> int:
+        total = 0
+        for leaf in plan.collect(lambda p: isinstance(p, MemoryScanExec)):
+            for t in leaf.tasks:
+                for c in t.columns:
+                    total += int(c.data.nbytes)
+                    if c.validity is not None:
+                        total += int(c.validity.nbytes)
+        return total
 
-    started = time.perf_counter()
-
+    t0 = time.perf_counter()
     ctx = SessionContext()
     if suite == "tpch":
         from datafusion_distributed_tpu.data.tpchgen import register_tpch
@@ -189,55 +194,269 @@ def main() -> None:
 
         register_clickbench(ctx, rows=max(int(100_000 * sf / 0.05), 1000),
                             seed=0)
-    total = 0.0
-    failed = 0
-    per_query = {}
+    # force the host->device transfer into the registration measurement:
+    # touch one element of every registered column
+    reg_sync = 0.0
+    for name, t in ctx.catalog.tables.items():
+        for c in t.columns:
+            if c.data.size:
+                reg_sync += float(c.data.ravel()[0])
+    _emit(fh, event="registered", secs=round(time.perf_counter() - t0, 2),
+          tables=len(ctx.catalog.tables))
+
     for q in qlist:
-        if time.perf_counter() - started > budget * 0.85:
-            break  # leave room to report
+        now = time.time()
+        if now > deadline - 10:
+            _emit(fh, event="budget_stop", remaining=q)
+            break
         path = os.path.join(qdir, f"{q}.sql")
         if not os.path.exists(path):
+            _emit(fh, event="query_skipped", q=q, reason="no such file")
             continue
         sql = open(path).read()
         try:
             df = ctx.sql(sql)
-            # warm-up run compiles; second run measures steady-state latency
-            # (the reference reports p50 of multiple runs the same way)
+            runs = []
             best = float("inf")
+            # warm-up run compiles; second run measures steady-state
+            # latency (the reference reports p50 of repeat runs)
             for _attempt in range(2):
                 t0 = time.perf_counter()
                 if tasks > 1:
-                    df.collect_distributed_table(num_tasks=tasks)
+                    tbl = df.collect_distributed_table(num_tasks=tasks)
                 else:
-                    df.collect_table()
+                    tbl = df.collect_table()
+                sync_fetch(tbl)
                 dt = time.perf_counter() - t0
-                print(
-                    f"{q} attempt {_attempt}: {dt:.3f}s", file=sys.stderr,
-                    flush=True,
-                )
+                runs.append(round(dt, 4))
                 best = min(best, dt)
-                if time.perf_counter() - started > budget:
+                if time.time() > deadline - 5:
                     break
-            # note: a query whose second (steady-state) run was cut by the
-            # budget reports its compile-inclusive first run — conservative
-            per_query[q] = best
-            total += best
-            _PROGRESS["per_query"] = dict(per_query)
-            _PROGRESS["total"] = total
+            try:
+                # after collect the memoized plan reflects any overflow-
+                # widened replan; planning here (vs before the timed runs)
+                # also keeps plan-time subquery overflows inside
+                # collect_table's retry loop
+                bytes_in = plan_input_bytes(df.physical_plan())
+            except Exception:
+                bytes_in = 0
+            gbps = bytes_in / best / 1e9 if best > 0 else 0.0
+            ev = {
+                "event": "query", "q": q, "secs": round(best, 4),
+                "runs": runs, "bytes_in": bytes_in,
+                "gbps": round(gbps, 2),
+            }
+            if hbm_gbps:
+                ev["pct_hbm_roofline"] = round(100.0 * gbps / hbm_gbps, 2)
+            _emit(fh, **ev)
         except Exception as e:  # a failing query must not eat the report
-            failed += 1
-            print(f"{q} failed: {type(e).__name__}: {e}", file=sys.stderr)
+            _emit(fh, event="query_failed", q=q,
+                  error=f"{type(e).__name__}: {e}"[:300])
+    _emit(fh, event="done", hbm_gbps=hbm_gbps)
 
-    # vs_baseline scales the reference's published totals to this SF (see
-    # _BASELINES / module docstring for caveats).
-    _report(sf, per_query, total, suite=suite)
-    if os.environ.get("BENCH_VERBOSE"):
-        print(
-            json.dumps({k: round(v, 4) for k, v in per_query.items()}),
-            file=sys.stderr,
+
+# --------------------------------------------------------------------------
+# Parent: no JAX. Spawns/monitors children, aggregates, reports.
+# --------------------------------------------------------------------------
+
+_INIT_STALL_S = 210.0   # no init event -> child is wedged in PJRT init
+_QUERY_STALL_S = 300.0  # no progress mid-run (compiles can take ~40s)
+_BACKOFFS = [45.0, 90.0]  # tunnel lease needs time to expire after a kill
+
+
+def _read_events(path: str, offset: int):
+    """-> (events, new_offset); tolerant of a torn final line."""
+    try:
+        with open(path) as f:
+            f.seek(offset)
+            data = f.read()
+    except FileNotFoundError:
+        return [], offset
+    events = []
+    consumed = 0
+    for line in data.splitlines(keepends=True):
+        if not line.endswith("\n"):
+            break
+        consumed += len(line)
+        line = line.strip()
+        if line:
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    return events, offset + consumed
+
+
+def _kill_child(proc: subprocess.Popen) -> None:
+    """SIGINT first: a KeyboardInterrupt lets the PJRT client release the
+    single-client tunnel claim; SIGKILL mid-init wedges it for minutes."""
+    if proc.poll() is not None:
+        return
+    try:
+        proc.send_signal(signal.SIGINT)
+        proc.wait(timeout=15)
+    except (subprocess.TimeoutExpired, ProcessLookupError):
+        try:
+            proc.kill()
+            proc.wait(timeout=10)
+        except Exception:
+            pass
+
+
+def main() -> None:
+    if os.environ.get("BENCH_CHILD") == "1":
+        _child_main()
+        return
+
+    suite = os.environ.get("BENCH_SUITE", "tpch").lower()
+    if suite not in _SUITES:
+        print(json.dumps({
+            "metric": f"invalid_suite_{suite}", "value": -1,
+            "unit": "seconds", "vs_baseline": 0.0,
+        }), flush=True)
+        sys.exit(2)
+    sf = float(os.environ.get("BENCH_SF", "0.05"))
+    budget = float(os.environ.get("BENCH_BUDGET_S", "420"))
+    started = time.time()
+    deadline = started + budget
+
+    _qdir, default_queries, first_light = _SUITES[suite]
+    if os.environ.get("BENCH_QUERIES"):
+        qlist = [q.strip() for q in os.environ["BENCH_QUERIES"].split(",")
+                 if q.strip()]
+    else:
+        # first-light queries run first: a late wedge still yields numbers
+        qlist = first_light + [q for q in default_queries
+                               if q not in first_light]
+
+    # the parent's own last line of defense: always print the one JSON line
+    state = {"per_query": {}, "failed": {}, "meta": {}}
+
+    def final_report(suffix=""):
+        total = sum(state["per_query"].values())
+        _report(suite, sf, state["per_query"], total, suffix=suffix)
+        detail = {
+            "suite": suite, "sf": sf, "per_query_s": state["per_query"],
+            "failed": state["failed"], "meta": state["meta"],
+            "total_s": round(total, 4),
+        }
+        try:
+            with open(_DETAIL, "w") as f:
+                json.dump(detail, f, indent=1)
+        except OSError:
+            pass
+        print(json.dumps(detail), file=sys.stderr, flush=True)
+
+    import threading
+
+    def watchdog():
+        final_report(suffix="_watchdog")
+        os._exit(3)
+
+    wd = threading.Timer(budget + 90.0, watchdog)
+    wd.daemon = True
+    wd.start()
+
+    try:
+        os.unlink(_EVENTS)
+    except FileNotFoundError:
+        pass
+
+    attempt = 0
+    offset = 0
+    while time.time() < deadline - 30:
+        remaining = [q for q in qlist
+                     if q not in state["per_query"]
+                     and q not in state["failed"]]
+        if not remaining:
+            break
+        env = dict(os.environ)
+        env["BENCH_CHILD"] = "1"
+        env["BENCH_QUERIES"] = ",".join(remaining)
+        env["BENCH_DEADLINE_TS"] = str(deadline)
+        env.setdefault("JAX_PLATFORMS", "axon")
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, stdout=sys.stderr, stderr=sys.stderr,
+            start_new_session=True,
         )
-    if failed and not per_query:
-        sys.exit(2)  # every query failed: not a valid 0-second run
+        print(f"bench child attempt {attempt}: pid {proc.pid}, "
+              f"{len(remaining)} queries", file=sys.stderr, flush=True)
+        saw_init = False
+        child_done = False
+        last_progress = time.time()
+        while True:
+            events, offset = _read_events(_EVENTS, offset)
+            for ev in events:
+                last_progress = time.time()
+                kind = ev.get("event")
+                if kind == "init":
+                    saw_init = True
+                    state["meta"].update(
+                        {k: ev[k] for k in
+                         ("init_s", "devices", "device_kind") if k in ev})
+                elif kind == "registered":
+                    state["meta"]["register_s"] = ev.get("secs")
+                elif kind == "query":
+                    state["per_query"][ev["q"]] = ev["secs"]
+                    state["meta"].setdefault("queries", {})[ev["q"]] = {
+                        k: ev[k] for k in
+                        ("runs", "bytes_in", "gbps", "pct_hbm_roofline")
+                        if k in ev}
+                    print(f"  {ev['q']}: {ev['secs']}s "
+                          f"({ev.get('gbps', '?')} GB/s, "
+                          f"{ev.get('pct_hbm_roofline', '?')}% roofline)",
+                          file=sys.stderr, flush=True)
+                elif kind == "query_failed":
+                    state["failed"][ev["q"]] = ev.get("error", "")
+                elif kind == "done":
+                    state["meta"]["hbm_gbps"] = ev.get("hbm_gbps")
+                    child_done = True
+            if child_done:
+                # all results are in hand; don't let a wedged PJRT teardown
+                # burn the remaining budget waiting for a clean exit
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    _kill_child(proc)
+                break
+            if proc.poll() is not None:
+                # child died without a done event (crash / OOM): drain any
+                # events written after the last poll before moving on
+                events, offset = _read_events(_EVENTS, offset)
+                for ev in events:
+                    if ev.get("event") == "query":
+                        state["per_query"][ev["q"]] = ev["secs"]
+                    elif ev.get("event") == "query_failed":
+                        state["failed"][ev["q"]] = ev.get("error", "")
+                    elif ev.get("event") == "done":
+                        child_done = True
+                print(f"bench child exited rc={proc.returncode}",
+                      file=sys.stderr, flush=True)
+                break
+            stall = _QUERY_STALL_S if saw_init else _INIT_STALL_S
+            if time.time() - last_progress > stall:
+                print(f"bench child stalled ({'run' if saw_init else 'init'}"
+                      f" {stall}s); killing", file=sys.stderr, flush=True)
+                _kill_child(proc)
+                break
+            if time.time() > deadline - 5:
+                _kill_child(proc)
+                break
+            time.sleep(2.0)
+        if child_done:
+            break
+        backoff = _BACKOFFS[min(attempt, len(_BACKOFFS) - 1)]
+        attempt += 1
+        if attempt > 3 or time.time() + backoff > deadline - 60:
+            break
+        print(f"backoff {backoff}s before retry", file=sys.stderr, flush=True)
+        time.sleep(backoff)
+
+    wd.cancel()
+    final_report()
+    if not state["per_query"]:
+        sys.exit(4 if not state["meta"].get("init_s") else 2)
 
 
 if __name__ == "__main__":

@@ -33,6 +33,23 @@ from datafusion_distributed_tpu.plan.physical import (
 
 AXIS = "tasks"
 
+# Context manager that toggles the compilation cache around one invocation
+# (see the workaround at the call site). Private jax API — guarded so a jax
+# upgrade that moves it degrades to "no workaround" loudly here, once, instead
+# of breaking all distributed execution at call time.
+try:
+    from jax._src.config import enable_compilation_cache as _disable_compile_cache
+except ImportError:  # pragma: no cover - depends on jax version
+    _disable_compile_cache = None
+    import warnings
+
+    warnings.warn(
+        "jax._src.config.enable_compilation_cache unavailable; multi-device "
+        "executables will hit the persistent compile cache (fine if this jax "
+        "version serializes them without aborting)",
+        stacklevel=1,
+    )
+
 # Re-executing the SAME plan object on the same mesh reuses the compiled
 # SPMD program (the reference's cached TaskData plan re-execution analogue).
 _MESH_COMPILE_CACHE: dict = {}
@@ -143,9 +160,11 @@ def execute_on_mesh(
     # virtual mesh); single-device programs serialize fine. EVERY call may
     # recompile (jax.jit retraces on new input shapes), so the cache is
     # disabled around the invocation itself, not just the first call.
-    from jax._src import config as _jcfg
-
-    with _jcfg.enable_compilation_cache(False):
+    if _disable_compile_cache is not None:
+        with _disable_compile_cache(False):
+            out, any_overflow, any_precision, mvec = fn(stacked_inputs)
+    else:  # private API moved: run uncached-workaround-less (cache may
+        # simply be off globally, or a newer jax fixed the serialization)
         out, any_overflow, any_precision, mvec = fn(stacked_inputs)
     if check_overflow and bool(any_overflow):
         raise RuntimeError(

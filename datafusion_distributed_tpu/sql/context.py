@@ -93,6 +93,11 @@ class Catalog:
                 # (a saturated sample means the column's true NDV is small)
                 if ndv > 0.9 * sampled:
                     ndv = min(int(ndv * (total / sampled)), total)
+                elif sampled < total:
+                    # a non-extrapolated sampled count can still undercount
+                    # the true NDV; pad it so downstream hash-table sizing
+                    # (which treats this as an upper bound) overflows less
+                    ndv = min(int(ndv * 1.5) + 16, total)
                 self._ndv_cache[key] = ndv
         return self._ndv_cache[key]
 

@@ -297,10 +297,13 @@ class PhysicalPlanner:
         Only direct base-table column references resolve (via the catalog's
         sampled NDV, the statistics role of DataFusion's table providers in
         the reference); any derived expression makes the tuple unknown.
-        Products over multiple keys ignore correlation, which only
-        *overestimates* — the safe direction for hash-table sizing (joins
-        can't mint new key values, so per-column base NDV is an upper bound
-        on the post-join distinct count)."""
+        Products over multiple keys ignore correlation, which biases the
+        multi-key estimate *upward* (joins can't mint new key values).
+        Per-column estimates, however, come from a strided SAMPLE: below
+        the extrapolation threshold they can undercount true NDV, so the
+        catalog pads non-extrapolated sampled counts (see
+        `Catalog.column_ndv`) — treat the result as a best-effort sizing
+        hint backed by the overflow-retry loop, not a hard upper bound."""
         ndv_fn = getattr(self.catalog, "column_ndv", None)
         if ndv_fn is None:
             return None
@@ -309,7 +312,14 @@ class PhysicalPlanner:
         while stack:
             n = stack.pop()
             if isinstance(n, lg.LScan):
-                aliases[n.alias] = n.table
+                # the same alias naming DIFFERENT base tables in nested
+                # scopes (correlated subquery reusing an outer alias) makes
+                # the lookup ambiguous: poison it rather than let the
+                # last-visited scan win and size against the wrong table
+                if aliases.get(n.alias, n.table) != n.table:
+                    aliases[n.alias] = None
+                else:
+                    aliases[n.alias] = n.table
             stack.extend(n.children())
         est = 1
         for e in exprs:
