@@ -355,6 +355,28 @@ class Table:
         n = jnp.minimum(self.num_rows, jnp.asarray(limit, dtype=jnp.int32))
         return Table(self.names, self.columns, n)
 
+    def slice_rows(self, lo: int, count: int) -> "Table":
+        """Row-range slice [lo, lo+count) as a compact table (NOT jit-safe:
+        static python offsets). The chunking primitive of the streaming
+        data plane — each chunk's buffers are views of this table, so
+        slicing is free until a consumer materializes the chunk."""
+        n = int(self.num_rows)
+        lo = max(0, min(lo, n))
+        count = max(0, min(count, n - lo))
+        cap = max(_round_up(count), 8)
+        cols = tuple(
+            Column(
+                c.data[lo:lo + cap],
+                c.validity[lo:lo + cap] if c.validity is not None else None,
+                c.dtype, c.dictionary,
+            )
+            for c in self.columns
+        )
+        # short tail: buffer views may be < cap; pad via head-room contract
+        # (rows past num_rows are garbage by contract, so a short buffer is
+        # only a problem for fixed-capacity consumers; re-pad those lazily)
+        return Table(self.names, cols, jnp.asarray(count, dtype=jnp.int32))
+
     # -- host materialization (NOT jit-safe) --------------------------------
     def to_numpy(self, decode_strings: bool = True) -> dict[str, np.ndarray]:
         n = int(self.num_rows)

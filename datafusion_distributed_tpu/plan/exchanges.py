@@ -44,6 +44,15 @@ class ExchangeExec(ExecutionPlan):
         # stamped by the prepare pass (stage ids mirror the reference's
         # (query_id, stage_num) TaskKey addressing)
         self.stage_id: Optional[int] = None
+        # producer-stage task count when it differs from the consumer side
+        # (stamped by the task-count lattice; None = uniform num_tasks).
+        # Coalesce's num_tasks already IS the producer count.
+        self.producer_tasks: Optional[int] = None
+        # downstream LIMIT's fetch+skip (stamped by the planner's limit
+        # rule): the streaming data plane stops pulling producer chunks
+        # once this many rows arrived (host tier only; in-mesh collectives
+        # are single-program and already bounded by the local limit)
+        self.consumer_fetch: Optional[int] = None
 
     def children(self):
         return [self.child]
@@ -92,10 +101,16 @@ class ShuffleExchangeExec(ExchangeExec):
             children[0], self.key_names, self.num_tasks, self.per_dest_capacity
         )
         n.stage_id = self.stage_id
+        n.producer_tasks = self.producer_tasks
+        n.consumer_fetch = self.consumer_fetch
         return n
 
     def output_capacity(self):
-        return self.num_tasks * self.per_dest_capacity
+        # a consumer task receives <= per_dest_capacity from EACH producer
+        # task (mesh tier: producers == the axis width == num_tasks)
+        t_prod = (self.producer_tasks if self.producer_tasks is not None
+                  else self.num_tasks)
+        return t_prod * self.per_dest_capacity
 
     def _execute(self, ctx: ExecContext) -> Table:
         t = self.child.execute(ctx)
@@ -122,6 +137,8 @@ class PartitionReplicatedExec(ExchangeExec):
     def with_new_children(self, children):
         n = PartitionReplicatedExec(children[0], self.num_tasks)
         n.stage_id = self.stage_id
+        n.producer_tasks = self.producer_tasks
+        n.consumer_fetch = self.consumer_fetch
         return n
 
     def output_capacity(self):
@@ -161,6 +178,8 @@ class CoalesceExchangeExec(ExchangeExec):
             children[0], self.num_tasks, self.num_consumers
         )
         n.stage_id = self.stage_id
+        n.producer_tasks = self.producer_tasks
+        n.consumer_fetch = self.consumer_fetch
         return n
 
     def output_capacity(self):
@@ -326,6 +345,8 @@ class BroadcastExchangeExec(ExchangeExec):
     def with_new_children(self, children):
         n = BroadcastExchangeExec(children[0], self.num_tasks)
         n.stage_id = self.stage_id
+        n.producer_tasks = self.producer_tasks
+        n.consumer_fetch = self.consumer_fetch
         return n
 
     def output_capacity(self):

@@ -105,8 +105,35 @@ _NODE_COUNTER = itertools.count()
 class ExecutionPlan:
     """Base of the physical plan tree."""
 
+    #: statistics annotations stamped by the SQL planner from catalog NDV
+    #: (the role DataFusion table-provider statistics play for the
+    #: reference's cost model): estimated output rows / filter selectivity.
+    #: Consumed by planner/statistics.estimate_rows; preserved across
+    #: with_new_children rebuilds by the __init_subclass__ hook below.
+    est_rows: "float | None" = None
+    est_selectivity: "float | None" = None
+
     def __init__(self) -> None:
         self.node_id = next(_NODE_COUNTER)
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        impl = cls.__dict__.get("with_new_children")
+        if impl is None:
+            return
+        import functools
+
+        @functools.wraps(impl)
+        def wrapped(self, children, _impl=impl):
+            n = _impl(self, children)
+            if n is not self and type(n) is type(self):
+                for a in ("est_rows", "est_selectivity"):
+                    v = getattr(self, a, None)
+                    if v is not None and getattr(n, a, None) is None:
+                        setattr(n, a, v)
+            return n
+
+        cls.with_new_children = wrapped
 
     # -- tree ---------------------------------------------------------------
     def children(self) -> list["ExecutionPlan"]:

@@ -20,9 +20,14 @@ those passes over our ExecutionPlan IR:
 - `prepare` elides boundaries whose producer and consumer distributions
   already agree and stamps stage ids (`prepare_network_boundaries.rs`).
 
-Task counts: stages run at the mesh size. The Desired/Maximum annotation
-lattice of the reference drives *task routing* when meshes are larger than
-useful parallelism; carried in TaskCountAnnotation for parity.
+Task counts: the Desired/Maximum annotation lattice of the reference
+(`task_estimator.rs`) is wired through `_inject`: each leaf contributes an
+annotation (user TaskEstimator > bytes-based sizing > Desired(num_tasks)),
+annotations merge up the open stage, `_seal_stage` resolves the stage's
+count (honoring max_tasks_per_stage) and splits its scans, and boundary
+consumer counts come from the cardinality scale-factor walk. The mesh tier
+pins every stage to the axis width (`uniform_stage_tasks`: collectives are
+axis-wide); the host/coordinator tier schedules the per-stage counts.
 """
 
 from __future__ import annotations
@@ -81,6 +86,26 @@ class TaskCountAnnotation:
         return TaskCountAnnotation(max(self.count, other.count), False)
 
 
+class TaskEstimator:
+    """User extension point for per-leaf task-count estimation (the
+    reference's `TaskEstimator` trait, `task_estimator.rs:110-148`).
+    Register via ``DistributedConfig.task_estimator``. Estimators are
+    consulted leaf-by-leaf; a ``None`` return falls through to the built-in
+    bytes-based estimation."""
+
+    def task_estimation(self, leaf: ExecutionPlan,
+                        cfg: "DistributedConfig") -> Optional[TaskCountAnnotation]:
+        """Desired/Maximum task-count hint for the stage containing
+        ``leaf``, or None to defer to other estimators / the default."""
+        return None
+
+    def scale_up_leaf_node(self, leaf: ExecutionPlan, task_count: int,
+                           cfg: "DistributedConfig") -> Optional[ExecutionPlan]:
+        """Replace ``leaf`` once the stage's final ``task_count`` is known
+        (reference `scale_up_leaf_node`); None keeps the default split."""
+        return None
+
+
 @dataclass
 class DistributedConfig:
     """Knobs (subset-parity with `distributed_config.rs`)."""
@@ -89,7 +114,9 @@ class DistributedConfig:
     broadcast_joins: bool = True
     broadcast_threshold_rows: int = 1 << 17  # build sides smaller: broadcast
     shuffle_skew_factor: int = 4
-    max_tasks_per_stage: int = 0  # 0 = num_tasks
+    # hard per-stage task-count cap (Maximum semantics applied to every
+    # stage's lattice resolution); 0 = uncapped (num_tasks)
+    max_tasks_per_stage: int = 0
     # wire-format knobs (reference: distributed_config.rs compression=lz4,
     # worker_connection_buffer_budget_bytes=64MiB; zstd here — lz4 is not in
     # this image)
@@ -100,14 +127,37 @@ class DistributedConfig:
     # 16MiB + dynamic_task_count): leaves sized by bytes, not mesh size
     bytes_per_task: int = 16 << 20
     dynamic_task_count: bool = False
-    # cost multiplier applied per cardinality-affecting node when scaling
-    # consumer task counts (cardinality_task_count_factor analogue)
+    # scale factor applied per cardinality-affecting node when sizing a
+    # boundary's consumer task count (CardinalityBasedNetworkBoundaryBuilder,
+    # `inject_network_boundaries.rs:595-623`): shrinking nodes divide,
+    # growing nodes multiply; 1.0 = consumers inherit the producer count
     cardinality_task_count_factor: float = 1.0
-    # size task counts from leaf bytes (FileScanConfigTaskEstimator
+    # size leaf-stage task counts from leaf bytes (FileScanConfigTaskEstimator
     # semantics, task_estimator.rs:235-258): tasks = ceil(bytes /
     # bytes_per_task), capped at num_tasks. Host/coordinator tier only —
     # a mesh SPMD program's task count is the physical device count.
     size_tasks_to_data: bool = False
+    # user TaskEstimator consulted before the built-in leaf estimation
+    task_estimator: Optional[TaskEstimator] = None
+    # insert partial_reduce aggregates below hash shuffles (the reference's
+    # `partial_reduce` knob, default off; see _partial_reduce_pass)
+    partial_reduce: bool = False
+    # force every stage to exactly num_tasks (the mesh tier sets this: one
+    # SPMD program's exchanges are axis-wide collectives, so stage width is
+    # the physical mesh width regardless of scheduling-tier knobs)
+    uniform_stage_tasks: bool = False
+
+    def _lattice_active(self) -> bool:
+        """Whether any knob makes per-stage task counts diverge from
+        num_tasks. When inactive, resolution short-circuits to num_tasks so
+        default plans (and the mesh tier's axis-wide collectives) keep
+        uniform stage widths."""
+        return not self.uniform_stage_tasks and (
+            self.size_tasks_to_data
+            or self.max_tasks_per_stage > 0
+            or self.cardinality_task_count_factor != 1.0
+            or self.task_estimator is not None
+        )
 
 
 def estimate_leaf_bytes(plan: ExecutionPlan) -> int:
@@ -158,14 +208,11 @@ def distribute_plan(
         if _root_distribution(plan) == Distribution.PARTITIONED:
             plan = CoalesceExchangeExec(plan, config.num_tasks)
         return _prepare(plan)
-    t_eff = effective_num_tasks(plan, config)
-    if t_eff != config.num_tasks:
-        from dataclasses import replace as _replace
-
-        config = _replace(config, num_tasks=t_eff)
-    out, dist = _inject(plan, config)
+    out, dist, ann = _inject(plan, config)
     if dist == Distribution.PARTITIONED:
-        out = CoalesceExchangeExec(out, config.num_tasks)
+        out, t_root = _seal_stage(out, ann, config)
+        out = CoalesceExchangeExec(out, t_root)
+    out = _partial_reduce_pass(out, config)
     out = _prepare(out)
     return out
 
@@ -208,43 +255,187 @@ def _root_distribution(plan: ExecutionPlan) -> Distribution:
 
 
 # ---------------------------------------------------------------------------
-# boundary injection
+# task-count lattice
 # ---------------------------------------------------------------------------
 
 
-def _inject(plan: ExecutionPlan, cfg: DistributedConfig):
-    t = cfg.num_tasks
+def _resolve_count(ann: TaskCountAnnotation, cfg: DistributedConfig) -> int:
+    """Annotation -> concrete stage task count. Inactive lattice (all knobs
+    at defaults, or the mesh tier's uniform flag) resolves to num_tasks so
+    stage widths stay uniform."""
+    if not cfg._lattice_active():
+        return cfg.num_tasks
+    cap = cfg.num_tasks
+    if cfg.max_tasks_per_stage > 0:
+        cap = min(cap, cfg.max_tasks_per_stage)
+    return max(1, min(ann.count, cap))
 
-    # -- leaves: scale up into per-task slices -----------------------------
+
+def _stage_cap(cfg: DistributedConfig) -> int:
+    """Upper bound any stage may run at (for arm assignment spread)."""
+    if cfg._lattice_active() and cfg.max_tasks_per_stage > 0:
+        return max(1, min(cfg.num_tasks, cfg.max_tasks_per_stage))
+    return cfg.num_tasks
+
+
+def _leaf_annotation(leaf: ExecutionPlan, cfg: DistributedConfig,
+                     replicated: bool = False) -> TaskCountAnnotation:
+    """Task-count hint contributed by one leaf to its stage's lattice.
+    Order mirrors the reference's estimator chain (`task_estimator.rs`):
+    user estimator first, then the built-in bytes-based estimation, then
+    Desired(num_tasks). Replicated leaves are neutral (Desired(1))."""
+    if cfg.task_estimator is not None:
+        est = cfg.task_estimator.task_estimation(leaf, cfg)
+        if est is not None:
+            return est
+    if replicated:
+        return TaskCountAnnotation(1)
+    if cfg.size_tasks_to_data and cfg.bytes_per_task > 0:
+        b = estimate_leaf_bytes(leaf)
+        want = -(-b // cfg.bytes_per_task) if b else 1
+        return TaskCountAnnotation(max(1, int(want)))
+    return TaskCountAnnotation(cfg.num_tasks)
+
+
+def _cardinality_scale(plan: ExecutionPlan, cfg: DistributedConfig) -> float:
+    """Consumer-stage scale factor over one producer stage (the reference's
+    CardinalityBasedNetworkBoundaryBuilder walk,
+    `inject_network_boundaries.rs:595-623`): max over children, divided by
+    the factor at cardinality-shrinking nodes, multiplied at growing ones."""
+    if getattr(plan, "is_exchange", False):
+        return 1.0
+    sf = max(
+        (_cardinality_scale(c, cfg) for c in plan.children()), default=1.0
+    )
+    f = cfg.cardinality_task_count_factor
+    if not f or f == 1.0:
+        return sf
+    shrinks = isinstance(plan, (FilterExec, LimitExec, HashAggregateExec)) or (
+        isinstance(plan, HashJoinExec)
+        and plan.join_type in ("semi", "anti")
+    )
+    grows = isinstance(plan, (CrossJoinExec, UnionExec))
+    if shrinks:
+        return sf / f
+    if grows:
+        return sf * f
+    return sf
+
+
+def _consumer_count(stage: ExecutionPlan, t_producer: int,
+                    cfg: DistributedConfig,
+                    *siblings) -> int:
+    """Task count for the stage consuming ``stage``'s boundary: Desired(
+    ceil(scale_factor * producer_tasks)), merged across sibling producer
+    stages feeding the same consumer (co-shuffled join sides must agree)."""
+    import math
+
+    ann = TaskCountAnnotation(
+        max(1, math.ceil(_cardinality_scale(stage, cfg) * t_producer))
+    )
+    for sib_stage, sib_t in siblings:
+        ann = ann.merge(TaskCountAnnotation(max(1, math.ceil(
+            _cardinality_scale(sib_stage, cfg) * sib_t
+        ))))
+    return _resolve_count(ann, cfg)
+
+
+def _seal_stage(sub: ExecutionPlan, ann: TaskCountAnnotation,
+                cfg: DistributedConfig) -> tuple[ExecutionPlan, int]:
+    """Finalize a producer stage: resolve its task count from the lattice
+    and split its still-unsplit scans into that many slices (the deferred
+    scale_up_leaf_node step). Hard floors: a stage can never run fewer
+    tasks than an existing partitioned scan's slice count (slices beyond
+    the task count would be dropped) or an isolated arm's pinned index."""
+    from datafusion_distributed_tpu.plan.exchanges import IsolatedArmExec
+
+    t = _resolve_count(ann, cfg)
+    for n in _stage_nodes(sub):
+        if isinstance(n, MemoryScanExec) and not n.replicated:
+            if len(n.tasks) > 1:
+                t = max(t, len(n.tasks))
+        elif isinstance(n, ParquetScanExec) and len(n.file_groups) > 1:
+            t = max(t, len(n.file_groups))
+        elif isinstance(n, IsolatedArmExec):
+            t = max(t, n.assigned_task + 1)
+    return _split_leaves(sub, t, cfg), t
+
+
+def _stage_nodes(plan: ExecutionPlan) -> list:
+    """Nodes of the stage rooted at ``plan`` (stops at boundaries: deeper
+    stages are already sealed)."""
+    out = [plan]
+    if not getattr(plan, "is_exchange", False):
+        for c in plan.children():
+            out.extend(_stage_nodes(c))
+    return out
+
+
+def _split_leaves(plan: ExecutionPlan, t: int,
+                  cfg: DistributedConfig) -> ExecutionPlan:
+    """Split this stage's unsplit scans into ``t`` per-task slices (the
+    reference's scale_up_leaf_node applied with the stage's final count)."""
+    if getattr(plan, "is_exchange", False):
+        return plan
+    if isinstance(plan, (MemoryScanExec, ParquetScanExec)):
+        if cfg.task_estimator is not None:
+            repl = cfg.task_estimator.scale_up_leaf_node(plan, t, cfg)
+            if repl is not None:
+                return repl
     if isinstance(plan, MemoryScanExec):
-        if len(plan.tasks) == 1 and t > 1:
-            slices = partition_table(plan.tasks[0], t)
-            return MemoryScanExec(slices, plan.schema()), Distribution.PARTITIONED
-        return plan, (
-            Distribution.PARTITIONED if len(plan.tasks) > 1
-            else Distribution.REPLICATED
-        )
+        if not plan.replicated and len(plan.tasks) == 1 and t > 1:
+            return MemoryScanExec(
+                partition_table(plan.tasks[0], t), plan.schema()
+            )
+        return plan
     if isinstance(plan, ParquetScanExec):
         if len(plan.file_groups) == 1 and t > 1:
             files = list(plan.file_groups[0])
             groups = [files[i::t] for i in range(t)]
             # per-task capacity: whole-file granularity keeps it conservative
             per_task_cap = round_up_pow2(
-                max(plan.capacity * (len(files) // t + 1) // max(len(files), 1), 8)
+                max(plan.capacity * (len(files) // t + 1)
+                    // max(len(files), 1), 8)
             )
-            return (
-                ParquetScanExec(
-                    groups, plan._schema, per_task_cap, plan.projection,
-                    plan.dictionaries,
-                ),
-                Distribution.PARTITIONED,
+            return ParquetScanExec(
+                groups, plan._schema, per_task_cap, plan.projection,
+                plan.dictionaries,
             )
-        return plan, Distribution.PARTITIONED
+        return plan
+    children = [_split_leaves(c, t, cfg) for c in plan.children()]
+    return plan.with_new_children(children) if children else plan
+
+
+# ---------------------------------------------------------------------------
+# boundary injection
+# ---------------------------------------------------------------------------
+
+
+def _inject(plan: ExecutionPlan, cfg: DistributedConfig):
+    """-> (plan, distribution, TaskCountAnnotation of the open stage).
+
+    Leaves are NOT split here: splitting waits until the stage's boundary
+    resolves its final task count from the merged lattice (`_seal_stage`),
+    mirroring the reference's estimate-then-scale_up_leaf_node order."""
+    t = cfg.num_tasks
+
+    # -- leaves: contribute lattice annotations; split deferred ------------
+    if isinstance(plan, MemoryScanExec):
+        if len(plan.tasks) == 1 and t > 1 and not plan.replicated:
+            return (plan, Distribution.PARTITIONED,
+                    _leaf_annotation(plan, cfg))
+        replicated = plan.replicated or len(plan.tasks) == 1
+        return plan, (
+            Distribution.REPLICATED if replicated
+            else Distribution.PARTITIONED
+        ), _leaf_annotation(plan, cfg, replicated=replicated)
+    if isinstance(plan, ParquetScanExec):
+        return plan, Distribution.PARTITIONED, _leaf_annotation(plan, cfg)
 
     # -- elementwise: keep child distribution ------------------------------
     if isinstance(plan, (FilterExec, ProjectionExec, CoalescePartitionsExec)):
-        child, dist = _inject(plan.children()[0], cfg)
-        return plan.with_new_children([child]), dist
+        child, dist, ann = _inject(plan.children()[0], cfg)
+        return plan.with_new_children([child]), dist, ann
 
     if isinstance(plan, HashAggregateExec):
         return _inject_aggregate(plan, cfg)
@@ -253,44 +444,60 @@ def _inject(plan: ExecutionPlan, cfg: DistributedConfig):
         return _inject_join(plan, cfg)
 
     if isinstance(plan, CrossJoinExec):
-        left, ldist = _inject(plan.left, cfg)
-        right, rdist = _inject(plan.right, cfg)
+        left, ldist, lann = _inject(plan.left, cfg)
+        right, rdist, rann = _inject(plan.right, cfg)
         if rdist == Distribution.PARTITIONED:
+            right, _tb = _seal_stage(right, rann, cfg)
             right = BroadcastExchangeExec(right, t)
-        return plan.with_new_children([left, right]), ldist
+        return plan.with_new_children([left, right]), ldist, lann
 
     from datafusion_distributed_tpu.plan.window_exec import WindowExec
 
     if isinstance(plan, WindowExec):
-        child, dist = _inject(plan.child, cfg)
+        child, dist, ann = _inject(plan.child, cfg)
         if dist == Distribution.REPLICATED:
-            return plan.with_new_children([child]), dist
+            return plan.with_new_children([child]), dist, ann
         if plan.partition_names:
             # rows of one window partition must land on one task
-            shuffled = _mk_shuffle(child, plan.partition_names, cfg)
-            return plan.with_new_children([shuffled]), Distribution.PARTITIONED
-        gathered = CoalesceExchangeExec(child, t)
-        return plan.with_new_children([gathered]), Distribution.REPLICATED
+            child, t_p = _seal_stage(child, ann, cfg)
+            t_c = _consumer_count(child, t_p, cfg)
+            if t_c <= 1:
+                gathered = CoalesceExchangeExec(child, t_p)
+                return (plan.with_new_children([gathered]),
+                        Distribution.REPLICATED, TaskCountAnnotation(1))
+            shuffled = _mk_shuffle(child, plan.partition_names, cfg, t_c, t_p)
+            return (plan.with_new_children([shuffled]),
+                    Distribution.PARTITIONED, TaskCountAnnotation(t_c))
+        child, t_p = _seal_stage(child, ann, cfg)
+        gathered = CoalesceExchangeExec(child, t_p)
+        return (plan.with_new_children([gathered]), Distribution.REPLICATED,
+                TaskCountAnnotation(1))
 
     if isinstance(plan, SortExec):
-        child, dist = _inject(plan.child, cfg)
+        child, dist, ann = _inject(plan.child, cfg)
         if dist == Distribution.REPLICATED:
-            return plan.with_new_children([child]), dist
+            return plan.with_new_children([child]), dist, ann
         # local (top-k) sort -> coalesce -> final sort; fetch pushdown is the
         # push_fetch_into_network_coalesce analogue
         local = SortExec(plan.keys, child, fetch=plan.fetch)
-        gathered = CoalesceExchangeExec(local, t)
+        local, t_p = _seal_stage(local, ann, cfg)
+        gathered = CoalesceExchangeExec(local, t_p)
         final = SortExec(plan.keys, gathered, fetch=plan.fetch)
-        return final, Distribution.REPLICATED
+        return final, Distribution.REPLICATED, TaskCountAnnotation(1)
 
     if isinstance(plan, LimitExec):
-        child, dist = _inject(plan.child, cfg)
+        child, dist, ann = _inject(plan.child, cfg)
         if dist == Distribution.REPLICATED:
-            return plan.with_new_children([child]), dist
+            return plan.with_new_children([child]), dist, ann
         # local limit bounds rows crossing the exchange (fetch+skip of them)
         local = LimitExec(child, plan.fetch + plan.skip, 0)
-        gathered = CoalesceExchangeExec(local, t)
-        return LimitExec(gathered, plan.fetch, plan.skip), Distribution.REPLICATED
+        local, t_p = _seal_stage(local, ann, cfg)
+        gathered = CoalesceExchangeExec(local, t_p)
+        # the streaming data plane stops pulling chunks once this many rows
+        # arrived — ANY fetch+skip rows satisfy an unordered LIMIT
+        gathered.consumer_fetch = plan.fetch + plan.skip
+        return (LimitExec(gathered, plan.fetch, plan.skip),
+                Distribution.REPLICATED, TaskCountAnnotation(1))
 
     if isinstance(plan, UnionExec):
         from datafusion_distributed_tpu.plan.exchanges import (
@@ -299,12 +506,18 @@ def _inject(plan: ExecutionPlan, cfg: DistributedConfig):
         )
 
         children = []
+        anns = []
         replicated_idx = []
         for i, c in enumerate(plan.children()):
-            cc, cdist = _inject(c, cfg)
+            cc, cdist, cann = _inject(c, cfg)
             if cdist == Distribution.REPLICATED:
                 replicated_idx.append(len(children))
             children.append(cc)
+            anns.append(cann)
+        ann = TaskCountAnnotation(1)
+        for i, a in enumerate(anns):
+            if i not in replicated_idx:
+                ann = ann.merge(a)
         if replicated_idx:
             # child isolation (ChildrenIsolatorUnionExec analogue): each
             # replicated arm is COMPUTED on exactly one task — weighted
@@ -314,61 +527,132 @@ def _inject(plan: ExecutionPlan, cfg: DistributedConfig):
             weights = [
                 float(children[i].output_capacity()) for i in replicated_idx
             ]
-            assigned = assign_arms_to_tasks(weights, t)
+            assigned = assign_arms_to_tasks(weights, _stage_cap(cfg))
             for i, task in zip(replicated_idx, assigned):
                 children[i] = IsolatedArmExec(children[i], task)
-        return UnionExec(children), Distribution.PARTITIONED
+            ann = ann.merge(TaskCountAnnotation(1 + max(assigned)))
+        return UnionExec(children), Distribution.PARTITIONED, ann
 
     if not plan.children():
-        return plan, Distribution.REPLICATED
+        return plan, Distribution.REPLICATED, TaskCountAnnotation(1)
 
     # default: single child passthrough
     children = []
     dist = Distribution.REPLICATED
+    ann = TaskCountAnnotation(1)
     for c in plan.children():
-        cc, cdist = _inject(c, cfg)
+        cc, cdist, cann = _inject(c, cfg)
         children.append(cc)
         if cdist == Distribution.PARTITIONED:
             dist = Distribution.PARTITIONED
-    return plan.with_new_children(children), dist
+        ann = ann.merge(cann)
+    return plan.with_new_children(children), dist, ann
 
 
 def _inject_aggregate(plan: HashAggregateExec, cfg: DistributedConfig):
-    t = cfg.num_tasks
-    child, dist = _inject(plan.child, cfg)
+    child, dist, ann = _inject(plan.child, cfg)
     if dist == Distribution.REPLICATED:
-        return plan.with_new_children([child]), dist
+        return plan.with_new_children([child]), dist, ann
     if plan.mode != "single":
         # already split by a previous pass
-        return plan.with_new_children([child]), dist
+        return plan.with_new_children([child]), dist, ann
 
     if not plan.group_names:
         partial = HashAggregateExec(
             "partial", [], plan.aggs, child, plan.num_slots
         )
-        gathered = CoalesceExchangeExec(partial, t)
+        partial, t_p = _seal_stage(partial, ann, cfg)
+        gathered = CoalesceExchangeExec(partial, t_p)
         final = HashAggregateExec(
             "final", [], plan.aggs, gathered, plan.num_slots
         )
-        return final, Distribution.REPLICATED
+        return final, Distribution.REPLICATED, TaskCountAnnotation(1)
 
     partial = HashAggregateExec(
         "partial", plan.group_names, plan.aggs, child, plan.num_slots
     )
-    shuffle = _mk_shuffle(partial, plan.group_names, cfg)
+    partial.est_rows = plan.est_rows  # NDV estimate survives the split
+    partial, t_p = _seal_stage(partial, ann, cfg)
+    t_c = _consumer_count(partial, t_p, cfg)
+    if t_c <= 1:
+        # one consumer: gather instead of shuffle (keys co-locate trivially;
+        # the coalesced output is replicated, not partitioned)
+        gathered = CoalesceExchangeExec(partial, t_p)
+        final = HashAggregateExec(
+            "final", plan.group_names, plan.aggs, gathered, plan.num_slots
+        )
+        final.est_rows = plan.est_rows
+        return final, Distribution.REPLICATED, TaskCountAnnotation(1)
+    shuffle = _mk_shuffle(partial, plan.group_names, cfg, t_c, t_p)
     final = HashAggregateExec(
         "final", plan.group_names, plan.aggs, shuffle,
         min(plan.num_slots, round_up_pow2(max(shuffle.output_capacity(), 16))),
     )
-    return final, Distribution.PARTITIONED
+    final.est_rows = plan.est_rows
+    return final, Distribution.PARTITIONED, TaskCountAnnotation(t_c)
 
 
-def _mk_shuffle(child, keys, cfg: DistributedConfig) -> ShuffleExchangeExec:
-    t = cfg.num_tasks
+def _mk_shuffle(child, keys, cfg: DistributedConfig,
+                t_consumer: Optional[int] = None,
+                t_producer: Optional[int] = None) -> ShuffleExchangeExec:
+    t = t_consumer if t_consumer is not None else cfg.num_tasks
     per_dest = round_up_pow2(
         max(cfg.shuffle_skew_factor * child.output_capacity() // max(t, 1), 8)
     )
-    return ShuffleExchangeExec(child, keys, t, per_dest)
+    ex = ShuffleExchangeExec(child, keys, t, per_dest)
+    if t_producer is not None:
+        ex.producer_tasks = t_producer
+    return ex
+
+
+def _partial_reduce_pass(plan: ExecutionPlan,
+                         cfg: DistributedConfig) -> ExecutionPlan:
+    """Insert `mode=partial_reduce` between a producer stage's partial
+    aggregate and its hash shuffle (the reference's
+    `partial_reduce_below_network_shuffles.rs`, gated off by default by
+    `DistributedConfig.partial_reduce` exactly like the reference knob).
+
+    TPU rationale: exchange payloads are PADDED capacity buffers, and a
+    partial aggregate is sized for the GLOBAL group cardinality while one
+    task's slice can only hold `slice_capacity` distinct keys. The inserted
+    re-group re-packs partial states into `min(global_slots,
+    2*slice_capacity)` slots, shrinking the all_to_all payload for
+    high-cardinality GROUP BYs (the merge itself is the same accumulator
+    merge the reference performs post-repartition)."""
+    if not cfg.partial_reduce:
+        return plan
+
+    def walk(node: ExecutionPlan) -> ExecutionPlan:
+        children = [walk(c) for c in node.children()]
+        if children:
+            node = node.with_new_children(children)
+        if not (
+            isinstance(node, ShuffleExchangeExec)
+            and isinstance(node.child, HashAggregateExec)
+            and node.child.mode == "partial"
+            and node.child.group_names
+            and list(node.key_names) == list(node.child.group_names)
+        ):
+            return node
+        partial = node.child
+        slots = min(
+            partial.num_slots,
+            round_up_pow2(max(2 * partial.child.output_capacity(), 16)),
+        )
+        reduce_node = HashAggregateExec(
+            "partial_reduce", partial.group_names, partial.aggs, partial,
+            slots,
+        )
+        per_dest = round_up_pow2(max(
+            cfg.shuffle_skew_factor * slots // max(node.num_tasks, 1), 8
+        ))
+        ex = ShuffleExchangeExec(
+            reduce_node, node.key_names, node.num_tasks, per_dest
+        )
+        ex.producer_tasks = getattr(node, "producer_tasks", None)
+        return ex
+
+    return walk(plan)
 
 
 def _inject_join(plan: HashJoinExec, cfg: DistributedConfig):
@@ -382,18 +666,21 @@ def _inject_join(plan: HashJoinExec, cfg: DistributedConfig):
       replicated/broadcast build.
     - null-aware anti (NOT IN) needs the global "any NULL build key" fact, so
       the build is always broadcast.
+    - co-shuffled sides share ONE consumer task count (`hash % t` must agree
+      or co-partitioning breaks), merged from both sides' lattices.
     """
     t = cfg.num_tasks
-    probe, pdist = _inject(plan.probe, cfg)
-    build, bdist = _inject(plan.build, cfg)
+    probe, pdist, pann = _inject(plan.probe, cfg)
+    build, bdist, bann = _inject(plan.build, cfg)
     preserved = plan.join_type in ("left", "semi", "anti", "mark")
 
     if bdist == Distribution.REPLICATED and pdist == Distribution.REPLICATED:
-        return plan.with_new_children([probe, build]), Distribution.REPLICATED
+        return (plan.with_new_children([probe, build]),
+                Distribution.REPLICATED, pann.merge(bann))
 
     if bdist == Distribution.REPLICATED:
         # build already everywhere; partitioned probe joins locally
-        return plan.with_new_children([probe, build]), pdist
+        return plan.with_new_children([probe, build]), pdist, pann
 
     small_build = (
         cfg.broadcast_joins
@@ -404,21 +691,26 @@ def _inject_join(plan: HashJoinExec, cfg: DistributedConfig):
         or pdist == Distribution.REPLICATED
     )
     if must_broadcast or small_build:
+        build, _tb = _seal_stage(build, bann, cfg)
         b = BroadcastExchangeExec(build, t)
         out = plan.with_new_children([probe, b])
-        return out, pdist
+        return out, pdist, pann
 
-    if preserved:
-        # co-shuffle both sides on the join keys (probe is PARTITIONED here)
-        p = _mk_shuffle(probe, plan.probe_keys, cfg)
-        b = _mk_shuffle(build, plan.build_keys, cfg)
-        return plan.with_new_children([p, b]), Distribution.PARTITIONED
-
-    # inner join, partitioned probe: co-shuffle both sides
-    p = _mk_shuffle(probe, plan.probe_keys, cfg)
-    b = _mk_shuffle(build, plan.build_keys, cfg)
+    # co-shuffle both sides on the join keys (probe is PARTITIONED here;
+    # applies to preserved joins and plain inner joins alike)
+    probe, t_pp = _seal_stage(probe, pann, cfg)
+    build, t_pb = _seal_stage(build, bann, cfg)
+    t_c = _consumer_count(probe, t_pp, cfg, (build, t_pb))
+    if t_c <= 1:
+        # one consumer: gather both sides; the join runs replicated
+        p = CoalesceExchangeExec(probe, t_pp)
+        b = CoalesceExchangeExec(build, t_pb)
+        return (plan.with_new_children([p, b]), Distribution.REPLICATED,
+                TaskCountAnnotation(1))
+    p = _mk_shuffle(probe, plan.probe_keys, cfg, t_c, t_pp)
+    b = _mk_shuffle(build, plan.build_keys, cfg, t_c, t_pb)
     out = plan.with_new_children([p, b])
-    return out, Distribution.PARTITIONED
+    return out, Distribution.PARTITIONED, TaskCountAnnotation(t_c)
 
 
 # ---------------------------------------------------------------------------

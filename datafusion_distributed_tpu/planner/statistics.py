@@ -106,7 +106,10 @@ class PlanStatistics:
 
 def estimate_rows(plan: ExecutionPlan, stats: Optional[PlanStatistics] = None) -> float:
     """Bottom-up cardinality estimate (CardinalityEffect analogue: filters
-    shrink, joins keep the probe side, aggregates dedupe)."""
+    shrink, joins keep the probe side, aggregates dedupe). Planner-stamped
+    NDV statistics (`ExecutionPlan.est_rows` / `.est_selectivity`, from the
+    catalog's sampled NDV — the same statistics that drive join/agg hash
+    sizing) take precedence over the blanket heuristics."""
     if stats is not None and plan.node_id in stats.rows:
         return stats.rows[plan.node_id]
     if isinstance(plan, (MemoryScanExec,)):
@@ -114,7 +117,9 @@ def estimate_rows(plan: ExecutionPlan, stats: Optional[PlanStatistics] = None) -
     if isinstance(plan, ParquetScanExec):
         return float(plan.capacity)
     if isinstance(plan, FilterExec):
-        return estimate_rows(plan.child, stats) / 3.0
+        n = estimate_rows(plan.child, stats)
+        sel = plan.est_selectivity
+        return n * sel if sel is not None else n / 3.0
     if isinstance(plan, (ProjectionExec, LimitExec)):
         child = plan.children()[0]
         n = estimate_rows(child, stats)
@@ -123,7 +128,11 @@ def estimate_rows(plan: ExecutionPlan, stats: Optional[PlanStatistics] = None) -
         return n
     if isinstance(plan, HashAggregateExec):
         n = estimate_rows(plan.child, stats)
-        return max(n ** 0.5, 1.0) if plan.group_names else 1.0
+        if not plan.group_names:
+            return 1.0
+        if plan.est_rows is not None:
+            return max(min(plan.est_rows, n), 1.0)
+        return max(n ** 0.5, 1.0)
     if isinstance(plan, HashJoinExec):
         p = estimate_rows(plan.probe, stats)
         if plan.join_type in ("semi", "anti"):

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from datafusion_distributed_tpu.ops.hash import hash_columns
-from datafusion_distributed_tpu.ops.table import Table, concat_tables, round_up_pow2
+from datafusion_distributed_tpu.ops.table import Table, concat_tables
 from datafusion_distributed_tpu.plan.exchanges import (
     BroadcastExchangeExec,
     CoalesceExchangeExec,
@@ -83,6 +83,8 @@ class Coordinator:
     route_tasks: Optional[Callable] = None  # custom routing hook
     collect_metrics: bool = True
     metrics: dict = field(default_factory=dict)  # TaskKey -> worker metrics
+    # (query_id, stage_id) -> streaming-plane stats (bytes/chunks/early_exit)
+    stream_metrics: dict = field(default_factory=dict)
     # `SET distributed.*` options propagated to every worker with the plan
     # (the config-over-headers flow, `config_extension_ext.rs:1-82`)
     config_options: dict = field(default_factory=dict)
@@ -144,6 +146,18 @@ class Coordinator:
             outputs = [
                 self._run_stage_task(producer, query_id, stage_id, 0, t_prod)
             ]
+        elif isinstance(
+            plan, (CoalesceExchangeExec, BroadcastExchangeExec)
+        ) and not (
+            isinstance(plan, CoalesceExchangeExec) and plan.num_consumers > 1
+        ):
+            # N:1 coalesce / broadcast: the STREAMING data plane — chunked,
+            # budget-bounded, LIMIT-aware (see _stream_stage_coalesced)
+            merged = self._stream_stage_coalesced(
+                plan, producer, query_id, stage_id, t_prod
+            )
+            return MemoryScanExec([merged], producer.schema(),
+                                  replicated=True)
         else:
             outputs = self._run_stage_tasks(
                 producer, query_id, stage_id, t_prod
@@ -173,13 +187,6 @@ class Coordinator:
                     ref = outputs[0]
                     slices.append(Table(ref.names, ref.columns,
                                         jnp.zeros((), jnp.int32)))
-        elif isinstance(plan, (CoalesceExchangeExec, BroadcastExchangeExec)):
-            # one merged logical table, served to EVERY consumer task
-            # (replicated semantics) — no per-task copies, any task count
-            cap = sum(o.capacity for o in outputs)
-            merged = concat_tables(outputs, capacity=cap)
-            return MemoryScanExec([merged], producer.schema(),
-                                  replicated=True)
         elif isinstance(plan, PartitionReplicatedExec):
             # producer is replicated: each consumer keeps its modulo slice of
             # task 0's output
@@ -190,9 +197,14 @@ class Coordinator:
 
     # -- task-count policy ---------------------------------------------------
     def _producer_task_count(self, exchange, producer) -> int:
-        """How many tasks to run for the producer stage: never more than the
-        data slices available in its scans (an earlier exchange may have
-        produced fewer consumer slices than the planned task count)."""
+        """How many tasks to run for the producer stage: the lattice-stamped
+        count when present, else the exchange's planned count — never more
+        than the data slices available in its scans (an earlier exchange may
+        have produced fewer consumer slices than the planned task count),
+        never fewer than an isolated arm's pinned index needs."""
+        planned = getattr(exchange, "producer_tasks", None)
+        if planned is None:
+            planned = exchange.num_tasks
         scans = [
             n for n in producer.collect(lambda n: not n.children())
             if isinstance(n, MemoryScanExec) and not n.pinned
@@ -205,20 +217,92 @@ class Coordinator:
         partitioned = [s for s in scans if not s.replicated]
         slice_counts = [len(s.tasks) for s in partitioned]
         if slice_counts:
-            t = min(exchange.num_tasks, max(slice_counts))
+            t = min(planned, max(slice_counts))
         elif scans:
             # all inputs replicated: every task would compute the identical
             # result — run the stage ONCE (the reference co-locates
             # single-task stages the same way, prepare_dynamic_plan.rs:86-96)
             t = 1
         else:
-            t = exchange.num_tasks
-        return min(exchange.num_tasks, max(t, need))
+            t = planned
+        return min(max(planned, need), max(t, need))
 
     def _consumer_task_count(self, exchange, outputs) -> int:
         """Static mode: the planned count (AdaptiveCoordinator recomputes
         from exact materialized bytes)."""
         return exchange.num_tasks
+
+    # -- streaming data plane -----------------------------------------------
+    def _stream_stage_coalesced(
+        self, exchange, producer: ExecutionPlan, query_id: str,
+        stage_id: int, t_prod: int,
+    ) -> Table:
+        """Materialize an N:1 coalesce/broadcast boundary through the
+        chunked streaming plane (runtime/streams.py): one puller per
+        producer task, in-flight bytes bounded by
+        `worker_connection_buffer_budget_bytes`, and production cancelled
+        early once a downstream LIMIT's rows have arrived
+        (`exchange.consumer_fetch`, stamped by the planner)."""
+        from datafusion_distributed_tpu.runtime.streams import (
+            stream_stage_chunks,
+        )
+
+        budget = int(self.config_options.get(
+            "worker_connection_buffer_budget_bytes", 64 << 20
+        ))
+        chunk_rows = int(self.config_options.get("stream_chunk_rows", 65536))
+        fetch = getattr(exchange, "consumer_fetch", None)
+
+        prepared = self._prepare_stage_plan(producer)
+
+        def make_puller(task_number: int):
+            def pull(cancel):
+                worker, key, plan_obj, store = self._dispatch_task(
+                    prepared, query_id, stage_id, task_number, t_prod
+                )
+                try:
+                    if hasattr(worker, "execute_task_stream"):
+                        yield from worker.execute_task_stream(
+                            key, chunk_rows=chunk_rows, cancel=cancel
+                        )
+                    else:  # transport without a streaming surface
+                        from datafusion_distributed_tpu.planner.statistics import (  # noqa: E501
+                            row_width,
+                        )
+
+                        out = worker.execute_task(key)
+                        width = row_width(out.schema())
+                        n = int(out.num_rows)
+                        for lo in range(0, max(n, 1), chunk_rows):
+                            if cancel.is_set():
+                                return
+                            c = min(chunk_rows, n - lo)
+                            yield out.slice_rows(lo, c), c * width
+                    self._record_task_progress(worker, key)
+                finally:
+                    self._cleanup_task(worker, key, plan_obj, store)
+
+            return pull
+
+        chunks, stats = stream_stage_chunks(
+            [make_puller(i) for i in range(t_prod)], budget,
+            row_target=fetch,
+        )
+        self.stream_metrics[(query_id, stage_id)] = {
+            "bytes_streamed": stats.bytes_streamed,
+            "chunks": stats.chunks,
+            "peak_in_flight": stats.peak_in_flight,
+            "early_exit": stats.early_exit,
+            "rows": stats.rows,
+        }
+        flat = [c for per in chunks for c in per]
+        if not flat:
+            schema = producer.schema()
+            return Table.empty(schema, 8, None)
+        # capacity: exactly the streamed rows, 8-row aligned (chunk padding
+        # and a pow2 round here would transiently double big gathers)
+        cap = max(-(-stats.rows // 8) * 8, 8)
+        return concat_tables(flat, capacity=cap)
 
     # -- task execution ------------------------------------------------------
     def _run_stage_tasks(
@@ -260,6 +344,26 @@ class Coordinator:
         task_number: int,
         task_count: int,
     ) -> Table:
+        stage_plan = self._prepare_stage_plan(stage_plan)
+        worker, key, plan_obj, store = self._dispatch_task(
+            stage_plan, query_id, stage_id, task_number, task_count
+        )
+        try:
+            out = worker.execute_task(key)
+            self._record_task_progress(worker, key)
+        finally:
+            self._cleanup_task(worker, key, plan_obj, store)
+        return out
+
+    # -- shared task dispatch (bulk + streaming planes) ----------------------
+    def _prepare_stage_plan(self, stage_plan: ExecutionPlan) -> ExecutionPlan:
+        """Hook: last-moment stage-plan rewrite before shipping (the
+        AdaptiveCoordinator resizes capacities from exact input stats)."""
+        return stage_plan
+
+    def _dispatch_task(self, stage_plan, query_id, stage_id, task_number,
+                       task_count):
+        """Route, task-specialize, ship: -> (worker, key, plan_obj, store)."""
         urls = self.resolver.get_urls()
         if self.route_tasks is not None:
             url = self.route_tasks(query_id, stage_id, task_number, urls)
@@ -274,26 +378,28 @@ class Coordinator:
         worker.set_plan(key, plan_obj, task_count,
                         config=self.config_options,
                         headers=self.passthrough_headers)
-        try:
-            out = worker.execute_task(key)
-            if self.collect_metrics:
-                progress = worker.task_progress(key) or {}
-                self.metrics[key] = progress
-                elapsed = progress.get("elapsed_s")
-                if elapsed is not None and self.latency is not None:
-                    self.latency.record(float(elapsed))
-        finally:
-            # drop-driven cleanup: the task's cache entry AND its shipped
-            # table slices are released as soon as its single partition is
-            # consumed (reference: on_drop_stream + invalidate,
-            # `impl_execute_task.rs:97-112`)
-            worker.registry.invalidate(key)
-            from datafusion_distributed_tpu.runtime.codec import (
-                collect_table_ids,
-            )
+        return worker, key, plan_obj, store
 
-            store.remove(collect_table_ids(plan_obj))
-        return out
+    def _record_task_progress(self, worker, key) -> None:
+        if not self.collect_metrics:
+            return
+        progress = worker.task_progress(key) or {}
+        self.metrics[key] = progress
+        elapsed = progress.get("elapsed_s")
+        if elapsed is not None and self.latency is not None:
+            self.latency.record(float(elapsed))
+
+    def _cleanup_task(self, worker, key, plan_obj, store) -> None:
+        # drop-driven cleanup: the task's cache entry AND its shipped
+        # table slices are released as soon as its single partition is
+        # consumed (reference: on_drop_stream + invalidate,
+        # `impl_execute_task.rs:97-112`)
+        worker.registry.invalidate(key)
+        from datafusion_distributed_tpu.runtime.codec import (
+            collect_table_ids,
+        )
+
+        store.remove(collect_table_ids(plan_obj))
 
 
 @dataclass
@@ -345,19 +451,17 @@ class AdaptiveCoordinator(Coordinator):
         )
         return t
 
-    def _run_stage_task(self, stage_plan, query_id, stage_id, task_number,
-                        task_count):
+    def _prepare_stage_plan(self, stage_plan):
+        """Resize stage capacities from EXACT materialized input stats —
+        applied by BOTH the bulk and streaming dispatch paths."""
         info = self._stage_input_info(stage_plan)
-        if info is not None:
-            from datafusion_distributed_tpu.planner.adaptive import (
-                resize_for_inputs,
-            )
-
-            stage_plan = resize_for_inputs(stage_plan, info)
-        out = super()._run_stage_task(
-            stage_plan, query_id, stage_id, task_number, task_count
+        if info is None:
+            return stage_plan
+        from datafusion_distributed_tpu.planner.adaptive import (
+            resize_for_inputs,
         )
-        return out
+
+        return resize_for_inputs(stage_plan, info)
 
     def _stage_input_info(self, stage_plan):
         from datafusion_distributed_tpu.planner.adaptive import (
@@ -483,7 +587,9 @@ def _shuffle_regroup(
         for j in range(num_tasks):
             buckets[j].append(out.compact(live & (dest == j)))
     slices = []
-    cap = num_tasks * per_dest_capacity
+    # each of the len(outputs) producers contributes <= per_dest_capacity
+    # rows to a destination (task counts may differ per stage)
+    cap = max(len(outputs), 1) * per_dest_capacity
     for j in range(num_tasks):
         slices.append(concat_tables(buckets[j], capacity=cap))
     return slices

@@ -79,37 +79,58 @@ def _handlers(worker: Worker):
             ).encode()
 
     def execute_task(request: bytes, context):
-        """Server-streaming: header+table as one framed payload, sliced into
-        chunks. The client's read pace backpressures via gRPC flow control;
-        a dropped stream (cancellation) stops the yield loop."""
+        """Server-streaming. Two protocols:
+
+        bulk (no chunk_rows): header+table as ONE framed payload sliced
+        into transport pieces; the client's read pace backpressures via
+        gRPC flow control.
+
+        streaming (chunk_rows > 0): a header message then one framed
+        message PER ROW CHUNK — rows after a client cancellation are never
+        even encoded (the reference's dropped-stream early exit,
+        `impl_execute_task.rs:97-112`)."""
         msg = json.loads(request.decode())
         key = _key_from_obj(msg["key"])
         codec = msg.get("compression", "zstd")
         chunk = int(msg.get("chunk_bytes", transport.DEFAULT_CHUNK_BYTES))
+        chunk_rows = int(msg.get("chunk_rows", 0))
         try:
-            out = worker.execute_task(key)
-            # progress rides the response: the registry entry is invalidated
-            # below, so a later TaskProgress call couldn't see it
-            progress = worker.task_progress(key)
+            try:
+                out = worker.execute_task(key)
+                # progress rides the response: the registry entry is
+                # invalidated below, so a later TaskProgress call couldn't
+                # see it
+                progress = worker.task_progress(key)
+            except WorkerError as e:
+                yield b"E" + json.dumps(e.to_dict()).encode()
+                return
+            except Exception as e:
+                yield b"E" + json.dumps(
+                    wrap_worker_exception(e, worker.url, key).to_dict()
+                ).encode()
+                return
+            if chunk_rows > 0:
+                yield b"H" + json.dumps({"progress": progress}).encode()
+                n = int(out.num_rows)
+                for lo in range(0, max(n, 1), chunk_rows):
+                    if not context.is_active():  # cancelled: stop producing
+                        return
+                    piece = out.slice_rows(lo, min(chunk_rows, n - lo))
+                    yield b"T" + transport.pack_frame(
+                        {}, {"table": encode_table(piece)}, codec=codec
+                    )
+                return
             frame = transport.pack_frame(
                 {"progress": progress}, {"table": encode_table(out)},
                 codec=codec,
             )
-        except WorkerError as e:
-            yield b"E" + json.dumps(e.to_dict()).encode()
-            return
-        except Exception as e:
-            yield b"E" + json.dumps(
-                wrap_worker_exception(e, worker.url, key).to_dict()
-            ).encode()
-            return
+            for piece in transport.iter_chunks(frame, chunk):
+                if not context.is_active():
+                    return
+                yield b"D" + piece
         finally:
             worker.registry.invalidate(key)
             worker.table_store.remove(msg.get("table_ids", []))
-        for piece in transport.iter_chunks(frame, chunk):
-            if not context.is_active():  # consumer cancelled: stop producing
-                return
-            yield b"D" + piece
 
     def get_info(request: bytes, context) -> bytes:
         return json.dumps(worker.get_info()).encode()
@@ -166,13 +187,13 @@ class GrpcWorkerClient:
     get_info / task_progress / table_store / registry."""
 
     def __init__(self, url: str, compression: str = "zstd",
-                 buffer_budget_bytes: int = 64 << 20,
                  chunk_bytes: int = transport.DEFAULT_CHUNK_BYTES):
+        # (in-flight byte budgeting lives in the coordinator's streaming
+        # plane, runtime/streams.py — not per-connection)
         import grpc
 
         self.url = url
         self.compression = transport.effective_codec(compression)
-        self.buffer_budget_bytes = buffer_budget_bytes
         self.chunk_bytes = chunk_bytes
         target = url.removeprefix("grpc://")
         self._channel = grpc.insecure_channel(
@@ -261,6 +282,39 @@ class GrpcWorkerClient:
         # response and is served from this cache
         self._progress_cache[key] = header.get("progress")
         return decode_table(blobs["table"])
+
+    def execute_task_stream(self, key: TaskKey, chunk_rows: int = 65536,
+                            cancel=None):
+        """Streaming protocol: yields (chunk Table, wire_bytes). Setting
+        ``cancel`` cancels the gRPC stream — the server stops encoding rows
+        (true wire-level early exit)."""
+        rpc = self._channel.unary_stream(
+            f"/{_SERVICE}/ExecuteTask",
+            request_serializer=None, response_deserializer=None,
+        )
+        req = json.dumps({
+            "key": _key_to_obj(key),
+            "table_ids": self._shipped_ids.pop(key, []),
+            "compression": self.compression,
+            "chunk_rows": int(chunk_rows),
+        }).encode()
+        stream = rpc(req)
+        try:
+            for piece in stream:
+                tag, body = piece[:1], piece[1:]
+                if tag == b"E":
+                    raise WorkerError.from_dict(json.loads(body.decode()))
+                if tag == b"H":
+                    self._progress_cache[key] = json.loads(
+                        body.decode()
+                    ).get("progress")
+                    continue
+                _, blobs = transport.unpack_frame(body)
+                yield decode_table(blobs["table"]), len(body)
+                if cancel is not None and cancel.is_set():
+                    return
+        finally:
+            stream.cancel()
 
     def get_info(self) -> dict:
         return self._call("GetInfo", {})

@@ -1,0 +1,134 @@
+"""Streaming data plane: budgeted, cancellable chunk streams between
+workers and the coordinator.
+
+The reference's WorkerConnectionPool multiplexes a partition range per
+stream, demuxes into per-partition channels, and backpressures on a 64 MiB
+byte budget (`/root/reference/src/worker/worker_connection_pool.rs:243-308`);
+tasks execute their partitions concurrently
+(`/root/reference/src/worker/impl_execute_task.rs:80-114`). The TPU host
+tier's analogue: a task's (device-resident) output is sliced into row
+chunks; one puller thread per task feeds a shared bounded buffer whose
+in-flight bytes never exceed the budget; the consumer drains chunks and can
+cancel the remaining production early (a satisfied LIMIT stops the wire).
+
+In-mesh exchanges never touch this: they are single-program collectives.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Iterator, Optional
+
+from datafusion_distributed_tpu.ops.table import Table
+
+
+class StreamBudget:
+    """Bounds the BYTES of chunks produced but not yet consumed (the
+    connection-buffer budget role). Producers block in acquire() until the
+    consumer releases; a chunk larger than the whole budget is admitted
+    alone (large-but-valid rows must stream through, never deadlock)."""
+
+    def __init__(self, budget_bytes: int):
+        self.budget = max(int(budget_bytes), 1)
+        self._in_flight = 0
+        self.peak_in_flight = 0
+        self._cv = threading.Condition()
+
+    def acquire(self, nbytes: int, cancel: threading.Event) -> bool:
+        with self._cv:
+            while (
+                self._in_flight > 0
+                and self._in_flight + nbytes > self.budget
+            ):
+                if cancel.is_set():
+                    return False
+                self._cv.wait(timeout=0.05)
+            if cancel.is_set():
+                return False
+            self._in_flight += nbytes
+            self.peak_in_flight = max(self.peak_in_flight, self._in_flight)
+            return True
+
+    def release(self, nbytes: int) -> None:
+        with self._cv:
+            self._in_flight -= nbytes
+            self._cv.notify_all()
+
+
+@dataclass
+class StreamStats:
+    """Per-stage streaming telemetry (surfaced via Coordinator.metrics)."""
+
+    bytes_streamed: int = 0
+    chunks: int = 0
+    peak_in_flight: int = 0
+    early_exit: bool = False
+    rows: int = 0
+    extra: dict = field(default_factory=dict)
+
+
+def stream_stage_chunks(
+    pullers: list[Callable[[threading.Event], Iterator[tuple[Table, int]]]],
+    budget_bytes: int,
+    row_target: Optional[int] = None,
+) -> tuple[list[list[Table]], StreamStats]:
+    """Run one chunk stream per producer task concurrently under a shared
+    byte budget; -> (per-task chunk lists, stats).
+
+    ``row_target``: stop pulling once this many TOTAL rows arrived (the
+    downstream LIMIT's fetch+skip) — remaining production is cancelled and
+    its bytes never cross the wire.
+    """
+    import queue as _q
+
+    budget = StreamBudget(budget_bytes)
+    cancel = threading.Event()
+    out_q: _q.Queue = _q.Queue()
+    chunks: list[list[Table]] = [[] for _ in pullers]
+    stats = StreamStats()
+
+    def run(i: int, pull) -> None:
+        try:
+            for chunk, nbytes in pull(cancel):
+                if not budget.acquire(nbytes, cancel):
+                    break
+                out_q.put(("chunk", i, chunk, nbytes))
+        except BaseException as e:  # propagate to the consumer
+            out_q.put(("error", i, e, 0))
+        finally:
+            out_q.put(("done", i, None, 0))
+
+    threads = [
+        threading.Thread(target=run, args=(i, p), daemon=True)
+        for i, p in enumerate(pullers)
+    ]
+    for t in threads:
+        t.start()
+    live = len(pullers)
+    error: Optional[BaseException] = None
+    while live:
+        kind, i, payload, nbytes = out_q.get()
+        if kind == "done":
+            live -= 1
+            continue
+        if kind == "error":
+            error = error or payload
+            cancel.set()
+            continue
+        budget.release(nbytes)
+        if cancel.is_set():
+            continue  # late chunk after cancellation: drop
+        chunks[i].append(payload)
+        stats.chunks += 1
+        stats.bytes_streamed += nbytes
+        stats.rows += int(payload.num_rows)
+        if row_target is not None and stats.rows >= row_target:
+            stats.early_exit = True
+            cancel.set()
+    for t in threads:
+        t.join(timeout=5.0)
+    if error is not None:
+        raise error
+    stats.peak_in_flight = budget.peak_in_flight
+    return chunks, stats

@@ -193,6 +193,26 @@ class Worker:
         data.metrics["elapsed_s"] = data.finished_at - data.executed_at
         return out
 
+    def execute_task_stream(self, key: TaskKey, chunk_rows: int = 65536,
+                            cancel=None):
+        """Streaming data plane: execute once, then yield the output as
+        (chunk Table, est_bytes) row-slices. A set ``cancel`` event stops
+        slicing — un-yielded rows never cross the wire (the reference's
+        dropped-stream early exit, `impl_execute_task.rs:97-112`)."""
+        from datafusion_distributed_tpu.planner.statistics import row_width
+
+        out = self.execute_task(key)
+        n = int(out.num_rows)
+        width = row_width(out.schema())
+        if n == 0:
+            yield out.slice_rows(0, 0), 0
+            return
+        for lo in range(0, n, max(chunk_rows, 1)):
+            if cancel is not None and cancel.is_set():
+                return
+            count = min(chunk_rows, n - lo)
+            yield out.slice_rows(lo, count), count * width
+
     # -- observability ------------------------------------------------------
     def get_info(self) -> dict:
         return {"url": self.url, "version": self.version,

@@ -221,8 +221,17 @@ class DataFrame:
             config = DistributedConfig(**opts)
         cfg = config
         pcfg = planner_config or self.ctx.config.planner
-        key = ("dist", cfg.num_tasks, cfg.shuffle_skew_factor,
-               cfg.broadcast_threshold_rows, pcfg.join_expansion_factor,
+        # EVERY plan-shaping config field keys the cache (a hand-picked
+        # subset silently served stale plans when e.g. max_tasks_per_stage
+        # changed via SET); the unhashable estimator keys by identity
+        cfg_key = tuple(
+            id(v) if k == "task_estimator" else v
+            for k, v in (
+                (k, getattr(cfg, k))
+                for k in type(cfg).__dataclass_fields__
+            )
+        )
+        key = ("dist", cfg_key, pcfg.join_expansion_factor,
                pcfg.agg_slot_factor, mesh is not None, eager_subqueries,
                coordinator is not None)
         plan = self._plan_cache.get(key)
@@ -273,7 +282,12 @@ class DataFrame:
             mesh = make_mesh(num_tasks or len(_jax.devices()))
         t = mesh.shape["tasks"]
         pcfg = self.ctx.config.planner
-        dcfg = self._seeded_distributed_config(t)
+        # uniform_stage_tasks: one SPMD program's exchanges are axis-wide
+        # collectives, so every stage runs at the physical mesh width —
+        # per-stage lattice knobs apply to the host/coordinator tier
+        dcfg = replace(
+            self._seeded_distributed_config(t), uniform_stage_tasks=True
+        )
         last_err: Optional[Exception] = None
         for _attempt in range(self.ctx.config.overflow_retries + 1):
             try:

@@ -72,7 +72,13 @@ class PhysicalPlanner:
         if isinstance(node, lg.LFilter):
             child = self._plan(node.child, used)
             self._resolve_subqueries(node.predicate)
-            return FilterExec(node.predicate, child)
+            f = FilterExec(node.predicate, child)
+            # NDV-backed selectivity (replaces the cost model's blanket 1/3
+            # for equality/IN predicates; consumed by statistics.estimate_rows)
+            f.est_selectivity = self._predicate_selectivity(
+                node.predicate, node.child
+            )
+            return f
         if isinstance(node, lg.LProject):
             child = self._plan(node.child, used)
             for e, _ in node.exprs:
@@ -208,6 +214,8 @@ class PhysicalPlanner:
             combined = HashAggregateExec(
                 "single", group_names, plain_specs, proj, base_slots
             )
+            if groups_ndv and group_names:
+                combined.est_rows = float(groups_ndv)
             for i, a in enumerate(distinct_aggs):
                 s = by_name[a.name]
                 dedup_ndv = self._exprs_ndv(
@@ -258,13 +266,53 @@ class PhysicalPlanner:
             groups_ndv = self._exprs_ndv(node.child,
                                          [e for e, _ in node.groups])
             slots2 = self._agg_slots(dedup.output_capacity(), groups_ndv)
-            return HashAggregateExec(
+            out = HashAggregateExec(
                 "single", group_names, outer_specs, dedup, slots2
             )
+            if groups_ndv:
+                out.est_rows = float(groups_ndv)
+            return out
 
         groups_ndv = self._exprs_ndv(node.child, [e for e, _ in node.groups])
         slots = self._agg_slots(proj.output_capacity(), groups_ndv)
-        return HashAggregateExec("single", group_names, specs, proj, slots)
+        out = HashAggregateExec("single", group_names, specs, proj, slots)
+        if groups_ndv:
+            # catalog NDV as the group-count estimate (replaces the cost
+            # model's sqrt(n) guess; consumed by statistics.estimate_rows)
+            out.est_rows = float(groups_ndv)
+        return out
+
+    def _predicate_selectivity(self, pred, child: lg.LogicalPlan,
+                               ) -> Optional[float]:
+        """Selectivity estimate from catalog NDV (the statistics the cost
+        model previously guessed as a blanket 1/3): equality on a base
+        column keeps ~1/NDV rows, IN keeps k/NDV, AND multiplies, OR adds.
+        None = no NDV-backed estimate (range predicates, derived exprs)."""
+        if isinstance(pred, pe.BooleanOp):
+            l = self._predicate_selectivity(pred.left, child)
+            r = self._predicate_selectivity(pred.right, child)
+            if l is None and r is None:
+                return None
+            l = 1.0 / 3.0 if l is None else l
+            r = 1.0 / 3.0 if r is None else r
+            return max(l * r, 1e-6) if pred.op == "and" else min(l + r, 1.0)
+        if isinstance(pred, pe.Not):
+            s = self._predicate_selectivity(pred.child, child)
+            return None if s is None else max(1.0 - s, 1e-6)
+        if isinstance(pred, pe.BinaryOp) and pred.op == "==":
+            col, other = pred.left, pred.right
+            if not isinstance(col, pe.Col):
+                col, other = other, col
+            if isinstance(col, pe.Col) and isinstance(other, pe.Literal):
+                ndv = self._exprs_ndv(child, [col])
+                if ndv:
+                    return 1.0 / ndv
+        if isinstance(pred, pe.InList) and isinstance(pred.child, pe.Col):
+            ndv = self._exprs_ndv(child, [pred.child])
+            if ndv:
+                s = min(len(pred.values) / ndv, 1.0)
+                return max(1.0 - s, 1e-6) if pred.negated else s
+        return None
 
     def _agg_slots(self, cap: int, ndv: Optional[int] = None) -> int:
         """Hash-table slots for a group-by: capacity-bounded, NDV-driven.
