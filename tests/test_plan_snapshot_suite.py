@@ -1,0 +1,88 @@
+"""Per-query staged-plan snapshots: ALL 22 TPC-H + 99 TPC-DS queries.
+
+The reference pins the staged plan of every TPC query
+(`tpch_plans_test.rs`, `tpcds_plans_test.rs` — insta snapshots): any
+distribution-decision change (boundary placement, task counts, broadcast
+vs shuffle) becomes a reviewable diff instead of an invisible regression.
+Snapshots live in tests/snapshots/{tpch,tpcds}/qN.txt with volatile
+capacities normalized (the insta filter analogue); regenerate with
+DFTPU_SNAPSHOT_UPDATE=1.
+"""
+
+import itertools
+import os
+import re
+
+import pytest
+
+from datafusion_distributed_tpu.data.tpchgen import register_tpch
+from datafusion_distributed_tpu.sql import planner as planner_mod
+from datafusion_distributed_tpu.sql.context import SessionContext
+
+SNAPDIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "snapshots")
+UPDATE = os.environ.get("DFTPU_SNAPSHOT_UPDATE") == "1"
+QDIR = "/root/reference/testdata"
+
+
+def normalize(tree: str) -> str:
+    """Strip volatile sizing; KEEP task counts and boundary structure (the
+    distribution decisions being pinned)."""
+    tree = re.sub(r"cap=\d+", "cap=N", tree)
+    tree = re.sub(r"slots=\d+", "slots=N", tree)
+    tree = re.sub(r"per_dest_cap=\d+", "per_dest_cap=N", tree)
+    tree = re.sub(r"out_cap=\d+", "out_cap=N", tree)
+    tree = re.sub(r"files=\d+", "files=N", tree)
+    return tree
+
+
+@pytest.fixture(scope="module")
+def tpch_ctx():
+    c = SessionContext()
+    register_tpch(c, sf=0.001, seed=0)
+    return c
+
+
+@pytest.fixture(scope="module")
+def tpcds_ctx():
+    from datafusion_distributed_tpu.data.tpcdsgen import register_tpcds
+
+    c = SessionContext()
+    register_tpcds(c, sf=0.001, seed=0)
+    return c
+
+
+def _check_snapshot(suite: str, ctx: SessionContext, q: str) -> None:
+    sql_path = os.path.join(QDIR, suite, "queries", f"{q}.sql")
+    if not os.path.exists(sql_path):
+        pytest.skip(f"no {suite}/{q}.sql in reference testdata")
+    # deterministic temp-column numbering regardless of which queries were
+    # planned before this one in the process
+    planner_mod._TMP = itertools.count()
+    df = ctx.sql(open(sql_path).read())
+    tree = normalize(df.explain_distributed(4))
+    snap = os.path.join(SNAPDIR, suite, f"{q}.txt")
+    if UPDATE or not os.path.exists(snap):
+        os.makedirs(os.path.dirname(snap), exist_ok=True)
+        with open(snap, "w") as f:
+            f.write(tree)
+        if not UPDATE:
+            pytest.fail(
+                f"snapshot {snap} was missing; wrote it — commit the file"
+            )
+        return
+    expected = open(snap).read()
+    assert tree == expected, (
+        f"staged plan changed for {suite}/{q} — review the diff; if "
+        "intended, regenerate with DFTPU_SNAPSHOT_UPDATE=1"
+    )
+
+
+@pytest.mark.parametrize("q", [f"q{i}" for i in range(1, 23)])
+def test_tpch_plan_snapshot(tpch_ctx, q):
+    _check_snapshot("tpch", tpch_ctx, q)
+
+
+@pytest.mark.parametrize("q", [f"q{i}" for i in range(1, 100)])
+def test_tpcds_plan_snapshot(tpcds_ctx, q):
+    _check_snapshot("tpcds", tpcds_ctx, q)
