@@ -449,6 +449,9 @@ def _inject(plan: ExecutionPlan, cfg: DistributedConfig):
         if rdist == Distribution.PARTITIONED:
             right, _tb = _seal_stage(right, rann, cfg)
             right = BroadcastExchangeExec(right, t)
+            # the build stage was sealed into _tb slices; without the stamp
+            # the coordinator would dispatch cfg.num_tasks producer tasks
+            right.producer_tasks = _tb
         return plan.with_new_children([left, right]), ldist, lann
 
     from datafusion_distributed_tpu.plan.window_exec import WindowExec
@@ -693,6 +696,7 @@ def _inject_join(plan: HashJoinExec, cfg: DistributedConfig):
     if must_broadcast or small_build:
         build, _tb = _seal_stage(build, bann, cfg)
         b = BroadcastExchangeExec(build, t)
+        b.producer_tasks = _tb
         out = plan.with_new_children([probe, b])
         return out, pdist, pann
 

@@ -287,6 +287,7 @@ class Coordinator:
         chunks, stats = stream_stage_chunks(
             [make_puller(i) for i in range(t_prod)], budget,
             row_target=fetch,
+            max_concurrent=max(len(self.resolver.get_urls()), 1),
         )
         self.stream_metrics[(query_id, stage_id)] = {
             "bytes_streamed": stats.bytes_streamed,
@@ -298,7 +299,7 @@ class Coordinator:
         flat = [c for per in chunks for c in per]
         if not flat:
             schema = producer.schema()
-            return Table.empty(schema, 8, None)
+            return Table.empty(schema, 8, _leaf_dictionaries(producer, schema))
         # capacity: exactly the streamed rows, 8-row aligned (chunk padding
         # and a pow2 round here would transiently double big gathers)
         cap = max(-(-stats.rows // 8) * 8, 8)
@@ -593,6 +594,32 @@ def _shuffle_regroup(
     for j in range(num_tasks):
         slices.append(concat_tables(buckets[j], capacity=cap))
     return slices
+
+
+def _leaf_dictionaries(plan: ExecutionPlan, schema) -> Optional[dict]:
+    """Best-effort dictionaries for an empty result table: string columns in
+    `schema` keep the codes minted at the leaves, so a zero-row fallback must
+    carry the same dictionaries a real (bulk) result would — dictionary-
+    dependent consumers (literal code lookups) break on a bare None."""
+    from datafusion_distributed_tpu.plan.physical import ParquetScanExec
+
+    out: dict = {}
+    names = {f.name for f in schema.fields}
+    for leaf in plan.collect(lambda n: not n.children()):
+        dicts: dict = {}
+        if isinstance(leaf, ParquetScanExec) and leaf.dictionaries:
+            dicts = leaf.dictionaries
+        elif isinstance(leaf, MemoryScanExec) and leaf.tasks:
+            ref = leaf.tasks[0]
+            dicts = {
+                n: c.dictionary
+                for n, c in zip(ref.names, ref.columns)
+                if c.dictionary is not None
+            }
+        for name, d in dicts.items():
+            if name in names and d is not None:
+                out.setdefault(name, d)
+    return out or None
 
 
 def _mod_slices(table: Table, num_tasks: int) -> list[Table]:

@@ -72,6 +72,7 @@ def stream_stage_chunks(
     pullers: list[Callable[[threading.Event], Iterator[tuple[Table, int]]]],
     budget_bytes: int,
     row_target: Optional[int] = None,
+    max_concurrent: Optional[int] = None,
 ) -> tuple[list[list[Table]], StreamStats]:
     """Run one chunk stream per producer task concurrently under a shared
     byte budget; -> (per-task chunk lists, stats).
@@ -79,6 +80,12 @@ def stream_stage_chunks(
     ``row_target``: stop pulling once this many TOTAL rows arrived (the
     downstream LIMIT's fetch+skip) — remaining production is cancelled and
     its bytes never cross the wire.
+
+    ``max_concurrent``: at most this many pullers EXECUTE at once (the
+    cluster's worker count — a single in-process worker must not run every
+    producer task simultaneously; matches `_run_stage_tasks`' thread-pool
+    policy). Each puller materializes its task's output on dispatch, so
+    this bounds peak device-side concurrency, not just host chunks.
     """
     import queue as _q
 
@@ -87,9 +94,20 @@ def stream_stage_chunks(
     out_q: _q.Queue = _q.Queue()
     chunks: list[list[Table]] = [[] for _ in pullers]
     stats = StreamStats()
+    gate = (
+        threading.Semaphore(max_concurrent)
+        if max_concurrent is not None and max_concurrent < len(pullers)
+        else None
+    )
 
     def run(i: int, pull) -> None:
+        held = False
         try:
+            if gate is not None:
+                gate.acquire()
+                held = True
+            if cancel.is_set():  # satisfied LIMIT: never dispatch the task
+                return
             for chunk, nbytes in pull(cancel):
                 if not budget.acquire(nbytes, cancel):
                     break
@@ -97,6 +115,8 @@ def stream_stage_chunks(
         except BaseException as e:  # propagate to the consumer
             out_q.put(("error", i, e, 0))
         finally:
+            if held:
+                gate.release()
             out_q.put(("done", i, None, 0))
 
     threads = [

@@ -27,6 +27,10 @@ from datafusion_distributed_tpu.plan import expressions as pe
 from datafusion_distributed_tpu.schema import DataType, Field, Schema
 from datafusion_distributed_tpu.sql import parser as ast
 
+# mark-join column namer: process-wide so two filters in one query can't
+# collide, resettable (like planner._TMP) so plan snapshots are reproducible
+_MARK_SEQ = itertools.count()
+
 
 # ---------------------------------------------------------------------------
 # Logical nodes
@@ -887,7 +891,6 @@ class Binder:
         (the reference gets this from DataFusion's subquery decorrelation,
         which lowers to the same mark-join shape)."""
         plan_box = [plan]
-        counter = [0]
 
         def walk(node):
             if isinstance(node, ast.Binary) and node.op in ("and", "or"):
@@ -905,8 +908,9 @@ class Binder:
             return self._bind_expr(node, scope, outer_refs)
 
         def _mark_name():
-            counter[0] += 1
-            return f"__mark_{id(c) % 100000}_{counter[0]}"
+            # process-wide monotonic counter: unique across every mark join
+            # in the query AND deterministic (resettable) for plan snapshots
+            return f"__mark_{next(_MARK_SEQ)}"
 
         self.__mark_name = _mark_name  # shared with helpers below
         pred = walk(c)

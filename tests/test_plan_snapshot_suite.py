@@ -16,6 +16,7 @@ import re
 import pytest
 
 from datafusion_distributed_tpu.data.tpchgen import register_tpch
+from datafusion_distributed_tpu.sql import logical as logical_mod
 from datafusion_distributed_tpu.sql import planner as planner_mod
 from datafusion_distributed_tpu.sql.context import SessionContext
 
@@ -56,9 +57,10 @@ def _check_snapshot(suite: str, ctx: SessionContext, q: str) -> None:
     sql_path = os.path.join(QDIR, suite, "queries", f"{q}.sql")
     if not os.path.exists(sql_path):
         pytest.skip(f"no {suite}/{q}.sql in reference testdata")
-    # deterministic temp-column numbering regardless of which queries were
-    # planned before this one in the process
+    # deterministic temp/mark column numbering regardless of which queries
+    # were planned before this one in the process
     planner_mod._TMP = itertools.count()
+    logical_mod._MARK_SEQ = itertools.count()
     df = ctx.sql(open(sql_path).read())
     tree = normalize(df.explain_distributed(4))
     snap = os.path.join(SNAPDIR, suite, f"{q}.txt")
