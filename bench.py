@@ -1,26 +1,39 @@
 #!/usr/bin/env python
 """Benchmark entry point (driver-run on real TPU hardware).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints a JSON metric line {"metric", "value", "unit", "vs_baseline"};
+the LAST such line on stdout is authoritative (it is re-printed after
+every completed query so a late wedge still reports all finished work).
 
-Architecture: a PARENT process that never touches JAX orchestrates a
-disposable CHILD process that does device init + query execution. The
-axon TPU tunnel can block indefinitely inside PJRT client init (observed
-rounds 1-2, and the tunnel is single-client: a killed init wedges the
-lease for minutes). A hung child is killed (SIGINT first so PJRT can
-release the claim, then SIGKILL) and retried with backoff; per-query
-results stream from child to parent through a JSONL event file, so a
-late wedge still reports every completed query.
+Architecture, shaped by three rounds of fighting the axon TPU tunnel:
 
-Per-query detail (stderr + BENCH_DETAIL.json): wall seconds, input bytes
-touched, achieved GB/s, and % of the chip's HBM roofline — so "fast" is
-judgeable against hardware limits, not just the reference's wall-clock.
+- The tunnel is SINGLE-CLIENT and fails init two ways: a ~25-min
+  in-plugin claim timeout that ends in an ordinary UNAVAILABLE
+  exception, or an indefinite hang when a previous client was killed
+  mid-init (the kill wedges the server-side claim for ~30 min).
+  Therefore the parent NEVER kills a child: a pending init either
+  resolves, raises (child retries), or the child's own deadline
+  watchdog ends it after the parent has already reported.
+- A PARENT process that never touches JAX orchestrates children and
+  aggregates their progressively-written JSONL events.
+- At startup the parent terminates leftover tunnel holders from the
+  build session (.tpu_probe / orphaned bench children) — round 3's
+  zero-result run traces to exactly such a leftover starving init.
+- If the TPU child hasn't initialized by (deadline - BENCH_CPU_S), a
+  CPU-fallback child (JAX_PLATFORMS=cpu; never touches the tunnel)
+  runs the same queries so the round still records a real wall-clock,
+  clearly labeled `_cpu_fallback`. The report is per-PLATFORM: any TPU
+  results win (the metric's Nq count discloses partial coverage);
+  fallback numbers are reported only when no TPU query completed, and
+  always ride along in BENCH_DETAIL.json.
 
-Metric: TPC-H total wall-clock (sum of per-query best-of-2 latencies) at
-the given scale factor. Baseline (BASELINE.md): the reference engine's
-TPC-H SF10 total on a 12-node CPU cluster is 10 s. vs_baseline scales
-the nearest published reference point to this SF per-query (see
-_BASELINES).
+Per-query detail (stderr + BENCH_DETAIL.json): wall seconds, input
+bytes touched, achieved GB/s, and % of the chip's HBM roofline.
+
+Metric: suite total wall-clock (sum of per-query best-of-2 latencies).
+Baseline (BASELINE.md): reference TPC-H SF10 total on a 12-node CPU
+cluster is 10 s; vs_baseline scales the nearest published reference
+point to this SF per-query (see _BASELINES).
 
 Env knobs:
   BENCH_SUITE    tpch (default) | tpcds | clickbench
@@ -28,7 +41,9 @@ Env knobs:
   BENCH_QUERIES  comma list (default: the suite's full set, first-light
                  queries ordered first)
   BENCH_TASKS    mesh size for distributed mode (default 1 = single chip)
-  BENCH_BUDGET_S wall-clock budget in seconds (default 420)
+  BENCH_BUDGET_S wall-clock budget in seconds (default 1740)
+  BENCH_CPU_S    budget reserved for the CPU fallback (default 420;
+                 0 disables the fallback)
   BENCH_HBM_GBPS override the HBM roofline (GB/s) if device_kind unknown
 """
 
@@ -84,22 +99,6 @@ def _vs_baseline(suite: str, sf: float, per_query: dict, total: float) -> float:
     return (per_q * len(per_query) * (sf / base_sf)) / total
 
 
-def _report(suite: str, sf: float, per_query: dict, total: float,
-            suffix: str = "") -> None:
-    print(
-        json.dumps(
-            {
-                "metric": f"{suite}_sf{sf}_total_wall_clock_"
-                          f"{len(per_query)}q{suffix}",
-                "value": round(total, 4) if per_query else -1,
-                "unit": "seconds",
-                "vs_baseline": round(_vs_baseline(suite, sf, per_query, total), 4),
-            }
-        ),
-        flush=True,
-    )
-
-
 # --------------------------------------------------------------------------
 # Child: owns JAX. Streams events (one JSON object per line) to _EVENTS.
 # --------------------------------------------------------------------------
@@ -116,30 +115,66 @@ def _child_main() -> None:
     sf = float(os.environ.get("BENCH_SF", "0.05"))
     tasks = int(os.environ.get("BENCH_TASKS", "1"))
     deadline = float(os.environ["BENCH_DEADLINE_TS"])
+    platform = os.environ.get("BENCH_PLATFORM", "axon")
     qdir, default_queries, _first = _SUITES[suite]
     queries = os.environ.get("BENCH_QUERIES", "")
     qlist = ([q.strip() for q in queries.split(",") if q.strip()]
              if queries else default_queries)
 
     fh = open(_EVENTS, "a")
-    # a predecessor child may have been SIGKILLed mid-write, leaving a torn
-    # line; a leading newline isolates it (blank lines are skipped on read)
+    # a predecessor child may have died mid-write, leaving a torn line; a
+    # leading newline isolates it (blank lines are skipped on read)
     fh.write("\n")
     os.environ.setdefault("DFTPU_COMPILE_CACHE", "/root/repo/.xla_cache")
 
+    # last line of defense: results are already flushed to the events
+    # file, so a child hung inside a single jax call past the deadline
+    # self-destructs AFTER the parent has reported (deadline + 60)
+    import threading
+
+    def _self_destruct():
+        _emit(fh, event="self_destruct", platform=platform)
+        os._exit(5)
+
+    t_left = max(deadline + 60 - time.time(), 1.0)
+    wd = threading.Timer(t_left, _self_destruct)
+    wd.daemon = True
+    wd.start()
+
     import jax  # noqa: E402
 
-    # the axon plugin force-selects jax_platforms="axon,cpu" at registration
-    # time, overriding the env var; pin it back when a platform is requested
-    # (BENCH_PLATFORM=cpu for harness self-tests)
-    if os.environ.get("BENCH_PLATFORM"):
-        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    # the axon plugin force-selects jax_platforms="axon,cpu" at
+    # registration time, overriding the env var; pin it back when a
+    # specific platform is requested (the CPU-fallback child must never
+    # touch the single-client tunnel)
+    if platform != "axon":
+        jax.config.update("jax_platforms", platform)
 
-    t0 = time.perf_counter()
-    devs = jax.devices()
+    # Init, with retry-on-exception: the tunnel's observed failure mode
+    # is an UNAVAILABLE raised after the plugin's ~25-min internal claim
+    # timeout. Each failed attempt is logged; retry while budget remains.
+    devs = None
+    attempt = 0
+    while devs is None:
+        t0 = time.perf_counter()
+        try:
+            devs = jax.devices()
+        except Exception as e:
+            _emit(fh, event="init_failed", attempt=attempt,
+                  secs=round(time.perf_counter() - t0, 1),
+                  platform=platform, error=f"{type(e).__name__}: {e}"[:200])
+            attempt += 1
+            if time.time() + 90 > deadline:
+                _emit(fh, event="init_gave_up", platform=platform)
+                sys.exit(4)
+            try:  # jax caches the failed backend; clear to allow retry
+                jax._src.xla_bridge._clear_backends()
+            except Exception:
+                pass
+            time.sleep(30)
     kind = getattr(devs[0], "device_kind", str(devs[0]))
     _emit(fh, event="init", init_s=round(time.perf_counter() - t0, 2),
-          devices=len(devs), device_kind=str(kind))
+          devices=len(devs), device_kind=str(kind), platform=platform)
 
     hbm_gbps = None
     if os.environ.get("BENCH_HBM_GBPS"):
@@ -202,16 +237,17 @@ def _child_main() -> None:
             if c.data.size:
                 reg_sync += float(c.data.ravel()[0])
     _emit(fh, event="registered", secs=round(time.perf_counter() - t0, 2),
-          tables=len(ctx.catalog.tables))
+          tables=len(ctx.catalog.tables), platform=platform)
 
     for q in qlist:
         now = time.time()
         if now > deadline - 10:
-            _emit(fh, event="budget_stop", remaining=q)
+            _emit(fh, event="budget_stop", remaining=q, platform=platform)
             break
         path = os.path.join(qdir, f"{q}.sql")
         if not os.path.exists(path):
-            _emit(fh, event="query_skipped", q=q, reason="no such file")
+            _emit(fh, event="query_skipped", q=q, reason="no such file",
+                  platform=platform)
             continue
         sql = open(path).read()
         try:
@@ -244,24 +280,22 @@ def _child_main() -> None:
             ev = {
                 "event": "query", "q": q, "secs": round(best, 4),
                 "runs": runs, "bytes_in": bytes_in,
-                "gbps": round(gbps, 2),
+                "gbps": round(gbps, 2), "platform": platform,
             }
             if hbm_gbps:
                 ev["pct_hbm_roofline"] = round(100.0 * gbps / hbm_gbps, 2)
             _emit(fh, **ev)
         except Exception as e:  # a failing query must not eat the report
-            _emit(fh, event="query_failed", q=q,
+            _emit(fh, event="query_failed", q=q, platform=platform,
                   error=f"{type(e).__name__}: {e}"[:300])
-    _emit(fh, event="done", hbm_gbps=hbm_gbps)
+    _emit(fh, event="done", hbm_gbps=hbm_gbps, platform=platform)
 
 
 # --------------------------------------------------------------------------
 # Parent: no JAX. Spawns/monitors children, aggregates, reports.
+# Never kills a child (a kill mid-init wedges the single-client tunnel);
+# children own their lifecycle via deadline watchdogs.
 # --------------------------------------------------------------------------
-
-_INIT_STALL_S = 210.0   # no init event -> child is wedged in PJRT init
-_QUERY_STALL_S = 300.0  # no progress mid-run (compiles can take ~40s)
-_BACKOFFS = [45.0, 90.0]  # tunnel lease needs time to expire after a kill
 
 
 def _read_events(path: str, offset: int):
@@ -287,20 +321,55 @@ def _read_events(path: str, offset: int):
     return events, offset + consumed
 
 
-def _kill_child(proc: subprocess.Popen) -> None:
-    """SIGINT first: a KeyboardInterrupt lets the PJRT client release the
-    single-client tunnel claim; SIGKILL mid-init wedges it for minutes."""
-    if proc.poll() is not None:
-        return
-    try:
-        proc.send_signal(signal.SIGINT)
-        proc.wait(timeout=15)
-    except (subprocess.TimeoutExpired, ProcessLookupError):
+def _terminate_stale_tunnel_holders() -> None:
+    """Kill leftover processes from the BUILD session that may hold the
+    single-client tunnel (probe scripts, orphaned bench children).
+
+    Round 3 post-mortem: a `.tpu_probe.py` left running by the build
+    session was still retrying init hours later when the driver's bench
+    ran — the bench never got the tunnel. These processes are long past
+    init (or failing it), so terminating them releases, not wedges."""
+    me = os.getpid()
+    for pid_s in os.listdir("/proc"):
+        if not pid_s.isdigit() or int(pid_s) == me:
+            continue
         try:
-            proc.kill()
-            proc.wait(timeout=10)
-        except Exception:
-            pass
+            with open(f"/proc/{pid_s}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode(errors="replace")
+            if ".tpu_probe" in cmd:
+                os.kill(int(pid_s), signal.SIGTERM)
+                print(f"bench: terminated stale probe pid {pid_s}",
+                      file=sys.stderr, flush=True)
+                continue
+            if "python" in cmd and "bench.py" in cmd:
+                with open(f"/proc/{pid_s}/environ", "rb") as f:
+                    env = f.read().replace(b"\0", b" ").decode(errors="replace")
+                if "BENCH_CHILD=1" in env:
+                    os.kill(int(pid_s), signal.SIGTERM)
+                    print(f"bench: terminated orphan bench child {pid_s}",
+                          file=sys.stderr, flush=True)
+        except (OSError, ValueError):
+            continue
+
+
+def _spawn_child(remaining_queries, deadline, platform):
+    env = dict(os.environ)
+    env["BENCH_CHILD"] = "1"
+    env["BENCH_QUERIES"] = ",".join(remaining_queries)
+    env["BENCH_DEADLINE_TS"] = str(deadline)
+    env["BENCH_PLATFORM"] = platform
+    if platform == "axon":
+        env.setdefault("JAX_PLATFORMS", "axon")
+    else:
+        env["JAX_PLATFORMS"] = platform
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env, stdout=sys.stderr, stderr=sys.stderr,
+        start_new_session=True,
+    )
+    print(f"bench child [{platform}]: pid {proc.pid}, "
+          f"{len(remaining_queries)} queries", file=sys.stderr, flush=True)
+    return proc
 
 
 def main() -> None:
@@ -316,9 +385,11 @@ def main() -> None:
         }), flush=True)
         sys.exit(2)
     sf = float(os.environ.get("BENCH_SF", "0.05"))
-    budget = float(os.environ.get("BENCH_BUDGET_S", "420"))
+    budget = float(os.environ.get("BENCH_BUDGET_S", "1740"))
+    cpu_reserve = float(os.environ.get("BENCH_CPU_S", "420"))
     started = time.time()
     deadline = started + budget
+    cpu_start_at = deadline - cpu_reserve if cpu_reserve > 0 else None
 
     _qdir, default_queries, first_light = _SUITES[suite]
     if os.environ.get("BENCH_QUERIES"):
@@ -329,134 +400,155 @@ def main() -> None:
         qlist = first_light + [q for q in default_queries
                                if q not in first_light]
 
-    # the parent's own last line of defense: always print the one JSON line
-    state = {"per_query": {}, "failed": {}, "meta": {}}
+    # "tpu" slot = the requested primary platform (axon for driver runs,
+    # cpu for BENCH_PLATFORM=cpu self-tests — those are NOT fallbacks and
+    # keep the unsuffixed metric name); "cpu" slot = the fallback child
+    state = {"tpu": {}, "cpu": {}, "failed": {}, "meta": {}}
 
-    def final_report(suffix=""):
-        total = sum(state["per_query"].values())
-        _report(suite, sf, state["per_query"], total, suffix=suffix)
-        detail = {
-            "suite": suite, "sf": sf, "per_query_s": state["per_query"],
-            "failed": state["failed"], "meta": state["meta"],
-            "total_s": round(total, 4),
-        }
+    def current_report():
+        if state["tpu"]:
+            per_query, suffix = state["tpu"], ""
+        else:
+            per_query, suffix = state["cpu"], "_cpu_fallback"
+        total = sum(per_query.values())
+        return per_query, suffix, total
+
+    def print_metric():
+        per_query, suffix, total = current_report()
+        print(json.dumps({
+            "metric": f"{suite}_sf{sf}_total_wall_clock_"
+                      f"{len(per_query)}q{suffix}",
+            "value": round(total, 4) if per_query else -1,
+            "unit": "seconds",
+            "vs_baseline": round(
+                _vs_baseline(suite, sf, per_query, total), 4),
+        }), flush=True)
+
+    def write_detail():
+        per_query, suffix, total = current_report()
         try:
             with open(_DETAIL, "w") as f:
-                json.dump(detail, f, indent=1)
+                json.dump({
+                    "suite": suite, "sf": sf,
+                    "platform": ("cpu_fallback" if suffix
+                                 else ("tpu" if primary == "axon"
+                                       else primary)),
+                    "per_query_s": per_query,
+                    "cpu_per_query_s": state["cpu"],
+                    "failed": state["failed"], "meta": state["meta"],
+                    "total_s": round(total, 4),
+                }, f, indent=1)
         except OSError:
             pass
-        print(json.dumps(detail), file=sys.stderr, flush=True)
 
     import threading
 
     def watchdog():
-        final_report(suffix="_watchdog")
+        # the parent's own last line of defense (should never fire: the
+        # main loop exits at deadline): report, then leave — children
+        # are NOT killed; their own watchdogs end them
+        write_detail()
+        print_metric()
         os._exit(3)
 
     wd = threading.Timer(budget + 90.0, watchdog)
     wd.daemon = True
     wd.start()
 
+    _terminate_stale_tunnel_holders()
+
     try:
         os.unlink(_EVENTS)
     except FileNotFoundError:
         pass
 
-    attempt = 0
     offset = 0
-    while time.time() < deadline - 30:
-        remaining = [q for q in qlist
-                     if q not in state["per_query"]
-                     and q not in state["failed"]]
-        if not remaining:
-            break
-        env = dict(os.environ)
-        env["BENCH_CHILD"] = "1"
-        env["BENCH_QUERIES"] = ",".join(remaining)
-        env["BENCH_DEADLINE_TS"] = str(deadline)
-        env.setdefault("JAX_PLATFORMS", "axon")
-        proc = subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__)],
-            env=env, stdout=sys.stderr, stderr=sys.stderr,
-            start_new_session=True,
-        )
-        print(f"bench child attempt {attempt}: pid {proc.pid}, "
-              f"{len(remaining)} queries", file=sys.stderr, flush=True)
-        saw_init = False
-        child_done = False
-        last_progress = time.time()
-        while True:
-            events, offset = _read_events(_EVENTS, offset)
-            for ev in events:
-                last_progress = time.time()
-                kind = ev.get("event")
-                if kind == "init":
-                    saw_init = True
-                    state["meta"].update(
-                        {k: ev[k] for k in
-                         ("init_s", "devices", "device_kind") if k in ev})
-                elif kind == "registered":
-                    state["meta"]["register_s"] = ev.get("secs")
-                elif kind == "query":
-                    state["per_query"][ev["q"]] = ev["secs"]
-                    state["meta"].setdefault("queries", {})[ev["q"]] = {
-                        k: ev[k] for k in
-                        ("runs", "bytes_in", "gbps", "pct_hbm_roofline")
-                        if k in ev}
-                    print(f"  {ev['q']}: {ev['secs']}s "
-                          f"({ev.get('gbps', '?')} GB/s, "
-                          f"{ev.get('pct_hbm_roofline', '?')}% roofline)",
-                          file=sys.stderr, flush=True)
-                elif kind == "query_failed":
-                    state["failed"][ev["q"]] = ev.get("error", "")
-                elif kind == "done":
-                    state["meta"]["hbm_gbps"] = ev.get("hbm_gbps")
-                    child_done = True
-            if child_done:
-                # all results are in hand; don't let a wedged PJRT teardown
-                # burn the remaining budget waiting for a clean exit
-                try:
-                    proc.wait(timeout=10)
-                except subprocess.TimeoutExpired:
-                    _kill_child(proc)
-                break
-            if proc.poll() is not None:
-                # child died without a done event (crash / OOM): drain any
-                # events written after the last poll before moving on
-                events, offset = _read_events(_EVENTS, offset)
-                for ev in events:
-                    if ev.get("event") == "query":
-                        state["per_query"][ev["q"]] = ev["secs"]
-                    elif ev.get("event") == "query_failed":
-                        state["failed"][ev["q"]] = ev.get("error", "")
-                    elif ev.get("event") == "done":
-                        child_done = True
-                print(f"bench child exited rc={proc.returncode}",
-                      file=sys.stderr, flush=True)
-                break
-            stall = _QUERY_STALL_S if saw_init else _INIT_STALL_S
-            if time.time() - last_progress > stall:
-                print(f"bench child stalled ({'run' if saw_init else 'init'}"
-                      f" {stall}s); killing", file=sys.stderr, flush=True)
-                _kill_child(proc)
-                break
-            if time.time() > deadline - 5:
-                _kill_child(proc)
-                break
-            time.sleep(2.0)
-        if child_done:
-            break
-        backoff = _BACKOFFS[min(attempt, len(_BACKOFFS) - 1)]
-        attempt += 1
-        if attempt > 3 or time.time() + backoff > deadline - 60:
-            break
-        print(f"backoff {backoff}s before retry", file=sys.stderr, flush=True)
-        time.sleep(backoff)
+    primary = os.environ.get("BENCH_PLATFORM", "axon")  # cpu for self-tests
+    tpu_child = _spawn_child(qlist, deadline, primary)
+    cpu_child = None
+    cpu_spawned = False
+    tpu_pending = True   # False once the primary child exits or is done
+    tpu_done = False     # primary child emitted its done event
 
+    while time.time() < deadline - 5:
+        events, offset = _read_events(_EVENTS, offset)
+        progressed = False
+        for ev in events:
+            kind = ev.get("event")
+            plat = "tpu" if ev.get("platform", "axon") == primary else "cpu"
+            if kind == "init":
+                state["meta"][f"{plat}_init"] = {
+                    k: ev[k] for k in
+                    ("init_s", "devices", "device_kind") if k in ev}
+            elif kind == "init_failed":
+                state["meta"].setdefault(f"{plat}_init_failures", []).append(
+                    {"secs": ev.get("secs"), "error": ev.get("error")})
+                print(f"  [{plat}] init attempt failed after "
+                      f"{ev.get('secs')}s: {ev.get('error', '')[:120]}",
+                      file=sys.stderr, flush=True)
+            elif kind == "registered":
+                state["meta"][f"{plat}_register_s"] = ev.get("secs")
+            elif kind == "query":
+                state[plat][ev["q"]] = ev["secs"]
+                state["meta"].setdefault(f"{plat}_queries", {})[ev["q"]] = {
+                    k: ev[k] for k in
+                    ("runs", "bytes_in", "gbps", "pct_hbm_roofline")
+                    if k in ev}
+                print(f"  [{plat}] {ev['q']}: {ev['secs']}s "
+                      f"({ev.get('gbps', '?')} GB/s, "
+                      f"{ev.get('pct_hbm_roofline', '?')}% roofline)",
+                      file=sys.stderr, flush=True)
+                progressed = True
+            elif kind == "query_failed":
+                state["failed"][f"{plat}:{ev['q']}"] = ev.get("error", "")
+            elif kind == "done":
+                if ev.get("hbm_gbps") is not None:
+                    state["meta"]["hbm_gbps"] = ev["hbm_gbps"]
+                if plat == "tpu":
+                    tpu_done = True
+                    tpu_pending = False
+        if progressed:
+            write_detail()
+            print_metric()
+        # a TPU child that exited (crash after init, init gave up, or
+        # normal teardown) has nothing more coming
+        if tpu_child is not None and tpu_child.poll() is not None:
+            if tpu_child.returncode not in (0, None):
+                print(f"bench tpu child exited rc={tpu_child.returncode}",
+                      file=sys.stderr, flush=True)
+            tpu_child = None
+            tpu_pending = False
+        if cpu_child is not None and cpu_child.poll() is not None:
+            cpu_child = None
+        # finish early once nothing is pending: the primary resolved and
+        # any spawned fallback exited
+        if not tpu_pending and cpu_child is None:
+            if (tpu_done or state["tpu"] or cpu_spawned
+                    or cpu_start_at is None or primary != "axon"):
+                break
+        # fallback trigger: no TPU init by the reserve point, or the TPU
+        # child conclusively failed without completing the suite
+        if (cpu_start_at is not None and not cpu_spawned
+                and primary == "axon" and not tpu_done
+                and (time.time() >= cpu_start_at or not tpu_pending)):
+            cpu_child = _spawn_child(qlist, deadline, "cpu")
+            cpu_spawned = True
+        time.sleep(2.0)
+
+    # final drain + report
+    events, offset = _read_events(_EVENTS, offset)
+    for ev in events:
+        plat = "tpu" if ev.get("platform", "axon") == "axon" else "cpu"
+        if ev.get("event") == "query":
+            state[plat][ev["q"]] = ev["secs"]
+        elif ev.get("event") == "query_failed":
+            state["failed"][f"{plat}:{ev['q']}"] = ev.get("error", "")
     wd.cancel()
-    final_report()
-    if not state["per_query"]:
-        sys.exit(4 if not state["meta"].get("init_s") else 2)
+    write_detail()
+    print_metric()
+    per_query, _suffix, _total = current_report()
+    if not per_query:
+        sys.exit(4)
 
 
 if __name__ == "__main__":
