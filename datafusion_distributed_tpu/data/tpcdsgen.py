@@ -171,7 +171,9 @@ def gen_tpcds(sf: float = 0.01, seed: int = 0) -> dict:
     day_names = ["Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
                  "Saturday", "Sunday"]
     d_qoy = (d_moy - 1) // 3 + 1
-    month_seq = (d_year - 1990) * 12 + (d_moy - 1)
+    # spec month numbering: d_month_seq counts from 1900 (Jan 1998 = 1176),
+    # so the standard query ranges (1176+11, 1200+11, ...) select real data
+    month_seq = (d_year - 1900) * 12 + (d_moy - 1)
     week_seq = ((d_sk - _SK0) // 7 + 417).astype(np.int64)
     out["date_dim"] = pa.table({
         "d_date_sk": d_sk.astype(np.int64),

@@ -35,6 +35,7 @@ from datafusion_distributed_tpu.schema import DataType, Field, Schema
 # Host-side dictionary registry
 # ---------------------------------------------------------------------------
 
+import threading
 import weakref
 
 _DICT_COUNTER = itertools.count()
@@ -44,6 +45,27 @@ _DICT_COUNTER = itertools.count()
 _DICT_REGISTRY: "weakref.WeakValueDictionary[int, Dictionary]" = (
     weakref.WeakValueDictionary()
 )
+# (sorted input dict ids) -> union Dictionary; see unify_dictionaries
+_UNION_DICT_CACHE: dict = {}
+_DICT_CACHE_LOCK = threading.Lock()
+
+
+def lru_get_or_create(cache: dict, key, mint, cap: int):
+    """Thread-safe get-or-mint with LRU eviction (python dicts preserve
+    insertion order; move-to-end on hit). Shared by the dictionary
+    memoization caches: identity stability across re-traces requires that
+    a hit NEVER returns a different object than a concurrent or recent
+    call for the same key, and that eviction only removes cold entries."""
+    with _DICT_CACHE_LOCK:
+        if key in cache:
+            val = cache.pop(key)
+            cache[key] = val  # move to end = most recently used
+            return val
+        val = mint()
+        cache[key] = val
+        while len(cache) > cap:
+            cache.pop(next(iter(cache)))
+        return val
 
 
 class Dictionary:
@@ -521,7 +543,13 @@ def unify_dictionaries(dicts):
         # already agree
         return present[0], [None] * len(dicts)
     union_vals = np.unique(np.concatenate(vals))
-    union = Dictionary(union_vals.astype(object))
+    # memoize by input dict ids: re-tracing the same concat (e.g. the arm
+    # probe + lax.cond branch of IsolatedArmExec) must see the SAME union
+    # Dictionary object, or the traces' pytree metadata diverges
+    union = lru_get_or_create(
+        _UNION_DICT_CACHE, tuple(sorted(unique)),
+        lambda: Dictionary(union_vals.astype(object)), cap=256,
+    )
     luts = []
     for d in dicts:
         if d is None or len(d) == 0:

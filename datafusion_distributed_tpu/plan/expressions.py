@@ -31,6 +31,41 @@ import numpy as np
 from datafusion_distributed_tpu.ops.table import Column, Dictionary, Table
 from datafusion_distributed_tpu.schema import DataType, Field, Schema
 
+# Dictionary minting must be DETERMINISTIC across repeated evaluations of
+# the same expression: IsolatedArmExec traces an arm twice (shape probe +
+# lax.cond branch) and cond requires both traces' pytree metadata —
+# which includes Dictionary identity — to match. Fresh per-evaluate
+# Dictionaries also defeat the jit cache (dict_id is static aux data).
+# Concurrency discipline: stage tasks evaluate expressions from worker
+# threads, so get-or-mint is under a lock, and eviction is LRU (never the
+# just-used entry — a wholesale clear between an arm's probe and branch
+# traces would remint mid-trace and recreate the divergence).
+from datafusion_distributed_tpu.ops.table import lru_get_or_create
+
+_LITERAL_DICT_CACHE: dict = {}
+_DERIVED_DICT_CACHE: dict = {}
+
+
+def _literal_dictionary(value: str) -> Dictionary:
+    return lru_get_or_create(
+        _LITERAL_DICT_CACHE, value,
+        lambda: Dictionary.from_strings([value]), cap=512,
+    )
+
+
+def _derived_dictionary(src: Dictionary, op_key, derive):
+    """Memoized (source dict, operation) -> (sorted-unique Dictionary,
+    int32 inverse LUT). `derive(values) -> array of derived strings`."""
+
+    def mint():
+        derived = np.asarray(derive(src.values), dtype=object)
+        uniq, inverse = np.unique(derived.astype(str), return_inverse=True)
+        return (Dictionary(uniq.astype(object)), inverse.astype(np.int32))
+
+    return lru_get_or_create(
+        _DERIVED_DICT_CACHE, (src.dict_id, op_key), mint, cap=256,
+    )
+
 
 # ---------------------------------------------------------------------------
 # Evaluation result: device data + optional validity (None = all valid)
@@ -124,9 +159,11 @@ class Literal(PhysicalExpr):
             return ExprValue(data, jnp.zeros(cap, dtype=jnp.bool_), self.dtype)
         if self.dtype == DataType.STRING:
             # Bare string literal with no column context: keep as dtype STRING
-            # with a private single-entry dictionary. Comparisons against
-            # columns resolve via the column's dictionary (see Cmp).
-            d = Dictionary.from_strings([self.value])
+            # with an INTERNED single-entry dictionary (same value -> same
+            # Dictionary object, so re-tracing the expression yields
+            # identical pytree metadata). Comparisons against columns
+            # resolve via the column's dictionary (see Cmp).
+            d = _literal_dictionary(self.value)
             data = jnp.zeros(cap, dtype=np.int32)
             return ExprValue(data, None, self.dtype, d)
         val = np.asarray(self.value, dtype=self.dtype.np_dtype)
@@ -705,18 +742,18 @@ class Substring(PhysicalExpr):
         # SQL semantics: positions before 1 exist but hold nothing, so a
         # start of 0 with FOR 2 yields just the first character.
         begin = self.start - 1
+        b = max(begin, 0)
         if self.length is None:
-            b = max(begin, 0)
-            derived = np.asarray([v[b:] for v in vals], dtype=object)
+            derive = lambda vs: [v[b:] for v in vs]  # noqa: E731
         else:
             end = begin + self.length
-            b = max(begin, 0)
-            derived = np.asarray(
-                [v[b:end] if end > b else "" for v in vals], dtype=object
-            )
-        uniq, inverse = np.unique(derived.astype(str), return_inverse=True)
-        new_dict = Dictionary(uniq.astype(object))
-        lut = jnp.asarray(inverse.astype(np.int32))
+            derive = lambda vs: [  # noqa: E731
+                v[b:end] if end > b else "" for v in vs
+            ]
+        new_dict, inverse = _derived_dictionary(
+            c.dictionary, ("substr", self.start, self.length), derive
+        )
+        lut = jnp.asarray(inverse)
         if len(vals) == 0:
             codes = c.data
         else:
@@ -853,13 +890,16 @@ class StringCase(PhysicalExpr):
         c = self.child.evaluate(table)
         if c.dtype != DataType.STRING or c.dictionary is None:
             raise ValueError("UPPER/LOWER requires a dictionary string column")
-        vals = c.dictionary.values.astype(str)
-        derived = np.char.upper(vals) if self.upper else np.char.lower(vals)
-        uniq, inverse = np.unique(derived, return_inverse=True)
-        new_dict = Dictionary(uniq.astype(object))
+        vals = c.dictionary.values
+        new_dict, inverse = _derived_dictionary(
+            c.dictionary, ("case", self.upper),
+            lambda vs: (np.char.upper if self.upper else np.char.lower)(
+                vs.astype(str)
+            ),
+        )
         if len(vals) == 0:
             return ExprValue(c.data, c.validity, DataType.STRING, new_dict)
-        lut = jnp.asarray(inverse.astype(np.int32))
+        lut = jnp.asarray(inverse)
         codes = lut[jnp.clip(c.data, 0, len(vals) - 1)]
         return ExprValue(codes, c.validity, DataType.STRING, new_dict)
 
@@ -923,13 +963,14 @@ class RegexpReplace(PhysicalExpr):
         rx = re.compile(self.pattern)
         # SQL regex replacement uses \1 backrefs; python re.sub shares that
         repl = self.replacement
-        vals = c.dictionary.values.astype(str)
-        derived = np.asarray([rx.sub(repl, v) for v in vals], dtype=object)
-        uniq, inverse = np.unique(derived.astype(str), return_inverse=True)
-        new_dict = Dictionary(uniq.astype(object))
+        vals = c.dictionary.values
+        new_dict, inverse = _derived_dictionary(
+            c.dictionary, ("re", self.pattern, repl),
+            lambda vs: [rx.sub(repl, v) for v in vs.astype(str)],
+        )
         if len(vals) == 0:
             return ExprValue(c.data, c.validity, DataType.STRING, new_dict)
-        lut = jnp.asarray(inverse.astype(np.int32))
+        lut = jnp.asarray(inverse)
         codes = lut[jnp.clip(c.data, 0, len(vals) - 1)]
         return ExprValue(codes, c.validity, DataType.STRING, new_dict)
 

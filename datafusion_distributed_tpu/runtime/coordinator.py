@@ -156,8 +156,10 @@ class Coordinator:
             merged = self._stream_stage_coalesced(
                 plan, producer, query_id, stage_id, t_prod
             )
-            return MemoryScanExec([merged], producer.schema(),
+            scan = MemoryScanExec([merged], producer.schema(),
                                   replicated=True)
+            self._seed_consumer_scan(plan, scan)
+            return scan
         else:
             outputs = self._run_stage_tasks(
                 producer, query_id, stage_id, t_prod
@@ -193,7 +195,20 @@ class Coordinator:
             slices = _mod_slices(outputs[0], t)
         else:
             raise NotImplementedError(type(plan).__name__)
-        return MemoryScanExec(slices, producer.schema())
+        scan = MemoryScanExec(slices, producer.schema())
+        self._seed_consumer_scan(plan, scan)
+        return scan
+
+    def _seed_consumer_scan(self, exchange, scan) -> None:
+        """Hook: the consumer-side scan for `exchange` was just built (the
+        AdaptiveCoordinator seeds it with mid-execution LoadInfo)."""
+
+    def _producer_progress(self, stage_id: int, done: int, total: int,
+                           rows: int, width: int) -> None:
+        """Hook: `done`/`total` producer tasks of stage `stage_id` have
+        completed with `rows` total output rows so far (the reference's
+        LoadInfo stream, `sampler.rs:30-42`). Called while the remaining
+        producers are still executing."""
 
     # -- task-count policy ---------------------------------------------------
     def _producer_task_count(self, exchange, producer) -> int:
@@ -284,10 +299,18 @@ class Coordinator:
 
             return pull
 
+        from datafusion_distributed_tpu.planner.statistics import row_width
+
+        width = row_width(producer.schema())
+
+        def progress(done, total, rows, _bytes):
+            self._producer_progress(stage_id, done, total, rows, width)
+
         chunks, stats = stream_stage_chunks(
             [make_puller(i) for i in range(t_prod)], budget,
             row_target=fetch,
             max_concurrent=max(len(self.resolver.get_urls()), 1),
+            on_progress=progress,
         )
         self.stream_metrics[(query_id, stage_id)] = {
             "bytes_streamed": stats.bytes_streamed,
@@ -317,13 +340,21 @@ class Coordinator:
         the remaining ones (cancellation propagation)."""
         import concurrent.futures as cf
 
+        from datafusion_distributed_tpu.planner.statistics import row_width
+
+        width = row_width(producer.schema())
         workers = max(len(self.resolver.get_urls()), 1)
         if task_count == 1 or workers == 1:
-            return [
-                self._run_stage_task(producer, query_id, stage_id, i,
-                                     task_count)
-                for i in range(task_count)
-            ]
+            outs = []
+            rows = 0
+            for i in range(task_count):
+                out = self._run_stage_task(producer, query_id, stage_id, i,
+                                           task_count)
+                outs.append(out)
+                rows += int(out.num_rows)
+                self._producer_progress(stage_id, i + 1, task_count, rows,
+                                        width)
+            return outs
         with cf.ThreadPoolExecutor(max_workers=workers) as pool:
             futs = [
                 pool.submit(self._run_stage_task, producer, query_id,
@@ -331,6 +362,15 @@ class Coordinator:
                 for i in range(task_count)
             ]
             try:
+                # drain in completion order so mid-execution LoadInfo flows
+                # while the slower producers are still running
+                rows = 0
+                done = 0
+                for f in cf.as_completed(futs):
+                    rows += int(f.result().num_rows)
+                    done += 1
+                    self._producer_progress(stage_id, done, task_count,
+                                            rows, width)
                 return [f.result() for f in futs]
             except BaseException:
                 for f in futs:
@@ -406,27 +446,75 @@ class Coordinator:
 @dataclass
 class AdaptiveCoordinator(Coordinator):
     """Dynamic-planning coordinator (the reference's `dynamic_task_count`
-    mode): consumer stages are re-sized from the EXACT LoadInfo of their
-    materialized inputs before execution — planning and execution interleave
-    (`prepare_dynamic_plan.rs`), with real statistics instead of samples.
-    Both CAPACITIES (resize_for_inputs) and TASK COUNTS
-    (compute_based_task_count analogue: ceil(exact bytes / bytes_per_task))
-    adapt."""
+    mode): consumer stages are re-sized from runtime LoadInfo — planning
+    and execution interleave (`prepare_dynamic_plan.rs`). Both CAPACITIES
+    (resize_for_inputs) and TASK COUNTS (compute_based_task_count analogue:
+    ceil(bytes / bytes_per_task)) adapt.
+
+    Mid-execution sampling: every dispatch path streams per-completion
+    LoadInfo (`_producer_progress` — the reference's SamplerExec stream,
+    `sampler.rs:30-42`); once `sample_fraction` of a stage's producer
+    tasks have completed, the consumer's statistics are EXTRAPOLATED from
+    that partial per-task sample and frozen — the sizing decision is taken
+    while the remaining producers are still running, exactly the
+    reference's 20%%-sample short-circuit (`prepare_dynamic_plan.rs:
+    111-141,206-331`). In this bulk-synchronous host tier the consumer
+    still launches only after its inputs materialize, so what the early
+    freeze buys is the reference's decision protocol (sample-extrapolated
+    sizing, available to e.g. pre-compile or pre-provision the consumer)
+    rather than wall-clock overlap; stages whose producers finish before
+    the threshold fall back to exact statistics."""
 
     #: compute_based_task_count divisor (prepare_dynamic_plan.rs:60-69 uses
     #: cpu_cost / bytes_per_partition_per_second; here exact bytes / this)
     bytes_per_task: int = 16 << 20
+    #: fraction of producer tasks whose completion triggers the partial-
+    #: sample decision (the reference short-circuits at 20% sampling)
+    sample_fraction: float = 0.25
+    #: safety margin applied to extrapolated rows (underestimating a
+    #: capacity costs an overflow-retry; overestimating only pads)
+    extrapolation_headroom: float = 1.25
 
     def execute(self, plan: ExecutionPlan) -> Table:
         self._load_info: dict[int, object] = {}
         self.task_count_decisions: list[tuple[int, int, int]] = []
+        #: stage_id -> LoadInfo predicted from a partial producer sample
+        self._predicted: dict[int, object] = {}
+        #: stage_id -> (done, total) at decision time — test/introspection
+        #: surface proving the decision predates producer completion
+        self.partial_decisions: dict[int, tuple[int, int]] = {}
         self._solo_shuffles = _find_solo_shuffles(plan)
         return super().execute(plan)
 
+    # -- mid-execution sampling ------------------------------------------
+    def _producer_progress(self, stage_id, done, total, rows, width):
+        if stage_id in self._predicted or done >= total or done <= 0:
+            return
+        import math
+
+        if done < max(1, math.ceil(total * self.sample_fraction)):
+            return
+        from datafusion_distributed_tpu.planner.adaptive import LoadInfo
+
+        pred_rows = int(rows * total / done * self.extrapolation_headroom)
+        self._predicted[stage_id] = LoadInfo(
+            rows=pred_rows, bytes=pred_rows * width
+        )
+        self.partial_decisions[stage_id] = (done, total)
+
+    def _seed_consumer_scan(self, exchange, scan) -> None:
+        """Freeze the mid-execution prediction as the consumer's LoadInfo:
+        `_stage_input_info` will size the consumer stage from the partial
+        sample instead of re-measuring the final tables."""
+        pred = self._predicted.get(exchange.stage_id)
+        if pred is not None:
+            self._load_info[scan.node_id] = pred
+
     def _consumer_task_count(self, exchange, outputs) -> int:
-        """Recompute the consumer task count from the EXACT bytes of the
-        materialized producer outputs (dynamic_task_count semantics); the
-        planned count is only an upper bound.
+        """Recompute the consumer task count from producer-output bytes
+        (dynamic_task_count semantics); the planned count is only an upper
+        bound. Uses the mid-execution prediction when one was frozen,
+        exact bytes otherwise.
 
         Only SOLO shuffles adapt (consumer stage fed by exactly one
         shuffle): a hash-join's co-shuffled sides must agree on `hash % t`
@@ -442,9 +530,13 @@ class AdaptiveCoordinator(Coordinator):
             return exchange.num_tasks
         if not outputs or self.bytes_per_task <= 0:
             return exchange.num_tasks
-        width = row_width(outputs[0].schema())
-        rows = sum(int(o.num_rows) for o in outputs)
-        want = max(1, -(-rows * width // self.bytes_per_task))
+        pred = self._predicted.get(exchange.stage_id)
+        if pred is not None:
+            nbytes = pred.bytes
+        else:
+            width = row_width(outputs[0].schema())
+            nbytes = sum(int(o.num_rows) for o in outputs) * width
+        want = max(1, -(-nbytes // self.bytes_per_task))
         t = min(exchange.num_tasks, int(want))
         self.task_count_decisions.append(
             (exchange.stage_id if exchange.stage_id is not None else -1,
@@ -519,10 +611,18 @@ def _find_solo_shuffles(plan: ExecutionPlan) -> set:
 def _task_specialized(plan: ExecutionPlan, task_number: int) -> ExecutionPlan:
     """Ship only this task's leaf slice (the reference strips other tasks'
     DistributedLeaf variants before sending, `query_coordinator.rs:346-382`).
-    The worker indexes its slice with task_index 0...task-local addressing is
-    preserved because MemoryScanExec.load clamps by list length."""
 
-    def walk(node: ExecutionPlan) -> ExecutionPlan:
+    Inside an IsolatedArmExec that IS assigned to this task, partitioned
+    scans contribute ALL their slices, concatenated: the arm executes on
+    exactly one task, so it is the sole consumer of any exchange output or
+    base-table slice in its subtree — indexing those by the OUTER task
+    number would silently drop every slice but this task's (observed as
+    q5's catalog channel vanishing when its arm landed on task 1 and the
+    arm's scans held a single slice 0). This is the reference's inner
+    `DistributedTaskContext` remap for union children
+    (`children_isolator_union.rs:84-100`)."""
+
+    def walk(node: ExecutionPlan, in_arm: bool) -> ExecutionPlan:
         if isinstance(node, IsolatedArmExec):
             if node.assigned_task != task_number:
                 # ChildrenIsolatorUnion semantics: this arm belongs to
@@ -530,13 +630,37 @@ def _task_specialized(plan: ExecutionPlan, task_number: int) -> ExecutionPlan:
                 schema = node.schema()
                 empty = Table.empty(schema, 8, None)
                 return MemoryScanExec([empty], schema, pinned=True)
-            return walk(node.child)
+            return walk(node.child, True)
+        if in_arm:
+            from datafusion_distributed_tpu.plan.physical import (
+                ParquetScanExec,
+            )
+
+            if isinstance(node, ParquetScanExec):
+                # the arm's task reads EVERY file group (same sole-consumer
+                # argument as the MemoryScan case below)
+                flat = [f for g in node.file_groups for f in g]
+                groups = [[] for _ in range(task_number)] + [flat]
+                return ParquetScanExec(
+                    groups, node.schema(),
+                    node.capacity * max(len(node.file_groups), 1),
+                    projection=node.projection,
+                    dictionaries=node.dictionaries,
+                )
         if isinstance(node, MemoryScanExec) and node.replicated:
             # every task reads the same merged table
             return MemoryScanExec([node.tasks[0]], node.schema(),
                                   pinned=True)
         if isinstance(node, MemoryScanExec) and not node.pinned:
-            if task_number < len(node.tasks):
+            if in_arm:
+                if len(node.tasks) == 1:
+                    chosen = node.tasks[0]
+                else:
+                    chosen = concat_tables(
+                        node.tasks,
+                        capacity=sum(t.capacity for t in node.tasks),
+                    )
+            elif task_number < len(node.tasks):
                 chosen = node.tasks[task_number]
             else:
                 from datafusion_distributed_tpu.plan.physical import _dicts_of
@@ -546,10 +670,10 @@ def _task_specialized(plan: ExecutionPlan, task_number: int) -> ExecutionPlan:
                     node.schema(), ref.capacity, _dicts_of(ref)
                 )
             return MemoryScanExec([chosen], node.schema(), pinned=True)
-        children = [walk(c) for c in node.children()]
+        children = [walk(c, in_arm) for c in node.children()]
         return node.with_new_children(children) if children else node
 
-    return walk(plan)
+    return walk(plan, False)
 
 
 def _shuffle_regroup(

@@ -73,6 +73,7 @@ def stream_stage_chunks(
     budget_bytes: int,
     row_target: Optional[int] = None,
     max_concurrent: Optional[int] = None,
+    on_progress: Optional[Callable[[int, int, int, int], None]] = None,
 ) -> tuple[list[list[Table]], StreamStats]:
     """Run one chunk stream per producer task concurrently under a shared
     byte budget; -> (per-task chunk lists, stats).
@@ -86,6 +87,14 @@ def stream_stage_chunks(
     producer task simultaneously; matches `_run_stage_tasks`' thread-pool
     policy). Each puller materializes its task's output on dispatch, so
     this bounds peak device-side concurrency, not just host chunks.
+
+    ``on_progress(done_pullers, total_pullers, rows, bytes)``: called in
+    the consumer thread after every puller COMPLETION with the rows/bytes
+    contributed by the completed pullers only — the reference's
+    mid-execution LoadInfo stream (`sampler.rs:30-42`); an adaptive
+    coordinator extrapolates the NEXT stage's sizing from these partial
+    per-task samples (rows from still-running pullers are excluded so
+    `rows * total/done` is an unbiased estimate).
     """
     import queue as _q
 
@@ -127,10 +136,19 @@ def stream_stage_chunks(
         t.start()
     live = len(pullers)
     error: Optional[BaseException] = None
+    rows_per = [0] * len(pullers)
+    bytes_per = [0] * len(pullers)
+    done_rows = 0
+    done_bytes = 0
     while live:
         kind, i, payload, nbytes = out_q.get()
         if kind == "done":
             live -= 1
+            done_rows += rows_per[i]
+            done_bytes += bytes_per[i]
+            if on_progress is not None:
+                on_progress(len(pullers) - live, len(pullers),
+                            done_rows, done_bytes)
             continue
         if kind == "error":
             error = error or payload
@@ -143,6 +161,8 @@ def stream_stage_chunks(
         stats.chunks += 1
         stats.bytes_streamed += nbytes
         stats.rows += int(payload.num_rows)
+        rows_per[i] += int(payload.num_rows)
+        bytes_per[i] += nbytes
         if row_target is not None and stats.rows >= row_target:
             stats.early_exit = True
             cancel.set()

@@ -126,3 +126,44 @@ def test_adaptive_coordinator_matches_single():
     np.testing.assert_array_equal(got["k"], single["k"])
     np.testing.assert_allclose(got["sv"], single["sv"], rtol=FLOAT_RTOL)
     np.testing.assert_array_equal(got["n"], single["n"])
+
+
+def test_adaptive_overlap_partial_decision():
+    """Mid-execution adaptive planning (the reference's overlap of
+    prepare_dynamic_plan with execution, `prepare_dynamic_plan.rs:111-141`):
+    with 4 concurrent producer tasks, the consumer's LoadInfo freezes from
+    an extrapolated PARTIAL sample — `partial_decisions` records (done,
+    total) with done < total, proving the sizing decision predates producer
+    completion — and the result still matches single-node."""
+    import pandas as pd
+
+    from datafusion_distributed_tpu.sql.context import SessionContext
+
+    rng = np.random.default_rng(7)
+    n = 20000
+    arrow = pa.table({"k": rng.integers(0, 50, n).astype("int64"),
+                      "v": rng.normal(size=n)})
+    ctx = SessionContext()
+    ctx.register_arrow("t", arrow)
+    ctx.config.distributed_options["bytes_per_task"] = 1  # force 4-way split
+    df = ctx.sql("select k, sum(v) sv, count(*) c from t group by k")
+    single = df.to_pandas().sort_values("k").reset_index(drop=True)
+    cluster = InMemoryCluster(4)
+    coord = AdaptiveCoordinator(resolver=cluster, channels=cluster)
+    got = df._strip_quals(
+        df.collect_coordinated_table(coordinator=coord, num_tasks=4)
+    ).to_pandas()
+    got.columns = list(single.columns)
+    got = got.sort_values("k").reset_index(drop=True)
+    np.testing.assert_array_equal(got["k"], single["k"])
+    # sums of ~400 standard normals can land near zero, where rtol alone
+    # rejects benign f32 accumulation-order differences (the static
+    # coordinator shows the same 2e-5 deltas)
+    np.testing.assert_allclose(got["sv"], single["sv"], rtol=FLOAT_RTOL,
+                               atol=1e-3)
+    np.testing.assert_array_equal(got["c"], single["c"])
+    assert coord.partial_decisions, (
+        "no consumer sizing decision was made from partial producer output"
+    )
+    for done, total in coord.partial_decisions.values():
+        assert 0 < done < total
