@@ -56,13 +56,26 @@ def shuffle_exchange(
     column bit-cast to uint lanes and stacked), not one collective per
     column — latency is ~flat in column count.
     """
-    cap = table.capacity
     live = table.row_mask()
     cols = [table.column(k).data for k in key_names]
     valids = [table.column(k).validity for k in key_names]
     h = hash_columns(cols, valids)
     dest = (h % np.uint32(num_tasks)).astype(jnp.int32)
     dest = jnp.where(live, dest, num_tasks)  # dead rows go nowhere
+    return _route_by_dest(table, dest, axis, num_tasks, per_dest_capacity)
+
+
+def _route_by_dest(
+    table: Table,
+    dest: jnp.ndarray,
+    axis: str,
+    num_tasks: int,
+    per_dest_capacity: int,
+) -> tuple[Table, jnp.ndarray]:
+    """Move each live row to mesh task `dest[row]` (dead rows carry
+    dest == num_tasks). Shared routing core of the hash and range shuffles:
+    sort-based bucketing + ONE fused all_to_all per element-width class."""
+    cap = table.capacity
 
     # sort-based bucketing: rows grouped by destination, dead rows last
     order = jnp.argsort(dest, stable=True).astype(jnp.int32)  # [C]
@@ -152,6 +165,111 @@ def _bitcast_back(u: jnp.ndarray, dtype) -> jnp.ndarray:
     if u.dtype == dtype:
         return u
     return u.view(dtype)
+
+
+def _order_encode(col: Column, ascending: bool, nulls_first: bool):
+    """Order-isomorphic unsigned encoding of a sort-key column: for the
+    TRUE sort order (incl. direction and null placement), a < b implies
+    e(a) <= e(b). Nulls map to the dtype's extremes, so a null can only
+    FALSE-TIE with an extreme value — which merely coarsens range
+    partitioning (ties route to one task), never reorders. String columns
+    compare by dictionary code (dictionaries are sorted)."""
+    d = col.data
+    nan_mask = None
+    if d.dtype == jnp.bool_:
+        u = d.astype(jnp.uint32)
+    elif jnp.issubdtype(d.dtype, jnp.floating):
+        w = d.dtype.itemsize
+        ut = {2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}[w]
+        b = d.view(ut)
+        sign = jnp.asarray(1, ut) << (8 * w - 1)
+        # IEEE radix trick: negatives flip all bits, positives flip sign
+        u = jnp.where((b & sign) != 0, ~b, b ^ sign)
+        nan_mask = jnp.isnan(d)
+    elif jnp.issubdtype(d.dtype, jnp.signedinteger):
+        w = d.dtype.itemsize
+        ut = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}[w]
+        u = d.view(ut) ^ (jnp.asarray(1, ut) << (8 * w - 1))
+    else:
+        u = d
+    if not ascending:
+        u = ~u
+    if nan_mask is not None:
+        # the local sort kernel (argsort) and the host regroup both place
+        # NaN LAST regardless of direction; route it the same way (after
+        # the direction flip, before the null override)
+        u = jnp.where(nan_mask, ~jnp.zeros((), u.dtype), u)
+    if col.validity is not None:
+        lo = jnp.zeros((), u.dtype)
+        hi = ~jnp.zeros((), u.dtype)
+        u = jnp.where(col.validity, u, lo if nulls_first else hi)
+    return u
+
+
+def range_shuffle_exchange(
+    table: Table,
+    keys,  # list[ops.sort.SortKey]
+    axis: str,
+    num_tasks: int,
+    per_dest_capacity: int,
+    samples_per_task: int = 64,
+) -> tuple[Table, jnp.ndarray]:
+    """Range-partition rows across the mesh axis by the composite sort key
+    (classic distributed sample sort): after this exchange + a LOCAL sort,
+    concatenating task outputs in axis order IS the global sort order — no
+    device ever holds or re-sorts the full dataset, unlike the previous
+    coalesce-then-sort plan whose every device sorted all T*C gathered
+    rows. The splitters come from an all_gathered per-task sample (the
+    small gather is the only global communication besides the row routing
+    itself, which rides the same fused all_to_all as the hash shuffle).
+    """
+    cap = table.capacity
+    live = table.row_mask()
+    enc = [
+        _order_encode(table.column(k.name), k.ascending, k.nulls_first)
+        for k in keys
+    ]
+
+    # --- per-task sample: evenly spaced live rows -----------------------
+    s = min(samples_per_task, cap)
+    n = table.num_rows
+    pos = (jnp.arange(s, dtype=jnp.int32) * jnp.maximum(n, 1)) // s
+    pos = jnp.clip(pos, 0, cap - 1)
+    samp_live = jnp.arange(s, dtype=jnp.int32) < n
+    samp = [e[pos] for e in enc]
+
+    # gather all tasks' samples: [T*s] per key lane, dead samples sort last
+    g_live = jax.lax.all_gather(samp_live, axis).reshape(-1)
+    g = [jax.lax.all_gather(e, axis).reshape(-1) for e in samp]
+    order = jnp.argsort(~g_live, stable=True).astype(jnp.int32)
+    for lane in reversed(g):
+        # stable composition, least-significant first; dead-last applied
+        # as the final (most significant) pass
+        order = order[jnp.argsort(lane[order], stable=True)]
+    order = order[jnp.argsort(~g_live[order], stable=True)]
+    total_live = jnp.sum(g_live.astype(jnp.int32))
+
+    # T-1 splitters at the live-sample quantiles
+    ranks = (
+        jnp.arange(1, num_tasks, dtype=jnp.int32) * total_live
+    ) // num_tasks
+    ranks = jnp.clip(ranks, 0, jnp.maximum(total_live - 1, 0))
+    split_idx = order[ranks]  # [T-1] indices into gathered samples
+    splitters = [lane[split_idx] for lane in g]  # per key: [T-1]
+
+    # --- dest = number of splitters <= row (lexicographic) --------------
+    dest = jnp.zeros(cap, dtype=jnp.int32)
+    for j in range(num_tasks - 1):
+        gt = jnp.zeros(cap, dtype=jnp.bool_)
+        eq = jnp.ones(cap, dtype=jnp.bool_)
+        for lane, spl in zip(enc, splitters):
+            sj = spl[j]
+            gt = gt | (eq & (lane > sj))
+            eq = eq & (lane == sj)
+        dest = dest + (gt | eq).astype(jnp.int32)
+    dest = jnp.where(total_live > 0, dest, 0)
+    dest = jnp.where(live, dest, num_tasks)  # dead rows go nowhere
+    return _route_by_dest(table, dest, axis, num_tasks, per_dest_capacity)
 
 
 def broadcast_exchange(table: Table, axis: str, num_tasks: int) -> Table:

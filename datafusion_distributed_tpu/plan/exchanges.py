@@ -128,6 +128,67 @@ class ShuffleExchangeExec(ExchangeExec):
         )
 
 
+class RangeShuffleExchangeExec(ExchangeExec):
+    """Range shuffle on a composite SORT key (distributed sample sort):
+    after this exchange, task i's rows all order before task i+1's, so a
+    LOCAL sort per task followed by an order-preserving coalesce yields
+    the global sort order. Replaces the coalesce-then-global-sort plan for
+    unlimited ORDER BY: the old shape made every device gather and re-sort
+    the full T*C dataset; this one sorts T-way in parallel and never
+    re-sorts after the gather. (The reference leans on single-node
+    SortPreservingMergeExec above a coalesce, `inject_network_boundaries.rs`
+    sort case — a merge is the streaming-CPU analogue of the same idea.)
+    """
+
+    def __init__(
+        self,
+        child: ExecutionPlan,
+        sort_keys,  # list[ops.sort.SortKey]
+        num_tasks: int,
+        per_dest_capacity: int,
+    ):
+        super().__init__(child, num_tasks)
+        self.sort_keys = list(sort_keys)
+        self.per_dest_capacity = per_dest_capacity
+
+    def with_new_children(self, children):
+        n = RangeShuffleExchangeExec(
+            children[0], self.sort_keys, self.num_tasks,
+            self.per_dest_capacity,
+        )
+        n.stage_id = self.stage_id
+        n.producer_tasks = self.producer_tasks
+        n.consumer_fetch = self.consumer_fetch
+        return n
+
+    def output_capacity(self):
+        t_prod = (self.producer_tasks if self.producer_tasks is not None
+                  else self.num_tasks)
+        return t_prod * self.per_dest_capacity
+
+    def _execute(self, ctx: ExecContext) -> Table:
+        from datafusion_distributed_tpu.parallel.exchange import (
+            range_shuffle_exchange,
+        )
+
+        t = self.child.execute(ctx)
+        out, overflow = range_shuffle_exchange(
+            t, self.sort_keys, self._require_axis(ctx), self.num_tasks,
+            self.per_dest_capacity,
+        )
+        ctx.record_overflow(self, overflow)
+        return out
+
+    def display(self):
+        keys = ", ".join(
+            f"{k.name}{'' if k.ascending else ' DESC'}" for k in self.sort_keys
+        )
+        return (
+            f"RangeShuffleExchange keys=[{keys}] tasks={self.num_tasks} "
+            f"per_dest_cap={self.per_dest_capacity}"
+        )
+
+
 class PartitionReplicatedExec(ExchangeExec):
     """REPLICATED -> PARTITIONED: every task keeps the row-index slice
     ``row % num_tasks == task`` of its (identical) copy. No communication —

@@ -41,6 +41,7 @@ from datafusion_distributed_tpu.ops.table import round_up_pow2
 from datafusion_distributed_tpu.parallel.exchange import partition_table
 from datafusion_distributed_tpu.plan.exchanges import (
     BroadcastExchangeExec,
+    RangeShuffleExchangeExec,
     CoalesceExchangeExec,
     ShuffleExchangeExec,
 )
@@ -142,6 +143,11 @@ class DistributedConfig:
     # insert partial_reduce aggregates below hash shuffles (the reference's
     # `partial_reduce` knob, default off; see _partial_reduce_pass)
     partial_reduce: bool = False
+    # unlimited ORDER BY over data larger than this (global row capacity)
+    # plans as a distributed sample sort (range shuffle + local sorts);
+    # smaller sorts keep the cheaper coalesce-then-sort shape (two fewer
+    # stages, and one device trivially sorts a post-aggregate result)
+    range_sort_threshold_rows: int = 8192
     # force every stage to exactly num_tasks (the mesh tier sets this: one
     # SPMD program's exchanges are axis-wide collectives, so stage width is
     # the physical mesh width regardless of scheduling-tier knobs)
@@ -480,8 +486,31 @@ def _inject(plan: ExecutionPlan, cfg: DistributedConfig):
         child, dist, ann = _inject(plan.child, cfg)
         if dist == Distribution.REPLICATED:
             return plan.with_new_children([child]), dist, ann
-        # local (top-k) sort -> coalesce -> final sort; fetch pushdown is the
-        # push_fetch_into_network_coalesce analogue
+        if plan.fetch is None:
+            # unlimited ORDER BY: distributed sample sort — range-shuffle
+            # on the sort key, sort locally, gather in axis order (which IS
+            # the global order). The old coalesce-then-sort shape made
+            # every device re-sort the full gathered dataset.
+            child, t_p = _seal_stage(child, ann, cfg)
+            t_c = _consumer_count(child, t_p, cfg)
+            big = (child.output_capacity() * max(t_p, 1)
+                   > cfg.range_sort_threshold_rows)
+            if t_c > 1 and big:
+                per_dest = round_up_pow2(max(
+                    cfg.shuffle_skew_factor * child.output_capacity()
+                    // max(t_c, 1), 8,
+                ))
+                rs = RangeShuffleExchangeExec(child, plan.keys, t_c, per_dest)
+                rs.producer_tasks = t_p
+                local = SortExec(plan.keys, rs)
+                gathered = CoalesceExchangeExec(local, t_c)
+                return (gathered, Distribution.REPLICATED,
+                        TaskCountAnnotation(1))
+            gathered = CoalesceExchangeExec(child, t_p)
+            final = SortExec(plan.keys, gathered)
+            return final, Distribution.REPLICATED, TaskCountAnnotation(1)
+        # fetch-limited: local top-k sort -> coalesce -> final sort; fetch
+        # pushdown is the push_fetch_into_network_coalesce analogue
         local = SortExec(plan.keys, child, fetch=plan.fetch)
         local, t_p = _seal_stage(local, ann, cfg)
         gathered = CoalesceExchangeExec(local, t_p)

@@ -34,6 +34,7 @@ from datafusion_distributed_tpu.plan.exchanges import (
     CoalesceExchangeExec,
     IsolatedArmExec,
     PartitionReplicatedExec,
+    RangeShuffleExchangeExec,
     ShuffleExchangeExec,
 )
 from datafusion_distributed_tpu.plan.physical import (
@@ -160,12 +161,31 @@ class Coordinator:
                                   replicated=True)
             self._seed_consumer_scan(plan, scan)
             return scan
+        elif (
+            isinstance(plan, ShuffleExchangeExec)
+            and self._partition_streams_enabled(plan)
+        ):
+            # partition-range data plane: each producer serves its hash-
+            # partitioned output over ONE multiplexed stream; the hashing
+            # runs on the workers and the coordinator only demuxes
+            slices = self._shuffle_stage_partition_streams(
+                plan, producer, query_id, stage_id, t_prod
+            )
+            scan = MemoryScanExec(slices, producer.schema())
+            self._seed_consumer_scan(plan, scan)
+            return scan
         else:
             outputs = self._run_stage_tasks(
                 producer, query_id, stage_id, t_prod
             )
         t = self._consumer_task_count(plan, outputs)
-        if isinstance(plan, ShuffleExchangeExec):
+        if isinstance(plan, RangeShuffleExchangeExec):
+            # host tier can range-partition EXACTLY: sort the concatenated
+            # producer output once and hand out contiguous slices (the
+            # mesh tier's sample-splitter approximation is only needed
+            # where no task sees the whole dataset)
+            slices = _range_regroup(outputs, plan.sort_keys, t)
+        elif isinstance(plan, ShuffleExchangeExec):
             slices = _shuffle_regroup(
                 outputs, plan.key_names, t, plan.per_dest_capacity
             )
@@ -209,6 +229,93 @@ class Coordinator:
         completed with `rows` total output rows so far (the reference's
         LoadInfo stream, `sampler.rs:30-42`). Called while the remaining
         producers are still executing."""
+
+    # -- partition-range data plane ------------------------------------------
+    def _partition_streams_enabled(self, exchange) -> bool:
+        """Shuffle via worker-side partitioning + multiplexed partition
+        streams when every worker offers the surface. The adaptive
+        coordinator overrides to False: it resizes consumer task counts
+        from exact materialized outputs, while a partition stream fixes
+        the partition count in the request."""
+        try:
+            return all(
+                hasattr(self.channels.get_worker(u),
+                        "execute_task_partitions")
+                for u in self.resolver.get_urls()
+            )
+        except Exception:
+            return False
+
+    def _shuffle_stage_partition_streams(
+        self, exchange, producer: ExecutionPlan, query_id: str,
+        stage_id: int, t_prod: int,
+    ) -> list[Table]:
+        """One multiplexed stream per producer task carrying the FULL
+        partition range [0, t_consumer); chunks arrive tagged with their
+        partition id and are demuxed into consumer slices under the shared
+        byte budget (the reference's WorkerConnectionPool demux +
+        64 MiB budget, `worker_connection_pool.rs:243-308`). The hash/
+        bucket work runs on the producers, not the coordinator."""
+        from datafusion_distributed_tpu.runtime.streams import (
+            stream_stage_chunks,
+        )
+
+        t_cons = exchange.num_tasks
+        budget = int(self.config_options.get(
+            "worker_connection_buffer_budget_bytes", 64 << 20
+        ))
+        chunk_rows = int(self.config_options.get("stream_chunk_rows", 65536))
+        prepared = self._prepare_stage_plan(producer)
+
+        def make_puller(task_number: int):
+            def pull(cancel):
+                worker, key, plan_obj, store = self._dispatch_task(
+                    prepared, query_id, stage_id, task_number, t_prod
+                )
+                try:
+                    for p, piece, est in worker.execute_task_partitions(
+                        key, exchange.key_names, t_cons, 0, t_cons,
+                        per_dest_capacity=exchange.per_dest_capacity,
+                        chunk_rows=chunk_rows, cancel=cancel,
+                    ):
+                        yield (p, piece), est
+                    self._record_task_progress(worker, key)
+                finally:
+                    self._cleanup_task(worker, key, plan_obj, store)
+
+            return pull
+
+        chunks, stats = stream_stage_chunks(
+            [make_puller(i) for i in range(t_prod)], budget,
+            max_concurrent=max(len(self.resolver.get_urls()), 1),
+            payload_rows=lambda pr: int(pr[1].num_rows),
+        )
+        self.stream_metrics[(query_id, stage_id)] = {
+            "bytes_streamed": stats.bytes_streamed,
+            "chunks": stats.chunks,
+            "peak_in_flight": stats.peak_in_flight,
+            "early_exit": stats.early_exit,
+            "rows": stats.rows,
+            "partitions": t_cons,
+            "rows_per_s": round(stats.rows_per_s, 1),
+            "bytes_per_s": round(stats.bytes_per_s, 1),
+        }
+        parts: list[list[Table]] = [[] for _ in range(t_cons)]
+        for per in chunks:
+            for p, tbl in per:
+                parts[p].append(tbl)
+        schema = producer.schema()
+        slices = []
+        for plist in parts:
+            if plist:
+                rows = sum(int(t.num_rows) for t in plist)
+                cap = max(-(-rows // 8) * 8, 8)
+                slices.append(concat_tables(plist, capacity=cap))
+            else:
+                slices.append(Table.empty(
+                    schema, 8, _leaf_dictionaries(producer, schema)
+                ))
+        return slices
 
     # -- task-count policy ---------------------------------------------------
     def _producer_task_count(self, exchange, producer) -> int:
@@ -318,6 +425,8 @@ class Coordinator:
             "peak_in_flight": stats.peak_in_flight,
             "early_exit": stats.early_exit,
             "rows": stats.rows,
+            "rows_per_s": round(stats.rows_per_s, 1),
+            "bytes_per_s": round(stats.bytes_per_s, 1),
         }
         flat = [c for per in chunks for c in per]
         if not flat:
@@ -485,6 +594,12 @@ class AdaptiveCoordinator(Coordinator):
         self.partial_decisions: dict[int, tuple[int, int]] = {}
         self._solo_shuffles = _find_solo_shuffles(plan)
         return super().execute(plan)
+
+    def _partition_streams_enabled(self, exchange) -> bool:
+        # adaptive mode recomputes consumer task counts from exact
+        # materialized outputs; a partition stream would fix the count
+        # in the producer request before those statistics exist
+        return False
 
     # -- mid-execution sampling ------------------------------------------
     def _producer_progress(self, stage_id, done, total, rows, width):
@@ -717,6 +832,32 @@ def _shuffle_regroup(
     cap = max(len(outputs), 1) * per_dest_capacity
     for j in range(num_tasks):
         slices.append(concat_tables(buckets[j], capacity=cap))
+    return slices
+
+
+def _range_regroup(outputs: Sequence[Table], sort_keys,
+                   num_tasks: int) -> list[Table]:
+    """Exact host-side range partition: concat, sort once, contiguous
+    slices. Slice i's rows all order before slice i+1's, so consumers'
+    local sorts + an order-preserving coalesce reproduce the global
+    order (mesh-tier contract of RangeShuffleExchangeExec)."""
+    from datafusion_distributed_tpu.ops.sort import sort_table
+
+    total = concat_tables(
+        outputs, capacity=sum(o.capacity for o in outputs)
+    )
+    s = sort_table(total, sort_keys)
+    n = int(s.num_rows)
+    per = -(-max(n, 1) // num_tasks)
+    slices = []
+    for i in range(num_tasks):
+        count = max(min(per, n - i * per), 0)
+        if count > 0:
+            slices.append(s.slice_rows(i * per, count))
+        else:
+            from datafusion_distributed_tpu.plan.physical import _dicts_of
+
+            slices.append(Table.empty(s.schema(), 8, _dicts_of(s)))
     return slices
 
 

@@ -17,6 +17,7 @@ In-mesh exchanges never touch this: they are single-program collectives.
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Iterator, Optional
 
@@ -58,13 +59,18 @@ class StreamBudget:
 
 @dataclass
 class StreamStats:
-    """Per-stage streaming telemetry (surfaced via Coordinator.metrics)."""
+    """Per-stage streaming telemetry (surfaced via Coordinator.metrics).
+    ``rows_per_s``/``bytes_per_s`` are the reference LoadInfo's velocity
+    fields (`worker.proto` LoadInfo, `sampler.rs:30-42`)."""
 
     bytes_streamed: int = 0
     chunks: int = 0
     peak_in_flight: int = 0
     early_exit: bool = False
     rows: int = 0
+    elapsed_s: float = 0.0
+    rows_per_s: float = 0.0
+    bytes_per_s: float = 0.0
     extra: dict = field(default_factory=dict)
 
 
@@ -74,7 +80,8 @@ def stream_stage_chunks(
     row_target: Optional[int] = None,
     max_concurrent: Optional[int] = None,
     on_progress: Optional[Callable[[int, int, int, int], None]] = None,
-) -> tuple[list[list[Table]], StreamStats]:
+    payload_rows: Optional[Callable] = None,
+) -> tuple[list[list], StreamStats]:
     """Run one chunk stream per producer task concurrently under a shared
     byte budget; -> (per-task chunk lists, stats).
 
@@ -98,6 +105,9 @@ def stream_stage_chunks(
     """
     import queue as _q
 
+    if payload_rows is None:
+        payload_rows = lambda p: int(p.num_rows)  # noqa: E731
+    t_start = time.perf_counter()
     budget = StreamBudget(budget_bytes)
     cancel = threading.Event()
     out_q: _q.Queue = _q.Queue()
@@ -160,8 +170,9 @@ def stream_stage_chunks(
         chunks[i].append(payload)
         stats.chunks += 1
         stats.bytes_streamed += nbytes
-        stats.rows += int(payload.num_rows)
-        rows_per[i] += int(payload.num_rows)
+        pr = payload_rows(payload)
+        stats.rows += pr
+        rows_per[i] += pr
         bytes_per[i] += nbytes
         if row_target is not None and stats.rows >= row_target:
             stats.early_exit = True
@@ -171,4 +182,7 @@ def stream_stage_chunks(
     if error is not None:
         raise error
     stats.peak_in_flight = budget.peak_in_flight
+    stats.elapsed_s = max(time.perf_counter() - t_start, 1e-9)
+    stats.rows_per_s = stats.rows / stats.elapsed_s
+    stats.bytes_per_s = stats.bytes_streamed / stats.elapsed_s
     return chunks, stats

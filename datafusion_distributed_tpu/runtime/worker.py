@@ -60,6 +60,17 @@ class TaskData:
     # (`passthrough_headers.rs`)
     config: dict = field(default_factory=dict)
     headers: dict = field(default_factory=dict)
+    # partition-range data plane state (the reference's per-task partition
+    # accounting, `impl_execute_task.rs:97-112` / `task_data.rs`): the
+    # task's output partitioned once per (keys, P) spec, a served set (a
+    # retried range must not double-decrement), and a remaining count —
+    # the entry self-invalidates when every partition was served. `lock`
+    # serializes build/accounting across concurrent range streams.
+    partition_spec: Optional[tuple] = None
+    partition_slices: Optional[list] = None
+    partitions_remaining: Optional[int] = None
+    partitions_served: set = field(default_factory=set)
+    lock: threading.Lock = field(default_factory=threading.Lock)
 
 
 RESERVED_HEADER_PREFIX = "x-dftpu-"
@@ -140,6 +151,9 @@ class Worker:
         self.registry = TaskRegistry(ttl_seconds)
         self.on_plan = on_plan
         self.table_store = TableStore()
+        # final progress of partition-range tasks, retained past their
+        # drop-driven invalidation (consumed once by task_progress)
+        self._final_progress: dict[TaskKey, Optional[dict]] = {}
 
     # -- control plane ------------------------------------------------------
     def set_plan(self, key: TaskKey, plan_obj: dict, task_count: int,
@@ -213,6 +227,99 @@ class Worker:
             count = min(chunk_rows, n - lo)
             yield out.slice_rows(lo, count), count * width
 
+    def execute_task_partitions(
+        self,
+        key: TaskKey,
+        key_names,
+        num_partitions: int,
+        part_lo: int,
+        part_hi: int,
+        per_dest_capacity: int = 0,
+        chunk_rows: int = 65536,
+        cancel=None,
+    ):
+        """Partition-range data plane: one stream carries partitions
+        [part_lo, part_hi) of this task's hash-partitioned output, each
+        chunk tagged with its partition id — the reference's multiplexed
+        ExecuteTask stream (`worker_connection_pool.rs:243-308` demuxes the
+        same shape into per-partition channels). The output is executed and
+        partitioned ONCE per (keys, P) spec and cached on the TaskData;
+        `partitions_remaining` decrements per served partition and the
+        registry entry self-invalidates at zero (the drop-driven accounting
+        of `impl_execute_task.rs:97-112`).
+
+        Yields (partition_id, chunk Table, est_bytes).
+        """
+        from datafusion_distributed_tpu.planner.statistics import row_width
+
+        data = self.registry.get(key)
+        if data is None:
+            raise WorkerError(
+                f"no plan for task {key} (expired or never set)",
+                worker_url=self.url,
+                task=key,
+            )
+        spec = (tuple(key_names), int(num_partitions))
+        with data.lock:
+            if data.partition_slices is None or data.partition_spec != spec:
+                out = self.execute_task(key)
+                # same hash as the in-mesh shuffle kernel, so codes
+                # co-locate across tiers (function-level import:
+                # runtime/coordinator.py imports this module at top level)
+                from datafusion_distributed_tpu.runtime.coordinator import (
+                    _shuffle_regroup,
+                )
+
+                cap = per_dest_capacity or max(int(out.capacity), 8)
+                data.partition_slices = _shuffle_regroup(
+                    [out], key_names, num_partitions, cap
+                )
+                data.partition_spec = spec
+                data.partitions_served = set()
+                data.partitions_remaining = num_partitions
+            # a concurrent stream finishing its range must not yank the
+            # slices out from under this one: hold our own reference
+            slices = data.partition_slices
+        try:
+            for p in range(part_lo, min(part_hi, num_partitions)):
+                piece = slices[p]
+                n = int(piece.num_rows)
+                width = row_width(piece.schema())
+                if n == 0:
+                    yield p, piece.slice_rows(0, 0), 0
+                else:
+                    for lo in range(0, n, max(chunk_rows, 1)):
+                        if cancel is not None and cancel.is_set():
+                            return
+                        count = min(chunk_rows, n - lo)
+                        yield p, piece.slice_rows(lo, count), count * width
+                with data.lock:
+                    if p not in data.partitions_served:
+                        data.partitions_served.add(p)
+                        data.partitions_remaining -= 1
+        finally:
+            with data.lock:
+                done = data.partitions_remaining is not None and (
+                    data.partitions_remaining <= 0
+                )
+            if done:
+                # metrics fire on last drop (impl_execute_task.rs:97-112):
+                # retain the final progress past the invalidation so the
+                # consumer's post-stream progress read still sees it
+                self._stash_final_progress(key)
+                self.registry.invalidate(key)
+
+    def partitions_remaining(self, key: TaskKey) -> Optional[int]:
+        data = self.registry.get(key)
+        return None if data is None else data.partitions_remaining
+
+    def _stash_final_progress(self, key: TaskKey) -> None:
+        """Bounded retention (a worker serving many queries must not grow
+        this forever when nobody reads the final progress back)."""
+        if len(self._final_progress) > 256:
+            self._final_progress.pop(next(iter(self._final_progress)))
+        self._final_progress[key] = self.task_progress(key)
+
     # -- observability ------------------------------------------------------
     def get_info(self) -> dict:
         return {"url": self.url, "version": self.version,
@@ -221,7 +328,7 @@ class Worker:
     def task_progress(self, key: TaskKey) -> Optional[dict]:
         data = self.registry.get(key)
         if data is None:
-            return None
+            return self._final_progress.pop(key, None)
         return {
             "plan_added_at": data.plan_added_at,
             "executed_at": data.executed_at,

@@ -383,3 +383,48 @@ def test_preinjected_partitioned_root_gets_coalesced(mesh):
     )
     np.testing.assert_array_equal(out["k"], exp["k"])
     np.testing.assert_allclose(out["s"], exp["s"], rtol=FLOAT_RTOL)
+
+
+def test_range_sort_exact_order_all_tiers(mesh):
+    """Distributed sample sort (RangeShuffleExchangeExec): unlimited ORDER
+    BY over large data must reproduce the single-node row order EXACTLY on
+    every tier — the concat of range-partitioned, locally-sorted shards in
+    axis order IS the global order (no sort above the gather)."""
+    import pandas as pd
+
+    from datafusion_distributed_tpu.runtime.coordinator import (
+        AdaptiveCoordinator,
+        Coordinator,
+        InMemoryCluster,
+    )
+    from datafusion_distributed_tpu.sql.context import SessionContext
+
+    rng = np.random.default_rng(5)
+    n = 12000
+    arrow = pa.table({
+        "k": rng.integers(-500, 500, n).astype("int64"),
+        "s": rng.choice(["ant", "bee", "cat", "dog", "elk"], n),
+        "v": rng.normal(size=n),
+    })
+    ctx = SessionContext()
+    ctx.register_arrow("t", arrow)
+    ctx.config.distributed_options["bytes_per_task"] = 1
+    ctx.config.distributed_options["range_sort_threshold_rows"] = 64
+    df = ctx.sql("select k, s from t where v > 0 order by s desc, k")
+    assert "RangeShuffleExchange" in df.explain_distributed(8)
+    single = df.to_pandas().reset_index(drop=True)
+
+    m = df._strip_quals(
+        df.collect_distributed_table(num_tasks=8)
+    ).to_pandas().reset_index(drop=True)
+    m.columns = list(single.columns)
+    pd.testing.assert_frame_equal(m, single)
+
+    cluster = InMemoryCluster(4)
+    for cls in (Coordinator, AdaptiveCoordinator):
+        coord = cls(resolver=cluster, channels=cluster)
+        got = df._strip_quals(
+            df.collect_coordinated_table(coordinator=coord, num_tasks=4)
+        ).to_pandas().reset_index(drop=True)
+        got.columns = list(single.columns)
+        pd.testing.assert_frame_equal(got, single)

@@ -39,17 +39,38 @@ def test_aggregate_plan_shape(ctx):
         "select l_returnflag, sum(l_quantity) q from lineitem "
         "group by l_returnflag order by l_returnflag"
     ).explain_distributed(4))
+    # small unlimited ORDER BY (under range_sort_threshold_rows): gather
+    # then one final sort; data above the threshold instead plans as a
+    # distributed sample sort (see test_range_sort_plan_shape)
     assert tree == """\
 Sort: [l_returnflag ASC]
   CoalesceExchange tasks=4 ── stage 1 boundary
-    Sort: [l_returnflag ASC]
-      Projection: __g0 AS l_returnflag, __a0 AS q
-        HashAggregate mode=final gby=[__g0] aggs=[sum(__in___a0)] slots=N
-          ShuffleExchange keys=[__g0] tasks=4 per_dest_cap=N ── stage 0 boundary
-            HashAggregate mode=partial gby=[__g0] aggs=[sum(__in___a0)] slots=N
-              Projection: lineitem.l_returnflag AS __g0, lineitem.l_quantity AS __in___a0
-                Projection: l_quantity AS lineitem.l_quantity, l_returnflag AS lineitem.l_returnflag
-                  MemoryScan tasks=4 cap=N"""
+    Projection: __g0 AS l_returnflag, __a0 AS q
+      HashAggregate mode=final gby=[__g0] aggs=[sum(__in___a0)] slots=N
+        ShuffleExchange keys=[__g0] tasks=4 per_dest_cap=N ── stage 0 boundary
+          HashAggregate mode=partial gby=[__g0] aggs=[sum(__in___a0)] slots=N
+            Projection: lineitem.l_returnflag AS __g0, lineitem.l_quantity AS __in___a0
+              Projection: l_quantity AS lineitem.l_quantity, l_returnflag AS lineitem.l_returnflag
+                MemoryScan tasks=4 cap=N"""
+
+
+def test_range_sort_plan_shape(ctx):
+    # unlimited ORDER BY over large data = distributed sample sort:
+    # range-shuffle on the sort key, local sort per task, order-preserving
+    # coalesce — and NO sort above the gather (concat in axis order IS the
+    # global order)
+    ctx.config.distributed_options["range_sort_threshold_rows"] = 64
+    try:
+        tree = normalize(ctx.sql(
+            "select l_orderkey, l_extendedprice from lineitem "
+            "order by l_extendedprice desc"
+        ).explain_distributed(4))
+    finally:
+        del ctx.config.distributed_options["range_sort_threshold_rows"]
+    assert "RangeShuffleExchange keys=[l_extendedprice DESC]" in tree
+    first = tree.splitlines()[0]
+    assert first.startswith("CoalesceExchange"), first
+    assert tree.index("Sort:") > tree.index("CoalesceExchange")
 
 
 def test_broadcast_join_plan_shape(ctx):

@@ -306,3 +306,61 @@ def test_grpc_metrics_collected():
         assert any(m and "elapsed_s" in m for m in coord.metrics.values())
     finally:
         cluster.shutdown()
+
+
+def test_partition_range_accounting():
+    """Partition-range data plane (`worker_connection_pool.rs:243-308`):
+    two disjoint range requests serve the task's hash-partitioned output
+    once, chunks arrive tagged by partition, and the registry entry
+    self-invalidates only after EVERY partition was served (the drop-driven
+    accounting of `impl_execute_task.rs:97-112`)."""
+    rng = np.random.default_rng(3)
+    arrow = pa.table({"k": rng.integers(0, 40, 500), "v": rng.normal(size=500)})
+    t = arrow_to_table(arrow)
+    scan = MemoryScanExec([t], t.schema())
+
+    w = Worker()
+    key = TaskKey("q", 0, 0)
+    store = TableStore()
+    plan_obj = encode_plan(scan, store)
+    for tid, tbl in store.tables.items():
+        w.table_store.tables[tid] = tbl
+    w.set_plan(key, plan_obj, task_count=1)
+
+    got: dict[int, int] = {}
+    for p, piece, _est in w.execute_task_partitions(
+        key, ["k"], 4, 0, 2, chunk_rows=64
+    ):
+        got[p] = got.get(p, 0) + int(piece.num_rows)
+    assert set(got) <= {0, 1}
+    assert w.partitions_remaining(key) == 2  # half served, entry alive
+    for p, piece, _est in w.execute_task_partitions(
+        key, ["k"], 4, 2, 4, chunk_rows=64
+    ):
+        got[p] = got.get(p, 0) + int(piece.num_rows)
+    assert sum(got.values()) == 500
+    # all partitions served -> drop-driven invalidation
+    assert w.registry.get(key) is None
+
+
+def test_shuffle_partition_streams_match_bulk():
+    """The static coordinator's partition-stream shuffle equals the
+    adaptive coordinator's bulk regroup (same hash, different plane), and
+    records the demux in stream_metrics."""
+    from datafusion_distributed_tpu.runtime.coordinator import (
+        AdaptiveCoordinator,
+    )
+
+    plan, arrow = sample_plan(3000, seed=9)
+    dplan = distribute_plan(plan, DistributedConfig(num_tasks=NT))
+    cluster = InMemoryCluster(3)
+    coord = Coordinator(resolver=cluster, channels=cluster)
+    out = coord.execute(dplan).to_pandas()
+    assert any(
+        "partitions" in m for m in coord.stream_metrics.values()
+    ), "partition-stream plane was not used for the shuffle"
+    acoord = AdaptiveCoordinator(resolver=cluster, channels=cluster)
+    exp = acoord.execute(dplan).to_pandas()
+    np.testing.assert_array_equal(out["k"], exp["k"])
+    np.testing.assert_allclose(out["sv"], exp["sv"], rtol=FLOAT_RTOL)
+    np.testing.assert_array_equal(out["n"], exp["n"])
