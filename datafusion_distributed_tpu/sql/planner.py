@@ -208,7 +208,8 @@ class PhysicalPlanner:
             by_name = dict(zip([a.name for a in node.aggs], specs))
             plain_specs = [by_name[a.name] for a in regular]
             groups_ndv = self._exprs_ndv(node.child,
-                                         [e for e, _ in node.groups])
+                                         [e for e, _ in node.groups],
+                                         loose=True)
             slots = self._agg_slots(proj.output_capacity(), groups_ndv)
             base_slots = 16 if not group_names else slots
             combined = HashAggregateExec(
@@ -219,7 +220,8 @@ class PhysicalPlanner:
             for i, a in enumerate(distinct_aggs):
                 s = by_name[a.name]
                 dedup_ndv = self._exprs_ndv(
-                    node.child, [e for e, _ in node.groups] + [a.arg]
+                    node.child, [e for e, _ in node.groups] + [a.arg],
+                    loose=True,
                 )
                 dedup = HashAggregateExec(
                     "single", group_names + [s.input_name], [], proj,
@@ -257,6 +259,7 @@ class PhysicalPlanner:
             inner_ndv = self._exprs_ndv(
                 node.child,
                 [e for e, _ in node.groups] + [a.arg for a in node.aggs],
+                loose=True,
             )
             slots = self._agg_slots(proj.output_capacity(), inner_ndv)
             dedup = HashAggregateExec("single", inner_groups, [], proj, slots)
@@ -264,7 +267,8 @@ class PhysicalPlanner:
                 AggSpec("count", s.input_name, s.output_name) for s in specs
             ]
             groups_ndv = self._exprs_ndv(node.child,
-                                         [e for e, _ in node.groups])
+                                         [e for e, _ in node.groups],
+                                         loose=True)
             slots2 = self._agg_slots(dedup.output_capacity(), groups_ndv)
             out = HashAggregateExec(
                 "single", group_names, outer_specs, dedup, slots2
@@ -273,7 +277,8 @@ class PhysicalPlanner:
                 out.est_rows = float(groups_ndv)
             return out
 
-        groups_ndv = self._exprs_ndv(node.child, [e for e, _ in node.groups])
+        groups_ndv = self._exprs_ndv(node.child, [e for e, _ in node.groups],
+                                     loose=True)
         slots = self._agg_slots(proj.output_capacity(), groups_ndv)
         out = HashAggregateExec("single", group_names, specs, proj, slots)
         if groups_ndv:
@@ -339,12 +344,24 @@ class PhysicalPlanner:
         return by_cap
 
     def _exprs_ndv(self, child: lg.LogicalPlan,
-                   exprs: Sequence[pe.PhysicalExpr]) -> Optional[int]:
+                   exprs: Sequence[pe.PhysicalExpr],
+                   loose: bool = False) -> Optional[int]:
         """Distinct-count estimate for a tuple of expressions, or None.
 
-        Only direct base-table column references resolve (via the catalog's
-        sampled NDV, the statistics role of DataFusion's table providers in
-        the reference); any derived expression makes the tuple unknown.
+        Two modes:
+        - strict (default): direct base-table column references (via the
+          catalog's sampled NDV), followed through projection ALIASES; any
+          derived expression makes the tuple unknown. Safe for selectivity
+          (1/NDV) estimates.
+        - loose=True: additionally derives UPPER bounds for common derived
+          shapes — calendar parts (EXTRACT/DATE_TRUNC caps), unary
+          value-preserving ops, binary arithmetic (ndv product),
+          boolean-valued ops (3), CASE/COALESCE (branch sums). Upper
+          bounds are only safe for capacity SIZING (an overestimate just
+          pads; q9's (nation, o_year) aggregate sized 2M slots for a true
+          NDV of ~175 without them) — NOT for 1/NDV selectivity, where a
+          loose bound inverts into an underestimate.
+
         Products over multiple keys ignore correlation, which biases the
         multi-key estimate *upward* (joins can't mint new key values).
         Per-column estimates, however, come from a strided SAMPLE: below
@@ -356,6 +373,8 @@ class PhysicalPlanner:
         if ndv_fn is None:
             return None
         aliases: dict[str, str] = {}
+        proj_map: dict = {}
+        _poisoned = object()
         stack = [child]
         while stack:
             n = stack.pop()
@@ -368,19 +387,112 @@ class PhysicalPlanner:
                     aliases[n.alias] = None
                 else:
                     aliases[n.alias] = n.table
+            elif isinstance(n, lg.LProject):
+                # projection aliases let bounds see THROUGH derived columns
+                # (q9 groups by a subquery's `o_year` = EXTRACT alias);
+                # a name bound to different exprs in different branches is
+                # ambiguous -> poisoned
+                for e, name in n.exprs:
+                    if name in proj_map and proj_map[name] is not e:
+                        proj_map[name] = _poisoned
+                    else:
+                        proj_map.setdefault(name, e)
             stack.extend(n.children())
-        est = 1
-        for e in exprs:
-            if not isinstance(e, pe.Col) or "." not in e.name:
+        # calendar-part cardinality caps (EXTRACT/DATE_TRUNC derive columns
+        # with small, known ranges — without these, a GROUP BY on
+        # EXTRACT(YEAR ...) falls back to row-count sizing: q9's (nation,
+        # o_year) aggregate was handed 2M slots for a true NDV of ~175)
+        part_caps = {
+            "year": 200, "month": 12, "moy": 12, "quarter": 4, "qoy": 4,
+            "day": 31, "dom": 31, "dow": 7, "doy": 366, "week": 53,
+            "hour": 24, "minute": 60, "second": 60,
+        }
+        trunc_caps = {"year": 200, "quarter": 800, "month": 2400,
+                      "week": 11000, "day": 75000}
+
+        def col_ndv(e: pe.Col) -> Optional[int]:
+            if "." not in e.name:
                 return None
             alias, col = e.name.split(".", 1)
             table = aliases.get(alias)
             if table is None:
                 return None
             ndv = ndv_fn(table, col)
-            if not ndv:
+            return int(ndv) if ndv else None
+
+        def bound(e, depth: int = 0) -> Optional[int]:
+            """Distinct count (strict) or upper bound (loose), or None."""
+            if depth > 8:  # projection-chain guard
                 return None
-            est *= int(ndv)
+            if isinstance(e, pe.Col):
+                direct = col_ndv(e)
+                if direct is not None:
+                    return direct
+                sub = proj_map.get(e.name)
+                if sub is not None and sub is not _poisoned and not (
+                    isinstance(sub, pe.Col) and sub.name == e.name
+                ):
+                    return bound(sub, depth + 1)
+                return None
+            if isinstance(e, pe.Literal):
+                return 1
+            if not loose:
+                return None
+            if isinstance(e, (pe.BooleanOp, pe.Not, pe.IsNull, pe.Like,
+                              pe.InList)):
+                return 3  # true/false/NULL
+            if isinstance(e, pe.BinaryOp) and e.op in pe._CMP_OPS:
+                return 3
+            if isinstance(e, pe.Extract):
+                cap = part_caps.get(e.part.lower())
+                inner = bound(e.child, depth + 1)
+                if cap is None:
+                    return inner
+                return min(cap, inner) if inner else cap
+            if isinstance(e, pe.DateTrunc):
+                cap = trunc_caps.get(e.unit.lower())
+                inner = bound(e.child, depth + 1)
+                if cap is None:
+                    return inner
+                return min(cap, inner) if inner else cap
+            if isinstance(e, (pe.Substring, pe.StringCase, pe.Cast,
+                              pe.Abs, pe.Round, pe.StrLength)):
+                return bound(e.children()[0], depth + 1)
+            if isinstance(e, pe.BinaryOp):
+                l, r = bound(e.left, depth + 1), bound(e.right, depth + 1)
+                if l and r:
+                    return l * r  # upper bound; correlation only shrinks it
+                return None
+            if isinstance(e, pe.Case):
+                # value space = union of branch values (+ otherwise/NULL)
+                total = 0
+                for _, v in e.branches:
+                    b = bound(v, depth + 1)
+                    if b is None:
+                        return None
+                    total += b
+                if e.otherwise is not None:
+                    b = bound(e.otherwise, depth + 1)
+                    if b is None:
+                        return None
+                    total += b
+                return total + 1
+            if isinstance(e, pe.Coalesce):
+                total = 0
+                for c in e.children():
+                    b = bound(c, depth + 1)
+                    if b is None:
+                        return None
+                    total += b
+                return total
+            return None
+
+        est = 1
+        for e in exprs:
+            b = bound(e)
+            if not b:
+                return None
+            est *= int(b)
         return est
 
     def _distinct(self, child: ExecutionPlan) -> ExecutionPlan:
