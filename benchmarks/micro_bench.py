@@ -136,6 +136,7 @@ def main() -> None:
             broadcast_exchange,
             group_coalesce_exchange,
             partition_table,
+            range_shuffle_exchange,
             shuffle_exchange,
         )
         from datafusion_distributed_tpu.runtime.mesh_executor import (
@@ -174,6 +175,11 @@ def main() -> None:
         gco = mk(lambda t_: group_coalesce_exchange(t_, AXIS, nt, 2))
         report("coalesce_n_to_2_ppermute", _timeit(gco, stacked,
                                                    repeats=args.repeats))
+        rs_per_dest = round_up_pow2(max(4 * n // (nt * nt), 64))
+        rsh = mk(lambda t_: range_shuffle_exchange(
+            t_, [SortKey("v")], AXIS, nt, rs_per_dest))
+        report("range_shuffle_sample_sort", _timeit(rsh, stacked,
+                                                    repeats=args.repeats))
 
     # ---- pallas claim-loop vs XLA claim loop ------------------------------
     from datafusion_distributed_tpu.ops.pallas_hash import (

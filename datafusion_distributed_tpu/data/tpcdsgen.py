@@ -144,8 +144,14 @@ def _pick(rng, pool, n):
 
 
 def _ids(prefix: str, keys: np.ndarray) -> np.ndarray:
+    # digits pad to exactly 16 chars INCLUDING the prefix. (A fixed
+    # 16-digit format truncated to 16 chopped the LAST digit, colliding
+    # ids 0-9 — 500 customers shared 51 c_customer_ids, which broke
+    # business-key uniqueness and made q74-class ORDER BY ... LIMIT
+    # tie-arbitrary across execution tiers.)
+    width = 16 - len(prefix)
     return np.asarray(
-        [f"{prefix}{k:016d}"[:16] for k in keys], dtype=object
+        [f"{prefix}{k:0{width}d}" for k in keys], dtype=object
     )
 
 
