@@ -140,12 +140,29 @@ def resize_for_inputs(
                 ),
             )
         elif isinstance(node, HashJoinExec):
+            # honor the node's expansion_factor: it encodes the planner's
+            # fanout knowledge AND the overflow-retry's 4x widening — with
+            # a bare skew_headroom the retry loop replans wider and this
+            # resize immediately shrinks back to the same overflowing
+            # capacity (observed: q95's order-number self-join never
+            # converged in adaptive mode)
+            from datafusion_distributed_tpu.plan.joins import (
+                _MAX_DERIVED_JOIN_CAPACITY,
+            )
+
+            grow = max(skew_headroom, node.expansion_factor)
+            # same derived-capacity ceiling as the constructor: widened
+            # retry factors must not demand terabyte buffers
+            ceiling = max(
+                _MAX_DERIVED_JOIN_CAPACITY,
+                round_up_pow2(max(int(input_info.rows), 8)),
+            )
             node = HashJoinExec(
                 node.probe, node.build, node.probe_keys, node.build_keys,
                 node.join_type, node.residual,
-                out_capacity=round_up_pow2(
-                    max(int(input_info.rows * skew_headroom), 16)
-                ),
+                out_capacity=min(round_up_pow2(
+                    max(int(input_info.rows * grow), 16)
+                ), ceiling),
                 num_slots=node.num_slots,
                 mark_name=node.mark_name,
                 expansion_factor=node.expansion_factor,

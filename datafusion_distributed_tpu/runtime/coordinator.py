@@ -583,6 +583,11 @@ class AdaptiveCoordinator(Coordinator):
     #: safety margin applied to extrapolated rows (underestimating a
     #: capacity costs an overflow-retry; overestimating only pads)
     extrapolation_headroom: float = 1.25
+    #: resize_for_inputs headroom; quadruples after an overflow so the
+    #: session's overflow-retry CONVERGES — otherwise each retry replans
+    #: wider and the adaptive resize shrinks straight back to the same
+    #: overflowing capacity
+    resize_headroom: float = 2.0
 
     def execute(self, plan: ExecutionPlan) -> Table:
         self._load_info: dict[int, object] = {}
@@ -593,7 +598,16 @@ class AdaptiveCoordinator(Coordinator):
         #: surface proving the decision predates producer completion
         self.partial_decisions: dict[int, tuple[int, int]] = {}
         self._solo_shuffles = _find_solo_shuffles(plan)
-        return super().execute(plan)
+        try:
+            out = super().execute(plan)
+        except RuntimeError as e:
+            if "overflow" in str(e):
+                self.resize_headroom *= 4
+            raise
+        # success: back to the default so one query's widening does not
+        # permanently inflate every later query on this coordinator
+        self.resize_headroom = type(self).resize_headroom
+        return out
 
     def _partition_streams_enabled(self, exchange) -> bool:
         # adaptive mode recomputes consumer task counts from exact
@@ -660,8 +674,9 @@ class AdaptiveCoordinator(Coordinator):
         return t
 
     def _prepare_stage_plan(self, stage_plan):
-        """Resize stage capacities from EXACT materialized input stats —
-        applied by BOTH the bulk and streaming dispatch paths."""
+        """Resize stage capacities from runtime LoadInfo (exact or
+        partial-sample-predicted) — applied by BOTH the bulk and streaming
+        dispatch paths."""
         info = self._stage_input_info(stage_plan)
         if info is None:
             return stage_plan
@@ -669,7 +684,8 @@ class AdaptiveCoordinator(Coordinator):
             resize_for_inputs,
         )
 
-        return resize_for_inputs(stage_plan, info)
+        return resize_for_inputs(stage_plan, info,
+                                 skew_headroom=self.resize_headroom)
 
     def _stage_input_info(self, stage_plan):
         from datafusion_distributed_tpu.planner.adaptive import (

@@ -11,6 +11,7 @@ runtime/coordinator.py, which shells out to this executor per mesh.)
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import jax
@@ -52,7 +53,15 @@ except ImportError:  # pragma: no cover - depends on jax version
 
 # Re-executing the SAME plan object on the same mesh reuses the compiled
 # SPMD program (the reference's cached TaskData plan re-execution analogue).
+# Small LRU: entries are whole compiled multi-stage SPMD executables (tens
+# to hundreds of MB each on the CPU backend) and are only ever reused for
+# the SAME plan object — across different queries they are dead weight.
+# A 99-query sweep in one process accumulated >100 GB before the OOM
+# killer took it at the old cap of 256. Workloads that ALTERNATE among
+# more than the cap's worth of memoized plans (dashboard refresh loops)
+# can raise DFTPU_MESH_CACHE to trade memory for recompiles.
 _MESH_COMPILE_CACHE: dict = {}
+_MESH_COMPILE_CACHE_CAP = int(os.environ.get("DFTPU_MESH_CACHE", "8"))
 
 
 def make_mesh(num_tasks: Optional[int] = None, devices=None) -> Mesh:
@@ -139,9 +148,13 @@ def execute_on_mesh(
     in_specs = jax.tree.map(lambda _: P(AXIS), stacked_inputs)
     cache_key = (plan.node_id, tuple(d.id for d in mesh.devices.flat))
     cached = _MESH_COMPILE_CACHE.get(cache_key)
+    if cached is not None:
+        # move-to-end: LRU eviction must not take the entry being reused
+        _MESH_COMPILE_CACHE.pop(cache_key)
+        _MESH_COMPILE_CACHE[cache_key] = cached
     if cached is None:
-        if len(_MESH_COMPILE_CACHE) >= 256:
-            _MESH_COMPILE_CACHE.clear()
+        while len(_MESH_COMPILE_CACHE) >= _MESH_COMPILE_CACHE_CAP:
+            _MESH_COMPILE_CACHE.pop(next(iter(_MESH_COMPILE_CACHE)))
         fn = jax.jit(
             shard_map(
                 run,

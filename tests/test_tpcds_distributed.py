@@ -43,9 +43,10 @@ from test_tpcds import ALL, SEED, SF, _sql  # noqa: F401
 
 # pinned CI subset: one query per major shape family + the tier bugs the
 # full sweep has caught (q5 coordinator arm loss, q49 mesh dictionary
-# divergence)
+# divergence, q74 id-collision tie-instability, q95 adaptive resize
+# non-convergence)
 SUBSET = ["q3", "q5", "q7", "q19", "q25", "q42", "q49", "q52", "q55",
-          "q59", "q65", "q79", "q88", "q93", "q96", "q98"]
+          "q59", "q65", "q74", "q79", "q88", "q93", "q95", "q96", "q98"]
 
 
 def _shard(queries):
