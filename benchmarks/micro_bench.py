@@ -189,11 +189,9 @@ def main() -> None:
     from datafusion_distributed_tpu.ops import pallas_hash as _ph
 
     hb_slots = round_up_pow2(max(n // 16, 64))
-    if (
-        pallas_available()
-        and n <= _ph._MAX_VMEM_ROWS
-        and hb_slots <= _ph._MAX_VMEM_SLOTS
-    ):
+    # gate on the partitioned-table bound: the row-blocked multi-pass
+    # kernel handles any row count and up to _MAX_PARTITIONS sub-tables
+    if pallas_available() and hb_slots <= _ph._MAX_TABLE_SLOTS:
         from datafusion_distributed_tpu.ops.aggregate import (
             build_group_table,
         )

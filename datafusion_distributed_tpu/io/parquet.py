@@ -76,6 +76,19 @@ def arrow_to_host_columns(
     import pyarrow.compute as pc
 
     schema = schema_from_arrow(arrow_table.schema)
+    meta = arrow_table.schema.metadata or {}
+    if b"dftpu_logical" in meta:
+        # wire payloads carry their LOGICAL dtypes (runtime/codec.py): the
+        # physical arrow width reflects the sender's precision mode, not
+        # the column's logical type
+        import json as _json
+
+        logical = _json.loads(meta[b"dftpu_logical"].decode())
+        schema = Schema([
+            Field(f.name, DataType(logical.get(f.name, f.dtype.value)),
+                  f.nullable)
+            for f in schema.fields
+        ])
     data: dict[str, np.ndarray] = {}
     validity: dict[str, np.ndarray] = {}
     dicts: dict[str, Dictionary] = {}
@@ -85,6 +98,37 @@ def arrow_to_host_columns(
             col = col.combine_chunks()
         null_mask = np.asarray(col.is_valid())
         if f.dtype == DataType.STRING:
+            provided0 = dictionaries.get(f.name) if dictionaries else None
+            if pa.types.is_dictionary(col.type) and provided0 is None:
+                # wire fast path: a dictionary array arriving from
+                # encode_table carries a GC'd, SORTED dictionary — adopt it
+                # and its codes directly instead of decoding + re-uniquing
+                # (the receive half of the reference's dictionary handling,
+                # `impl_execute_task.rs:184-201` DictionaryHandling::Resend)
+                dvals = np.asarray(
+                    col.dictionary.to_numpy(zero_copy_only=False),
+                    dtype=object,
+                )
+                sv = dvals.astype(str)
+                # STRICTLY ascending == sorted AND duplicate-free: a
+                # user-supplied dictionary array with repeated values must
+                # fall through to the canonicalizing decode+re-unique path
+                # (duplicate entries would give equal strings distinct
+                # codes, splitting their groups)
+                if len(sv) < 2 or bool(np.all(sv[:-1] < sv[1:])):
+                    import pyarrow.compute as pc
+
+                    idx = col.indices
+                    if not null_mask.all():
+                        idx = pc.fill_null(idx, 0)
+                    codes = np.asarray(
+                        idx.to_numpy(zero_copy_only=False)
+                    ).astype(np.int32)
+                    codes = np.where(null_mask, codes, 0).astype(np.int32)
+                    data[f.name] = codes
+                    dicts[f.name] = Dictionary(dvals)
+                    validity[f.name] = null_mask
+                    continue
             if pa.types.is_dictionary(col.type):
                 col = col.cast(pa.string())
             values = np.asarray(col.to_numpy(zero_copy_only=False), dtype=object)

@@ -115,13 +115,14 @@ def build_group_table(
 
         if (
             pallas_hash.use_pallas_hash()
-            and num_slots <= pallas_hash._MAX_VMEM_SLOTS
-            and n <= pallas_hash._MAX_VMEM_ROWS
+            and num_slots <= pallas_hash._MAX_TABLE_SLOTS
         ):
-            # experimental VMEM-resident build (DFTPU_PALLAS=1): grouping
-            # is consistent with the claim loop below, but the slot LAYOUT
-            # may differ (sequential vs min-row-id claim resolution) — see
-            # ops/pallas_hash.py for the trade-off being measured
+            # VMEM-resident build (DFTPU_PALLAS=1): row-blocked grid,
+            # partitioned multi-pass for tables beyond one VMEM block.
+            # Grouping is consistent with the claim loop below, but the
+            # slot LAYOUT may differ (sequential partition-confined vs
+            # min-row-id claim resolution) — see ops/pallas_hash.py for
+            # the trade-off being measured
             interpret = jax.default_backend() != "tpu"
             gid_p, tkeys_p, used_p, over_p = (
                 pallas_hash.pallas_build_group_ids(
@@ -221,6 +222,7 @@ def hash_aggregate(
     num_slots: int,
     mode: str = "single",  # "single" | "partial" | "final" | "partial_reduce"
     prec_flags: Optional[list] = None,
+    out_capacity: Optional[int] = None,
 ) -> tuple[Table, jnp.ndarray]:
     """GROUP BY aggregation. Returns (result table, overflow flag).
 
@@ -260,12 +262,22 @@ def hash_aggregate(
                       prec_flags)
         )
 
-    # Pack used slots to the front.
+    # Pack used slots to the front — into a TIGHTER capacity when the
+    # caller supplies one. The hash table stays wide for short probe
+    # chains, but the OUTPUT (which downstream sorts/joins pay capacity-
+    # proportional work for) only needs to hold the groups: group count is
+    # bounded by live input rows, so a bound of pow2(input capacity) can
+    # never overflow, and an NDV-derived bound folds into the overflow
+    # flag (the session retry widens it like any other capacity).
     packed = Table.make(out_cols, gt.num_groups)
     keep = gt.slot_used
-    (idx,) = jnp.nonzero(keep, size=num_slots, fill_value=0)
+    out_cap = min(out_capacity or num_slots, num_slots)
+    (idx,) = jnp.nonzero(keep, size=out_cap, fill_value=0)
     packed = packed.gather(idx, gt.num_groups)
-    return packed, gt.overflow
+    overflow = gt.overflow
+    if out_cap < num_slots:
+        overflow = overflow | (gt.num_groups > out_cap)
+    return packed, overflow
 
 
 def global_aggregate(table: Table, aggs: Sequence[AggSpec], mode: str = "single",

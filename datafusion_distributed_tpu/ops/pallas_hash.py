@@ -1,25 +1,44 @@
-"""Pallas claim-loop hash-table build (experimental TPU kernel).
+"""Pallas claim-loop hash-table build (TPU kernel).
 
 SURVEY.md §7 hard part (b): the XLA claim loop (ops/aggregate.py
 build_group_table) runs O(probe-chain) ROUNDS, each a full HBM pass over all
 rows plus scatters into the [H, lanes] table. This kernel is the
-VMEM-resident alternative: one sequential pass over the rows with the whole
+VMEM-resident alternative: sequential passes over the rows with the (sub-)
 table held in VMEM, so each probe is an on-chip read instead of an HBM
 round.
 
+Production shape (round 5; the round-4 version staged everything as single
+VMEM blocks and was gated to 2^16 slots / 2^18 rows):
+
+- **Row blocking.** Rows stream through a grid dimension in blocks of
+  2^15; the table lives in VMEM *scratch*, which persists across grid
+  steps (TPU grids execute sequentially), so row count is unbounded.
+- **Tables > VMEM: hash-partitioned multi-pass.** A table of H slots is
+  split into P = H / 2^16 contiguous partitions; pass p holds only
+  partition p in VMEM and processes only the rows whose initial probe slot
+  falls in it (same hash => same partition, so a key's whole chain is
+  confined to one partition). Cost: P sequential passes over the row
+  stream — the classic partitioned hash build, trading row-stream reads
+  (sequential HBM bandwidth) for table residency. **Collision strategy**:
+  linear probing WITHIN the partition (slot = base + ((local0 + k) mod
+  H/P)); a full partition raises the overflow flag (the session's
+  capacity-retry loop widens the table, exactly as for the XLA path —
+  hash uniformity keeps per-partition skew < a few % at the 2x load
+  factor the planner sizes for).
+
 Trade-off being measured (benchmarks/micro_bench.py hashbuild_* rows):
-- XLA claim loop: massively parallel per round, ~rounds × N × lanes HBM
-  traffic; great when chains are short (table ≥ 2×NDV).
-- This kernel: ZERO HBM traffic per probe (table in VMEM, ≤ ~1M slots),
-  but row processing is sequential on the scalar unit — throughput is
-  bounded by probe-chain length × scalar-op latency, not bandwidth.
+- XLA claim loop: massively parallel per round, ~rounds x N x lanes HBM
+  traffic; great when chains are short (table >= 2x NDV).
+- This kernel: ZERO HBM traffic per probe (sub-table in VMEM), but row
+  processing is sequential on the scalar unit — throughput is bounded by
+  probe-chain length x scalar-op latency, not bandwidth.
 
 The engine uses the XLA path by default; DFTPU_PALLAS=1 switches
 build_group_table's group-id assignment to this kernel where legal
-(single-device, table fits VMEM). On CPU the kernel runs in interpret mode
-(correctness tests); perf claims are only meaningful on a real chip — the
-micro-bench prints both paths so BENCH notes can record the verdict either
-way.
+(single-device, table <= _MAX_TABLE_SLOTS). On CPU the kernel runs in
+interpret mode (correctness tests); perf claims are only meaningful on a
+real chip — the micro-bench prints both paths so BENCH notes can record
+the verdict either way.
 """
 
 from __future__ import annotations
@@ -31,11 +50,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-# VMEM is ~16 MiB/core. This kernel stages EVERYTHING as single VMEM
-# blocks — the [H, L] table AND the [N, L] keys / [N] slot0/live/gid rows
-# (row blocking over a grid is future work), so both dimensions are gated.
+# One VMEM-resident table partition: [2^16, lanes] i32 + used flags is
+# ~1.5 MiB at 4 lanes, comfortably inside the ~16 MiB/core budget next to
+# a 2^15-row key block.
 _MAX_VMEM_SLOTS = 1 << 16
-_MAX_VMEM_ROWS = 1 << 18  # ~4 MiB of i32 rows at 2 lanes + gid/slot0/live
+_ROW_BLOCK = 1 << 15
+# Beyond 16 partitions the P full row passes stop paying for residency;
+# the XLA claim loop takes over (its rounds scale with chain length, not
+# table size).
+_MAX_PARTITIONS = 16
+_MAX_TABLE_SLOTS = _MAX_VMEM_SLOTS * _MAX_PARTITIONS
+
+# (the legacy _MAX_VMEM_ROWS row gate is gone: row blocking removed it)
 
 
 def pallas_available() -> bool:
@@ -61,91 +87,169 @@ def pallas_build_group_ids(
 ):
     """-> (gid [N] i32, slot_keys [H, L] i32, slot_used [H] bool,
     overflow bool). Sequential insertion semantics: the first live row of a
-    key claims a slot along its probe chain. Grouping is consistent with
-    the XLA claim loop but slot layout may differ (see module docstring)."""
+    key claims a slot along its (partition-confined) probe chain. Grouping
+    is consistent with the XLA claim loop but slot layout may differ (see
+    module docstring)."""
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     n, lanes = keys_mat.shape
     h = num_slots
     assert h & (h - 1) == 0
-    if h > _MAX_VMEM_SLOTS:
-        raise ValueError(f"{h} slots exceed the VMEM budget")
-    if n > _MAX_VMEM_ROWS:
-        raise ValueError(f"{n} rows exceed the VMEM budget (no row blocking)")
+    if h > _MAX_TABLE_SLOTS:
+        raise ValueError(
+            f"{h} slots exceed {_MAX_PARTITIONS} VMEM partitions"
+        )
+    hp = min(h, _MAX_VMEM_SLOTS)
+    num_parts = h // hp
+    block = min(_ROW_BLOCK, max(
+        8, 1 << max(int(np.ceil(np.log2(max(n, 1)))), 3)
+    ))
+    n_pad = -(-n // block) * block
+    nb = n_pad // block
 
-    def kernel(keys_ref, slot0_ref, live_ref, gid_ref, tkeys_ref, used_ref,
-               over_ref):
-        # init table
-        tkeys_ref[:, :] = jnp.zeros((h, lanes), jnp.int32)
-        used_ref[:] = jnp.zeros((h,), jnp.int32)
-        over_ref[0] = jnp.int32(0)
+    keys_p = jnp.zeros((n_pad, lanes), jnp.int32).at[:n].set(
+        keys_mat.astype(jnp.int32)
+    )
+    slot0_p = jnp.zeros((n_pad,), jnp.int32).at[:n].set(
+        slot0.astype(jnp.int32)
+    )
+    live_p = jnp.zeros((n_pad,), jnp.int32).at[:n].set(live.astype(jnp.int32))
 
-        def row(i, _):
-            is_live = live_ref[i] != 0
+    def partition_pass(part: int):
+        """One pallas_call per table partition: rows stream through the
+        grid in blocks while the partition's sub-table persists in VMEM
+        scratch (TPU grids run sequentially). A separate call per
+        partition keeps each pass's state machine trivial — no
+        cross-partition output aliasing semantics to get wrong."""
 
-            # PURE probe: walk the chain reading the table; all mutation
-            # happens once, after the loop (stateful ops inside while
-            # bodies do not discharge reliably into pallas refs)
-            def probe_body(state):
-                slot, done, steps = state
-                occupied = used_ref[slot] != 0
-                match = jnp.bool_(True)
-                for l in range(lanes):
-                    match = match & (tkeys_ref[slot, l] == keys_ref[i, l])
-                resolved = jnp.logical_not(occupied) | (occupied & match)
-                nxt = jnp.where(
-                    resolved, slot, (slot + 1) & jnp.int32(h - 1)
+        def kernel(keys_ref, slot0_ref, live_ref, gid_ref,
+                   tkeys_ref, used_ref, over_ref, tk_s, used_s, over_s):
+            b = pl.program_id(0)
+
+            @pl.when(b == 0)
+            def _():
+                tk_s[:, :] = jnp.zeros((hp, lanes), jnp.int32)
+                used_s[:] = jnp.zeros((hp,), jnp.int32)
+                over_s[0] = jnp.int32(0)
+
+            def row(i, _):
+                s0 = slot0_ref[i]
+                in_part = (s0 // hp) == part
+                is_live = (live_ref[i] != 0) & in_part
+                local0 = s0 % hp
+
+                # PURE probe: walk the chain reading the sub-table; all
+                # mutation happens once, after the loop (stateful ops
+                # inside while bodies do not discharge reliably into
+                # pallas refs)
+                def probe_body(state):
+                    slot, done, steps = state
+                    occupied = used_s[slot] != 0
+                    match = jnp.bool_(True)
+                    for lane in range(lanes):
+                        match = match & (
+                            tk_s[slot, lane] == keys_ref[i, lane]
+                        )
+                    resolved = (
+                        jnp.logical_not(occupied) | (occupied & match)
+                    )
+                    nxt = jnp.where(
+                        resolved, slot, (slot + 1) % jnp.int32(hp)
+                    )
+                    return nxt, resolved, steps + 1
+
+                def probe_cond(state):
+                    _, done, steps = state
+                    return jnp.logical_not(done) & (steps < hp) & is_live
+
+                slot, done, _ = jax.lax.while_loop(
+                    probe_cond, probe_body,
+                    (local0, jnp.logical_not(is_live), jnp.int32(0)),
                 )
-                return nxt, resolved, steps + 1
+                claim = is_live & done & (used_s[slot] == 0)
 
-            def probe_cond(state):
-                _, done, steps = state
-                return jnp.logical_not(done) & (steps < h)
+                @pl.when(claim)
+                def _():
+                    for lane in range(lanes):
+                        tk_s[slot, lane] = keys_ref[i, lane]
+                    used_s[slot] = jnp.int32(1)
 
-            slot, done, _ = jax.lax.while_loop(
-                probe_cond, probe_body,
-                (slot0_ref[i], jnp.bool_(False), jnp.int32(0)),
-            )
-            claim = is_live & done & (used_ref[slot] == 0)
+                @pl.when(is_live & done)
+                def _():
+                    gid_ref[i] = jnp.int32(part * hp) + slot
 
-            @pl.when(claim)
+                @pl.when(is_live & jnp.logical_not(done))
+                def _():
+                    over_s[0] = jnp.int32(1)
+
+                @pl.when(jnp.logical_not(is_live))
+                def _():
+                    gid_ref[i] = jnp.int32(0)  # full block write, no alias
+
+                return _
+
+            jax.lax.fori_loop(0, block, row, None)
+
+            @pl.when(b == nb - 1)
             def _():
-                for l in range(lanes):
-                    tkeys_ref[slot, l] = keys_ref[i, l]
-                used_ref[slot] = jnp.int32(1)
+                tkeys_ref[:, :] = tk_s[:, :]
+                used_ref[:] = used_s[:]
 
-            @pl.when(is_live & done)
-            def _():
-                gid_ref[i] = slot
+            over_ref[0] = over_s[0]
 
-            @pl.when(is_live & jnp.logical_not(done))
-            def _():
-                over_ref[0] = jnp.int32(1)
+        return pl.pallas_call(
+            kernel,
+            grid=(nb,),
+            in_specs=[
+                pl.BlockSpec((block, lanes), lambda b: (b, 0)),
+                pl.BlockSpec((block,), lambda b: (b,)),
+                pl.BlockSpec((block,), lambda b: (b,)),
+            ],
+            out_specs=[
+                pl.BlockSpec((block,), lambda b: (b,)),
+                pl.BlockSpec((hp, lanes), lambda b: (0, 0)),
+                pl.BlockSpec((hp,), lambda b: (0,)),
+                pl.BlockSpec((1,), lambda b: (0,)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((n_pad,), jnp.int32),
+                jax.ShapeDtypeStruct((hp, lanes), jnp.int32),
+                jax.ShapeDtypeStruct((hp,), jnp.int32),
+                jax.ShapeDtypeStruct((1,), jnp.int32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((hp, lanes), jnp.int32),
+                pltpu.VMEM((hp,), jnp.int32),
+                pltpu.SMEM((1,), jnp.int32),
+            ],
+            interpret=interpret,
+        )(keys_p, slot0_p, live_p)
 
-            return _
-
-        jax.lax.fori_loop(0, n, row, None)
-
-    gid, tkeys, used, over = pl.pallas_call(
-        kernel,
-        out_shape=[
-            jax.ShapeDtypeStruct((n,), jnp.int32),
-            jax.ShapeDtypeStruct((h, lanes), jnp.int32),
-            jax.ShapeDtypeStruct((h,), jnp.int32),
-            jax.ShapeDtypeStruct((1,), jnp.int32),
-        ],
-        interpret=interpret,
-    )(keys_mat.astype(jnp.int32), slot0.astype(jnp.int32),
-      live.astype(jnp.int32))
-    return gid, tkeys, used.astype(jnp.bool_), over[0].astype(jnp.bool_)
+    gid = jnp.zeros((n_pad,), jnp.int32)
+    part_of_row = slot0_p // hp
+    tkeys_parts = []
+    used_parts = []
+    over = jnp.asarray(False)
+    for part in range(num_parts):
+        gid_p, tk_p, used_p, over_p = partition_pass(part)
+        gid = jnp.where(part_of_row == part, gid_p, gid)
+        tkeys_parts.append(tk_p)
+        used_parts.append(used_p)
+        over = over | (over_p[0] != 0)
+    tkeys = jnp.concatenate(tkeys_parts, axis=0)
+    used = jnp.concatenate(used_parts, axis=0)
+    return gid[:n], tkeys, used.astype(jnp.bool_), over
 
 
 def build_group_ids_reference(keys_mat, slot0, live, num_slots):
-    """Pure-numpy oracle for the kernel's sequential-insert semantics."""
+    """Pure-numpy oracle for the kernel's sequential-insert semantics
+    (partition-confined linear probing, partition width = _MAX_VMEM_SLOTS)."""
     keys_mat = np.asarray(keys_mat)
     slot0 = np.asarray(slot0)
     live = np.asarray(live)
     n, lanes = keys_mat.shape
+    hp = min(num_slots, _MAX_VMEM_SLOTS)
     tkeys = np.zeros((num_slots, lanes), np.int32)
     used = np.zeros(num_slots, bool)
     gid = np.zeros(n, np.int32)
@@ -153,8 +257,10 @@ def build_group_ids_reference(keys_mat, slot0, live, num_slots):
     for i in range(n):
         if not live[i]:
             continue
-        slot = int(slot0[i])
-        for _ in range(num_slots):
+        base = (int(slot0[i]) // hp) * hp
+        local = int(slot0[i]) % hp
+        for _ in range(hp):
+            slot = base + local
             if not used[slot]:
                 tkeys[slot] = keys_mat[i]
                 used[slot] = True
@@ -163,7 +269,7 @@ def build_group_ids_reference(keys_mat, slot0, live, num_slots):
             if (tkeys[slot] == keys_mat[i]).all():
                 gid[i] = slot
                 break
-            slot = (slot + 1) & (num_slots - 1)
+            local = (local + 1) % hp
         else:
             overflow = True
     return gid, tkeys, used, overflow

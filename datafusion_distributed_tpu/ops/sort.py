@@ -28,12 +28,25 @@ class SortKey:
 
 
 def sort_permutation(table: Table, keys: list[SortKey]) -> jnp.ndarray:
-    """[capacity] permutation: live rows in key order first, dead rows last."""
+    """[capacity] permutation: live rows in key order first, dead rows last.
+
+    ONE `lax.sort` call with a lexicographic operand list — most-significant
+    first: [dead-row flag, key1 null flag, key1 values, key2 ...] — instead
+    of composing per-key stable argsorts. The composed form paid up to
+    2 sorts per key + a dead-row pass over the FULL padded capacity (a
+    2-key sort over a 1M-capacity aggregate output ran 5 million-row
+    argsorts: ~2.5 s of TPC-H q3's 2.8 s wall on the CPU tier); the fused
+    form pays exactly one."""
+    import jax
+
     cap = table.capacity
-    perm = jnp.arange(cap, dtype=jnp.int32)
-    # Least-significant key first; stable sorts compose lexicographically.
-    for key in reversed(keys):
+    operands: list[jnp.ndarray] = [~table.row_mask()]  # live rows first
+    for key in keys:
         col = table.column(key.name)
+        if col.validity is not None:
+            # null placement dominates this key's value order
+            flag = col.validity if key.nulls_first else ~col.validity
+            operands.append(flag)  # False sorts first
         vals = col.data
         if vals.dtype == jnp.bool_:
             vals = vals.astype(jnp.int32)
@@ -43,17 +56,12 @@ def sort_permutation(table: Table, keys: list[SortKey]) -> jnp.ndarray:
             else:
                 # avoid signed overflow on INT_MIN: flip via complement
                 vals = ~vals if jnp.issubdtype(vals.dtype, jnp.integer) else -vals
-        perm = perm[jnp.argsort(vals[perm], stable=True)]
-        if col.validity is not None:
-            # null-flag pass dominates the value pass for this key
-            flag = (
-                col.validity if key.nulls_first else ~col.validity
-            )  # False sorts first
-            perm = perm[jnp.argsort(flag[perm].astype(jnp.int32), stable=True)]
-    # Dead rows to the tail (most significant pass of all).
-    dead = ~table.row_mask()
-    perm = perm[jnp.argsort(dead[perm].astype(jnp.int32), stable=True)]
-    return perm
+        operands.append(vals)
+    perm0 = jnp.arange(cap, dtype=jnp.int32)
+    out = jax.lax.sort(
+        tuple(operands) + (perm0,), num_keys=len(operands), is_stable=True
+    )
+    return out[-1]
 
 
 def sort_table(table: Table, keys: list[SortKey]) -> Table:

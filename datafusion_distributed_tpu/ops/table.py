@@ -50,21 +50,56 @@ _UNION_DICT_CACHE: dict = {}
 _DICT_CACHE_LOCK = threading.Lock()
 
 
+_PIN_DEPTH = 0  # guarded by _DICT_CACHE_LOCK
+_PINNED: dict = {}  # id(cache) -> set of keys untouchable by eviction
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def pin_dictionary_caches():
+    """Entries touched while ANY pin context is active are exempt from LRU
+    eviction until the last context exits. IsolatedArmExec wraps its probe +
+    lax.cond branch traces in this: LRU recency alone cannot protect an
+    entry from heavy cross-thread churn between the two traces, and a
+    re-minted Dictionary diverges the branches' pytree metadata (loud trace
+    error). Nesting-safe; caches may transiently exceed their cap while
+    everything in them is pinned."""
+    global _PIN_DEPTH
+    with _DICT_CACHE_LOCK:
+        _PIN_DEPTH += 1
+    try:
+        yield
+    finally:
+        with _DICT_CACHE_LOCK:
+            _PIN_DEPTH -= 1
+            if _PIN_DEPTH == 0:
+                _PINNED.clear()
+
+
 def lru_get_or_create(cache: dict, key, mint, cap: int):
     """Thread-safe get-or-mint with LRU eviction (python dicts preserve
     insertion order; move-to-end on hit). Shared by the dictionary
     memoization caches: identity stability across re-traces requires that
     a hit NEVER returns a different object than a concurrent or recent
-    call for the same key, and that eviction only removes cold entries."""
+    call for the same key, and that eviction only removes cold entries
+    (never one pinned by an in-progress trace, see pin_dictionary_caches)."""
     with _DICT_CACHE_LOCK:
         if key in cache:
             val = cache.pop(key)
             cache[key] = val  # move to end = most recently used
-            return val
-        val = mint()
-        cache[key] = val
+        else:
+            val = mint()
+            cache[key] = val
+        if _PIN_DEPTH > 0:
+            _PINNED.setdefault(id(cache), set()).add(key)
+        pinned = _PINNED.get(id(cache), ())
         while len(cache) > cap:
-            cache.pop(next(iter(cache)))
+            victim = next((k for k in cache if k not in pinned), None)
+            if victim is None:
+                break  # everything live-pinned: transient over-cap is fine
+            cache.pop(victim)
         return val
 
 
@@ -488,6 +523,19 @@ def concat_tables(tables: Sequence[Table], capacity: Optional[int] = None) -> Ta
         total = int(sum(int(n) for n in concrete))
         if total > total_cap:
             raise ValueError(f"concat overflow: {total} rows > capacity {total_cap}")
+        # Meshes-as-workers: inputs committed to DIFFERENT device sets
+        # (slices pulled from two worker-owned meshes) cannot feed one op;
+        # rebase through host first — the DCN hop a real multi-host
+        # deployment pays at exactly this merge point.
+        device_sets = set()
+        for t in tables:
+            for c in t.columns:
+                s = getattr(c.data, "sharding", None)
+                if s is not None:
+                    device_sets.add(frozenset(s.device_set))
+        if len(device_sets) > 1:
+            tables = [_rebase_to_host(t) for t in tables]
+            first = tables[0]
     out_cols = []
     # Destination index for each source row: offset of its table + local idx.
     offsets = []
@@ -517,6 +565,29 @@ def concat_tables(tables: Sequence[Table], capacity: Optional[int] = None) -> Ta
                 validity = validity.at[dst].set(v, mode="drop")
         out_cols.append(Column(data, validity, src_dtype, dictionary))
     return Table(names, tuple(out_cols), total_rows)
+
+
+def _rebase_to_host(t: Table) -> Table:
+    """Detach a table's arrays from their committed devices (host round
+    trip); the next consumer places them wherever it computes."""
+    import numpy as _np
+
+    def move(x):
+        return jnp.asarray(_np.asarray(x))
+
+    return Table(
+        t.names,
+        tuple(
+            Column(
+                move(c.data),
+                move(c.validity) if c.validity is not None else None,
+                c.dtype,
+                c.dictionary,
+            )
+            for c in t.columns
+        ),
+        move(t.num_rows),
+    )
 
 
 def unify_dictionaries(dicts):

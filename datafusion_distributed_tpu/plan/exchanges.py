@@ -310,6 +310,19 @@ class IsolatedArmExec(ExecutionPlan):
             return self.child.execute(ctx)
         me = jax.lax.axis_index(axis)
 
+        from datafusion_distributed_tpu.ops.table import (
+            pin_dictionary_caches,
+        )
+
+        with pin_dictionary_caches():
+            return self._execute_mesh_arm(ctx, me)
+
+    def _execute_mesh_arm(self, ctx: ExecContext, me) -> Table:
+        """Probe + lax.cond traces, with the dictionary memo caches pinned
+        for the duration: both traces must observe the SAME Dictionary
+        objects or their pytree metadata diverges (ops/table.py)."""
+        import jax
+
         # Exchanges inside the arm contain COLLECTIVES, which every task
         # must execute unconditionally (a collective inside one lax.cond
         # branch deadlocks/aborts). Pre-execute them into the shared cache

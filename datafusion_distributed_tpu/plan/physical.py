@@ -229,7 +229,11 @@ class MemoryScanExec(ExecutionPlan):
         return self._schema
 
     def output_capacity(self):
-        return max(t.capacity for t in self.tasks)
+        # default=8: a co-shuffled group's PLACEHOLDER scan (adaptive
+        # coordinator, `_finish_shuffle`) is empty while sibling feeds
+        # materialize; parents rebuilt over it during that window get a
+        # floor capacity, corrected by resize_for_inputs at dispatch
+        return max((t.capacity for t in self.tasks), default=8)
 
     def load(self, task: DistributedTaskContext) -> Table:
         if self.pinned or self.replicated:
@@ -405,6 +409,20 @@ class HashAggregateExec(ExecutionPlan):
         self.num_slots = num_slots or min(
             round_up_pow2(2 * max(child.output_capacity(), 16)), 1 << 20
         )
+        # OUTPUT capacity: groups <= live input rows, so the packed result
+        # never needs more than pow2(input capacity) — downstream operators
+        # (the final sort especially) pay capacity-proportional work, and
+        # slots = 2x input would hand them double-width padding for free.
+        # DFTPU_AGG_COMPACT=0 is the A/B lever.
+        import os as _os
+
+        if _os.environ.get("DFTPU_AGG_COMPACT", "1") == "1":
+            self.out_capacity = min(
+                self.num_slots,
+                round_up_pow2(max(child.output_capacity(), 16)),
+            )
+        else:
+            self.out_capacity = self.num_slots
 
     def children(self):
         return [self.child]
@@ -422,7 +440,7 @@ class HashAggregateExec(ExecutionPlan):
         return Schema(fields)
 
     def output_capacity(self):
-        return self.num_slots
+        return self.out_capacity if self.group_names else self.num_slots
 
     def _execute(self, ctx: ExecContext) -> Table:
         t = self.child.execute(ctx)
@@ -435,7 +453,7 @@ class HashAggregateExec(ExecutionPlan):
         else:
             out, overflow = hash_aggregate(
                 t, self.group_names, self.aggs, self.num_slots, self.mode,
-                prec_flags=prec_flags,
+                prec_flags=prec_flags, out_capacity=self.out_capacity,
             )
             ctx.record_overflow(self, overflow)
         for f in prec_flags:

@@ -493,8 +493,15 @@ def _inject(plan: ExecutionPlan, cfg: DistributedConfig):
             # every device re-sort the full gathered dataset.
             child, t_p = _seal_stage(child, ann, cfg)
             t_c = _consumer_count(child, t_p, cfg)
-            big = (child.output_capacity() * max(t_p, 1)
-                   > cfg.range_sort_threshold_rows)
+            # prefer the planner-stamped row ESTIMATE over padded capacity:
+            # capacity is an upper bound, and pow2-padded small-but-wide
+            # inputs (post-aggregate results) would otherwise take the
+            # 3-stage distributed sample sort where coalesce-then-sort is
+            # cheaper (ADVICE r4)
+            est_total = child.est_rows
+            size = (est_total if est_total is not None
+                    else child.output_capacity() * max(t_p, 1))
+            big = size > cfg.range_sort_threshold_rows
             if t_c > 1 and big:
                 per_dest = round_up_pow2(max(
                     cfg.shuffle_skew_factor * child.output_capacity()

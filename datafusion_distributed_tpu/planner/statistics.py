@@ -151,8 +151,11 @@ def estimate_rows(plan: ExecutionPlan, stats: Optional[PlanStatistics] = None) -
 
 
 def operator_complexity(plan: ExecutionPlan) -> Complexity:
-    """Per-operator symbolic device-work model (complexity_cpu.rs analogue,
-    adapted: hash ops are linear vectorized passes, sorts are n log n)."""
+    """Per-operator symbolic device-work model in terms of OUTPUT rows
+    (complexity_cpu.rs analogue for the single-input shape). Multi-input
+    operators (joins) get their exact input-row shapes in
+    `operator_compute_rows` — this single-n view remains for callers that
+    only carry one cardinality."""
     if isinstance(plan, (MemoryScanExec, ParquetScanExec)):
         return Complexity(linear=1.0)
     if isinstance(plan, (FilterExec, ProjectionExec, LimitExec)):
@@ -168,18 +171,63 @@ def operator_complexity(plan: ExecutionPlan) -> Complexity:
     return Complexity(linear=1.0)
 
 
+def operator_compute_rows(
+    plan: ExecutionPlan, stats: Optional[PlanStatistics] = None
+) -> float:
+    """Row-ops this operator performs, shaped per the reference's per-op
+    CPU model (`complexity_cpu.rs:5-20` cites the DataFusion internals the
+    shapes come from):
+
+      hash join   O(n_build + n_probe)   build pass + probe pass
+      NLJ/cross   O(n_left * n_right)    every pair compared
+      hash agg    rounds * n             claim-loop rounds over the input
+      sort        n log2 n               bitonic/radix device sort
+      window      n log2 n               partition sort dominates
+      elementwise n                      filter/project/limit/scan
+    """
+    import math
+
+    if isinstance(plan, HashJoinExec):
+        b = estimate_rows(plan.build, stats)
+        p = estimate_rows(plan.probe, stats)
+        return b + p
+    if isinstance(plan, CrossJoinExec):
+        return (estimate_rows(plan.left, stats)
+                * estimate_rows(plan.right, stats))
+    if isinstance(plan, HashAggregateExec):
+        n = estimate_rows(plan.child, stats)
+        # claim-loop rounds grow with load factor: ~3 passes in the
+        # steady state (hash, claim, scatter) — see ops/aggregate.py
+        return 3.0 * n
+    if isinstance(plan, SortExec):
+        n = estimate_rows(plan.child, stats)
+        return n * math.log2(max(n, 2.0))
+    from datafusion_distributed_tpu.plan.window_exec import WindowExec
+
+    if isinstance(plan, WindowExec):
+        n = estimate_rows(plan.child, stats)
+        return n * math.log2(max(n, 2.0))
+    if isinstance(plan, UnionExec):
+        return sum(estimate_rows(c, stats) for c in plan.children())
+    if plan.children():
+        return max(estimate_rows(c, stats) for c in plan.children())
+    return estimate_rows(plan, stats)
+
+
 def calculate_cost(
     plan: ExecutionPlan, stats: Optional[PlanStatistics] = None
 ) -> Cost:
     """Total cost of a (sub)plan: the `calculate_cost` entry point
-    (cost.rs:27). Exchange nodes contribute network bytes; broadcast
-    multiplies by consumer task count (complexity_network.rs)."""
+    (cost.rs:27) — compute from the per-op input-row shapes
+    (operator_compute_rows), memory from padded HBM capacities, network
+    from exchange bytes; broadcast multiplies by consumer task count
+    (complexity_network.rs:2-22)."""
     total = Cost()
     for c in plan.children():
         total = total + calculate_cost(c, stats)
     n = estimate_rows(plan, stats)
     width = row_width(plan.schema())
-    work = operator_complexity(plan).evaluate(n) * width
+    work = operator_compute_rows(plan, stats) * width
     mem = float(plan.output_capacity()) * width
     net = 0.0
     if isinstance(plan, ShuffleExchangeExec):
@@ -191,6 +239,46 @@ def calculate_cost(
     elif isinstance(plan, PartitionReplicatedExec):
         net = 0.0
     return total + Cost(compute=work, memory=mem, network=net)
+
+
+def stage_cost(
+    head: ExecutionPlan, stats: Optional[PlanStatistics] = None
+) -> Cost:
+    """Cost of ONE stage: the subtree under ``head`` truncated at exchange
+    boundaries — nodes below a boundary belong to producer stages and were
+    already paid for (the per-stage cost of
+    `prepare_dynamic_plan.rs:40-59`). The boundary's own network
+    contribution is included; attach measured runtime rows for boundary
+    nodes via ``stats`` (LoadInfo -> statistics, `:111-141`)."""
+    total = Cost()
+
+    def node_cost(node: ExecutionPlan) -> Cost:
+        n = estimate_rows(node, stats)
+        width = row_width(node.schema())
+        work = operator_compute_rows(node, stats) * width
+        try:
+            mem = float(node.output_capacity()) * width
+        except Exception:
+            mem = n * width
+        net = 0.0
+        if isinstance(node, ShuffleExchangeExec):
+            net = n * width
+        elif isinstance(node, BroadcastExchangeExec):
+            net = n * width * node.num_tasks
+        elif isinstance(node, CoalesceExchangeExec):
+            net = n * width * node.num_tasks
+        return Cost(compute=work, memory=mem, network=net)
+
+    def walk(node: ExecutionPlan) -> None:
+        nonlocal total
+        total = total + node_cost(node)
+        if getattr(node, "is_exchange", False) and node is not head:
+            return  # producer stage: costed when ITS stage was decided
+        for c in node.children():
+            walk(c)
+
+    walk(head)
+    return total
 
 
 def compute_based_task_count(

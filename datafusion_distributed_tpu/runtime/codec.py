@@ -342,6 +342,30 @@ def encode_plan(p: ExecutionPlan, store: TableStore) -> dict:
     if isinstance(p, IsolatedArmExec):
         return {"t": "isoarm", "task": p.assigned_task,
                 "c": encode_plan(p.child, store)}
+    from datafusion_distributed_tpu.runtime.peer import PeerShuffleScanExec
+
+    if isinstance(p, PeerShuffleScanExec):
+        return {
+            "t": "peerscan",
+            "pulls": [
+                [[list(key), url, lo, hi] for key, url, lo, hi in specs]
+                for specs in p.pulls_per_task
+            ],
+            "keys": p.key_names,
+            "parts": p.num_partitions,
+            "per_dest": p.per_dest_capacity,
+            "schema": encode_schema(p._schema),
+            "dictionaries": {
+                name: list(d.values)
+                for name, d in (p.dictionaries or {}).items()
+            } or None,
+            "replicated": p.replicated,
+            "pinned_task": p.pinned_task,
+            "pull_all": p.pull_all,
+            "budget": p.budget_bytes,
+            "chunk_rows": p.chunk_rows,
+            "cap_hint": p.capacity_hint,
+        }
     kind = getattr(p, "codec_kind", None)
     if kind and kind in _USER_CODECS:
         enc, _ = _USER_CODECS[kind]
@@ -435,6 +459,33 @@ def decode_plan(o: dict, store: TableStore) -> ExecutionPlan:
         return n
     if t == "isoarm":
         return IsolatedArmExec(decode_plan(o["c"], store), o["task"])
+    if t == "peerscan":
+        from datafusion_distributed_tpu.ops.table import Dictionary
+        from datafusion_distributed_tpu.runtime.peer import (
+            PeerShuffleScanExec,
+        )
+        import numpy as np
+
+        dicts = None
+        if o.get("dictionaries"):
+            dicts = {
+                name: Dictionary(np.asarray(vals, dtype=object))
+                for name, vals in o["dictionaries"].items()
+            }
+        return PeerShuffleScanExec(
+            [
+                [(tuple(key), url, lo, hi) for key, url, lo, hi in specs]
+                for specs in o["pulls"]
+            ],
+            o["keys"], o["parts"], o["per_dest"],
+            decode_schema(o["schema"]), dicts,
+            replicated=o.get("replicated", False),
+            pinned_task=o.get("pinned_task"),
+            pull_all=o.get("pull_all", False),
+            budget_bytes=o.get("budget", 64 << 20),
+            chunk_rows=o.get("chunk_rows", 65536),
+            capacity_hint=o.get("cap_hint", 0),
+        )
     if t.startswith("user:"):
         kind = t[5:]
         if kind not in _USER_CODECS:
@@ -449,13 +500,75 @@ def decode_plan(o: dict, store: TableStore) -> ExecutionPlan:
 # ---------------------------------------------------------------------------
 
 
-def encode_table(table: Table) -> bytes:
-    """Table -> Arrow IPC bytes (the Flight data-plane payload analogue)."""
+def _table_to_arrow_wire(table: Table):
+    """Table -> Arrow table for the WIRE: string columns ship as
+    dictionary arrays whose dictionaries are garbage-collected to only the
+    values the slice's live rows reference — the reference's
+    dictionary/view-array GC before Flight encode
+    (`/root/reference/src/worker/impl_execute_task.rs:244-274`). A slice
+    that references 10 of a 100k-value dictionary ships 10 values, and
+    repeated strings ship as int32 codes instead of repeated bytes.
+    The GC'd subset of a sorted dictionary stays sorted, so the receiving
+    side can adopt it directly (io/parquet.py fast path)."""
+    import numpy as np
     import pyarrow as pa
 
-    from datafusion_distributed_tpu.io.parquet import table_to_arrow
+    from datafusion_distributed_tpu.schema import DataType as DT
 
-    arrow = table_to_arrow(table)
+    n = int(table.num_rows)
+    arrays = []
+    names = []
+    for name, col in zip(table.names, table.columns):
+        vals = np.asarray(col.data[:n])
+        mask = None
+        if col.validity is not None:
+            mask = ~np.asarray(col.validity[:n])
+        if col.dtype == DT.STRING:
+            assert col.dictionary is not None
+            codes = vals.astype(np.int64)
+            valid = np.ones(n, dtype=bool) if mask is None else ~mask
+            live = valid & (codes >= 0) & (
+                codes < len(col.dictionary.values)
+            )
+            used = np.unique(codes[live])
+            subset = col.dictionary.values[used]
+            fill = used[0] if len(used) else 0
+            new_codes = np.searchsorted(
+                used, np.where(live, codes, fill)
+            ).astype(np.int32)
+            indices = pa.array(new_codes, mask=~live)
+            arrays.append(pa.DictionaryArray.from_arrays(
+                indices, pa.array(subset.tolist(), type=pa.string())
+            ))
+        elif col.dtype == DT.DATE32:
+            arr = pa.array(vals.astype(np.int32), type=pa.int32(), mask=mask)
+            arrays.append(arr.cast(pa.date32()))
+        else:
+            arrays.append(pa.array(vals, mask=mask))
+        names.append(name)
+    out = pa.table(dict(zip(names, arrays)))
+    # LOGICAL dtypes ride as metadata: the physical arrow type narrows in
+    # tpu precision mode (FLOAT64 logical -> f32 device data), and a
+    # consumer that infers dtypes from the wire would otherwise disagree
+    # with a same-worker bypass pull of the identical table (concat dtype
+    # mismatch between a wire chunk and a bypass chunk)
+    import json as _json
+
+    out = out.replace_schema_metadata({
+        b"dftpu_logical": _json.dumps({
+            name: col.dtype.value
+            for name, col in zip(table.names, table.columns)
+        }).encode()
+    })
+    return out
+
+
+def encode_table(table: Table) -> bytes:
+    """Table -> Arrow IPC bytes (the Flight data-plane payload analogue),
+    with dictionary GC on string columns (see _table_to_arrow_wire)."""
+    import pyarrow as pa
+
+    arrow = _table_to_arrow_wire(table)
     sink = io.BytesIO()
     with pa.ipc.new_stream(sink, arrow.schema) as w:
         w.write_table(arrow)

@@ -69,6 +69,10 @@ class InMemoryCluster(WorkerResolver, ChannelResolver):
             f"mem://worker-{i}": Worker(f"mem://worker-{i}", ttl_seconds)
             for i in range(num_workers)
         }
+        for w in self.workers.values():
+            # peers resolve each other through the cluster itself (the
+            # in-memory duplex-pipe analogue, `in_memory_channel_resolver.rs`)
+            w.peer_channels = self
 
     def get_urls(self) -> list[str]:
         return list(self.workers.keys())
@@ -107,12 +111,43 @@ class Coordinator:
         if self.expected_version is not None:
             self._check_worker_versions()
         query_id = uuid.uuid4().hex
-        resolved = self._materialize_exchanges(plan, query_id)
-        # the root stage: a single consumer task
-        out = self._run_stage_task(
-            resolved, query_id, stage_id=-1, task_number=0, task_count=1
-        )
-        return out
+        # producer tasks shipped but never coordinator-executed (peer data
+        # plane): released at query end — the reference's query-end EOS
+        # notifier role (`query_coordinator.rs:188-192`)
+        self._peer_shipped: list = []
+        # per-query caches (span plans are keyed by query_id; the plan-walk
+        # verdicts key by object id which is only stable within a query).
+        # The lock serializes span check-and-ship: concurrent stage tasks
+        # of one span must not double-ship (double SPMD execution + a
+        # leaked first shipment).
+        self._span_shipped: dict = {}
+        self._span_ok_cache: dict = {}
+        import threading as _threading
+
+        self._span_lock = _threading.Lock()
+        try:
+            resolved = self._materialize_exchanges(plan, query_id)
+            # the root stage: a single consumer task
+            out = self._run_stage_task(
+                resolved, query_id, stage_id=-1, task_number=0, task_count=1
+            )
+            return out
+        finally:
+            for worker, key in self._peer_shipped:
+                try:
+                    # peer producers report metrics at query end (the
+                    # last-drop metrics flush rides no coordinator stream
+                    # to observe earlier)
+                    self._record_task_progress(worker, key)
+                except Exception:
+                    pass
+                try:
+                    if hasattr(worker, "release_task"):
+                        worker.release_task(key)
+                    else:
+                        worker.registry.invalidate(key)
+                except Exception:
+                    pass  # cleanup must not mask the query's own error
 
     def _check_worker_versions(self) -> None:
         from datafusion_distributed_tpu.runtime.errors import WorkerError
@@ -142,6 +177,12 @@ class Coordinator:
         producer = plan.children()[0]
         stage_id = plan.stage_id if plan.stage_id is not None else 0
         t_prod = self._producer_task_count(plan, producer)
+        if self._peer_plane_enabled(plan):
+            scan = self._peer_boundary(plan, producer, query_id, stage_id,
+                                       t_prod)
+            if scan is not None:
+                self._seed_consumer_scan(plan, scan)
+                return scan
         if isinstance(plan, PartitionReplicatedExec):
             # producer is replicated: one task's output carries everything
             outputs = [
@@ -178,6 +219,15 @@ class Coordinator:
             outputs = self._run_stage_tasks(
                 producer, query_id, stage_id, t_prod
             )
+        if isinstance(plan, ShuffleExchangeExec) and not isinstance(
+            plan, RangeShuffleExchangeExec
+        ):
+            # consumer-count decision + regroup are overridable together:
+            # the adaptive coordinator defers co-shuffled siblings so a
+            # join stage's feeds agree on ONE adapted count
+            scan = self._finish_shuffle(plan, outputs, producer)
+            self._seed_consumer_scan(plan, scan)
+            return scan
         t = self._consumer_task_count(plan, outputs)
         if isinstance(plan, RangeShuffleExchangeExec):
             # host tier can range-partition EXACTLY: sort the concatenated
@@ -185,10 +235,6 @@ class Coordinator:
             # mesh tier's sample-splitter approximation is only needed
             # where no task sees the whole dataset)
             slices = _range_regroup(outputs, plan.sort_keys, t)
-        elif isinstance(plan, ShuffleExchangeExec):
-            slices = _shuffle_regroup(
-                outputs, plan.key_names, t, plan.per_dest_capacity
-            )
         elif isinstance(plan, CoalesceExchangeExec) and (
             plan.num_consumers > 1
         ):
@@ -229,6 +275,107 @@ class Coordinator:
         completed with `rows` total output rows so far (the reference's
         LoadInfo stream, `sampler.rs:30-42`). Called while the remaining
         producers are still executing."""
+
+    # -- peer-to-peer data plane ---------------------------------------------
+    def _peer_plane_enabled(self, exchange) -> bool:
+        """Default plane for shuffle/broadcast/N:M-coalesce boundaries when
+        every worker offers the partition-stream surface: consumer tasks
+        pull straight from producer workers and the coordinator only ships
+        plans (`prepare_static_plan.rs:10-56` + `worker_connection_pool.rs`).
+        N:1 coalesce keeps the coordinator-streamed plane — there the
+        coordinator itself is the consumer (the reference's head stage runs
+        on the coordinator). RangeShuffle keeps the host plane for its exact
+        global sort. `SET distributed.peer_shuffle = false` restores the
+        coordinator-mediated plane everywhere."""
+        if not bool(self.config_options.get("peer_shuffle", True)):
+            return False
+        if isinstance(exchange, RangeShuffleExchangeExec):
+            return False
+        eligible = isinstance(
+            exchange, (ShuffleExchangeExec, BroadcastExchangeExec)
+        ) or (
+            isinstance(exchange, CoalesceExchangeExec)
+            and exchange.num_consumers > 1
+        )
+        if not eligible:
+            return False
+        return self._workers_peer_capable()
+
+    def _workers_peer_capable(self) -> bool:
+        """Cached capability probe: cluster membership is static per
+        coordinator — probing every worker per boundary would put O(stages
+        x workers) resolver calls on the dispatch path."""
+        cached = getattr(self, "_peer_capable", None)
+        if cached is None:
+            cached = all(
+                hasattr(self.channels.get_worker(u),
+                        "execute_task_partitions")
+                for u in self.resolver.get_urls()
+            )
+            self._peer_capable = cached
+        return cached
+
+    def _peer_boundary(
+        self, exchange, producer: ExecutionPlan, query_id: str,
+        stage_id: int, t_prod: int,
+    ):
+        """Ship the producer stage's task plans to their workers WITHOUT
+        executing them, and return the consumer-side peer scan. Row bytes
+        for this boundary never touch the coordinator; producers execute
+        lazily on the first consumer pull (pending->ready without a
+        coordinator materialization step)."""
+        from datafusion_distributed_tpu.runtime.peer import (
+            PeerShuffleScanExec,
+            group_pulls,
+            shuffle_pulls,
+        )
+
+        prepared = self._prepare_stage_plan(producer)
+        producers = []  # (key_obj, url)
+        for i in range(t_prod):
+            worker, key, plan_obj, _store = self._dispatch_task(
+                prepared, query_id, stage_id, i, t_prod
+            )
+            self._peer_shipped.append((worker, key))
+            producers.append(
+                ((key.query_id, key.stage_id, key.task_number), worker.url)
+            )
+        budget = int(self.config_options.get(
+            "worker_connection_buffer_budget_bytes", 64 << 20
+        ))
+        chunk_rows = int(self.config_options.get("stream_chunk_rows", 65536))
+        schema = producer.schema()
+        dicts = _leaf_dictionaries(producer, schema)
+        if isinstance(exchange, ShuffleExchangeExec):
+            t_cons = exchange.num_tasks
+            scan = PeerShuffleScanExec(
+                shuffle_pulls(producers, t_cons), exchange.key_names,
+                t_cons, exchange.per_dest_capacity, schema, dicts,
+                budget_bytes=budget, chunk_rows=chunk_rows,
+                capacity_hint=t_prod * exchange.per_dest_capacity,
+            )
+        elif isinstance(exchange, BroadcastExchangeExec):
+            t_cons = max(exchange.num_tasks, 1)
+            scan = PeerShuffleScanExec(
+                shuffle_pulls(producers, t_cons), [], t_cons, 0, schema,
+                dicts, replicated=True, budget_bytes=budget,
+                chunk_rows=chunk_rows,
+                capacity_hint=producer.output_capacity() * max(t_prod, 1),
+            )
+        else:  # N:M coalesce
+            t_cons = exchange.num_consumers
+            scan = PeerShuffleScanExec(
+                group_pulls(producers, t_cons), [], 1, 0, schema, dicts,
+                budget_bytes=budget, chunk_rows=chunk_rows,
+                capacity_hint=exchange.output_capacity(),
+            )
+        self.stream_metrics[(query_id, stage_id)] = {
+            "plane": "peer",
+            "coordinator_bytes": 0,
+            "producers": t_prod,
+            "partitions": t_cons,
+        }
+        return scan
 
     # -- partition-range data plane ------------------------------------------
     def _partition_streams_enabled(self, exchange) -> bool:
@@ -324,6 +471,10 @@ class Coordinator:
         than the data slices available in its scans (an earlier exchange may
         have produced fewer consumer slices than the planned task count),
         never fewer than an isolated arm's pinned index needs."""
+        from datafusion_distributed_tpu.runtime.peer import (
+            PeerShuffleScanExec,
+        )
+
         planned = getattr(exchange, "producer_tasks", None)
         if planned is None:
             planned = exchange.num_tasks
@@ -335,12 +486,35 @@ class Coordinator:
         # fewer tasks than the highest assignment would silently drop arms
         # (task specialization ships them as empty scans)
         arms = producer.collect(lambda n: isinstance(n, IsolatedArmExec))
+        # a peer scan INSIDE an arm is wholly pulled by the arm's one task
+        # (pull_all) — it must not constrain the stage width
+        in_arm_peer = {
+            id(n)
+            for a in arms
+            for n in a.collect(lambda n: isinstance(n, PeerShuffleScanExec))
+        }
+        peer_scans = [
+            n for n in producer.collect(
+                lambda n: isinstance(n, PeerShuffleScanExec)
+            )
+            if n.pinned_task is None and id(n) not in in_arm_peer
+        ]
         need = 1 + max((a.assigned_task for a in arms), default=-1)
         partitioned = [s for s in scans if not s.replicated]
-        slice_counts = [len(s.tasks) for s in partitioned]
+        partitioned_peer = [s for s in peer_scans if not s.replicated]
+        # a partitioned peer scan's partitions are pull obligations, not
+        # just available slices: running fewer tasks than pull-spec lists
+        # would leave partitions unpulled (silent row loss)
+        need = max(
+            need,
+            max((len(s.pulls_per_task) for s in partitioned_peer), default=0),
+        )
+        slice_counts = [len(s.tasks) for s in partitioned] + [
+            len(s.pulls_per_task) for s in partitioned_peer
+        ]
         if slice_counts:
             t = min(planned, max(slice_counts))
-        elif scans:
+        elif scans or peer_scans:
             # all inputs replicated: every task would compute the identical
             # result — run the stage ONCE (the reference co-locates
             # single-task stages the same way, prepare_dynamic_plan.rs:86-96)
@@ -353,6 +527,15 @@ class Coordinator:
         """Static mode: the planned count (AdaptiveCoordinator recomputes
         from exact materialized bytes)."""
         return exchange.num_tasks
+
+    def _finish_shuffle(self, exchange, outputs, producer) -> MemoryScanExec:
+        """Decide the consumer task count and regroup a hash shuffle's
+        producer outputs into consumer slices."""
+        t = self._consumer_task_count(exchange, outputs)
+        slices = _shuffle_regroup(
+            outputs, exchange.key_names, t, exchange.per_dest_capacity
+        )
+        return MemoryScanExec(slices, producer.schema())
 
     # -- streaming data plane -----------------------------------------------
     def _stream_stage_coalesced(
@@ -514,6 +697,10 @@ class Coordinator:
     def _dispatch_task(self, stage_plan, query_id, stage_id, task_number,
                        task_count):
         """Route, task-specialize, ship: -> (worker, key, plan_obj, store)."""
+        disp = self._try_dispatch_span(stage_plan, query_id, stage_id,
+                                       task_number, task_count)
+        if disp is not None:
+            return disp
         urls = self.resolver.get_urls()
         if self.route_tasks is not None:
             url = self.route_tasks(query_id, stage_id, task_number, urls)
@@ -525,10 +712,92 @@ class Coordinator:
         plan_obj = encode_plan(
             _task_specialized(stage_plan, task_number), store
         )
-        worker.set_plan(key, plan_obj, task_count,
-                        config=self.config_options,
-                        headers=self.passthrough_headers)
+        try:
+            worker.set_plan(key, plan_obj, task_count,
+                            config=self.config_options,
+                            headers=self.passthrough_headers)
+        except BaseException:
+            # a failed ship leaves no registry entry to own the staged
+            # slices — release them here or they leak until process exit
+            from datafusion_distributed_tpu.runtime.codec import (
+                collect_table_ids,
+            )
+
+            store.remove(collect_table_ids(plan_obj))
+            raise
         return worker, key, plan_obj, store
+
+    def _try_dispatch_span(self, stage_plan, query_id, stage_id,
+                           task_number, task_count):
+        """Meshes-as-workers dispatch (SURVEY §2.10 "same-mesh = collective,
+        off-mesh = RPC"): when every worker owns a device mesh
+        (`MeshWorker.mesh_width`), a stage's tasks ship as contiguous
+        SPANS — worker k gets tasks [kW, (k+1)W) as ONE span plan and runs
+        them as a single SPMD program. Per-task keys stay the data-plane
+        address, so peer pulls/streams work unchanged between meshes.
+        Returns None when span dispatch does not apply (mixed cluster,
+        custom routing, span-inexpressible plans)."""
+        if self.route_tasks is not None:
+            return None
+        span_w = getattr(self, "_mesh_span_width", None)
+        if span_w is None:
+            # cached: cluster membership is static per coordinator
+            urls0 = self.resolver.get_urls()
+            widths = [
+                getattr(self.channels.get_worker(u), "mesh_width", 0)
+                for u in urls0
+            ]
+            span_w = min(widths) if widths and all(
+                w > 0 for w in widths
+            ) else 0
+            self._mesh_span_width = span_w
+        if span_w <= 0:
+            return None
+        from datafusion_distributed_tpu.runtime.mesh_worker import (
+            span_specializable,
+            span_specialized,
+        )
+
+        span_ok = getattr(self, "_span_ok_cache", None)
+        if span_ok is None:
+            span_ok = self._span_ok_cache = {}
+        ok = span_ok.get(id(stage_plan))
+        if ok is None:
+            ok = span_ok[id(stage_plan)] = span_specializable(stage_plan)
+        if not ok:
+            return None
+        span = task_number // span_w
+        urls = self.resolver.get_urls()
+        url = urls[(stage_id + span) % len(urls)]
+        worker = self.channels.get_worker(url)
+        key = TaskKey(query_id, stage_id, task_number)
+        lo, hi = span * span_w, min((span + 1) * span_w, task_count)
+        if not hasattr(self, "_span_shipped"):  # direct-call safety
+            import threading as _threading
+
+            self._span_shipped = {}
+            self._span_lock = _threading.Lock()
+        ship_key = (query_id, stage_id, lo)
+        with self._span_lock:
+            if ship_key not in self._span_shipped:
+                plan_obj = encode_plan(
+                    span_specialized(stage_plan, lo, hi), worker.table_store
+                )
+                try:
+                    worker.set_stage_plan(
+                        query_id, stage_id, lo, hi, task_count, plan_obj,
+                        config=self.config_options,
+                        headers=self.passthrough_headers,
+                    )
+                except BaseException:
+                    from datafusion_distributed_tpu.runtime.codec import (
+                        collect_table_ids,
+                    )
+
+                    worker.table_store.remove(collect_table_ids(plan_obj))
+                    raise
+                self._span_shipped[ship_key] = plan_obj
+        return worker, key, self._span_shipped[ship_key], worker.table_store
 
     def _record_task_progress(self, worker, key) -> None:
         if not self.collect_metrics:
@@ -589,6 +858,11 @@ class AdaptiveCoordinator(Coordinator):
     #: overflowing capacity
     resize_headroom: float = 2.0
 
+    def __post_init__(self):
+        # remember the CONSTRUCTED value: the post-query reset must restore
+        # a caller-configured headroom, not clobber it with the class default
+        self._base_resize_headroom = self.resize_headroom
+
     def execute(self, plan: ExecutionPlan) -> Table:
         self._load_info: dict[int, object] = {}
         self.task_count_decisions: list[tuple[int, int, int]] = []
@@ -598,21 +872,46 @@ class AdaptiveCoordinator(Coordinator):
         #: surface proving the decision predates producer completion
         self.partial_decisions: dict[int, tuple[int, int]] = {}
         self._solo_shuffles = _find_solo_shuffles(plan)
+        # co-shuffled groups (join stages fed by >= 2 shuffles) adapt
+        # TOGETHER: the shared consumer count is decided once, from the
+        # combined runtime statistics of every feeding shuffle, before any
+        # side's slices ship (prepare_dynamic_plan.rs re-injection analogue)
+        self._group_of: dict = {}
+        self._group_members: dict = {}
+        self._group_heads: dict = {}
+        self._group_pending: dict = {}
+        #: stage_id -> (consumer head node, original exchange node_id) for
+        #: the stage-cost model (compute_based_task_count analogue)
+        self._stage_heads: dict = {}
+        for head, shuffles in _shuffle_consumer_groups(plan):
+            for s in shuffles:
+                self._stage_heads[s.stage_id] = (head, s.node_id)
+            if len(shuffles) >= 2:
+                gid = tuple(sorted(s.stage_id for s in shuffles))
+                self._group_members[gid] = [s.stage_id for s in shuffles]
+                self._group_heads[gid] = head
+                for s in shuffles:
+                    self._group_of[s.stage_id] = gid
         try:
             out = super().execute(plan)
         except RuntimeError as e:
             if "overflow" in str(e):
                 self.resize_headroom *= 4
             raise
-        # success: back to the default so one query's widening does not
-        # permanently inflate every later query on this coordinator
-        self.resize_headroom = type(self).resize_headroom
+        # success: back to the constructed value so one query's widening does
+        # not permanently inflate every later query on this coordinator
+        self.resize_headroom = self._base_resize_headroom
         return out
 
     def _partition_streams_enabled(self, exchange) -> bool:
         # adaptive mode recomputes consumer task counts from exact
         # materialized outputs; a partition stream would fix the count
         # in the producer request before those statistics exist
+        return False
+
+    def _peer_plane_enabled(self, exchange) -> bool:
+        # same rationale: the peer plane fixes partition counts and pull
+        # specs at plan-ship time, before runtime statistics exist
         return False
 
     # -- mid-execution sampling ------------------------------------------
@@ -645,12 +944,13 @@ class AdaptiveCoordinator(Coordinator):
         bound. Uses the mid-execution prediction when one was frozen,
         exact bytes otherwise.
 
-        Only SOLO shuffles adapt (consumer stage fed by exactly one
-        shuffle): a hash-join's co-shuffled sides must agree on `hash % t`
-        or co-partitioning breaks, and that agreement is planned, not local
-        to one exchange (the reference re-plans whole stages for the same
-        reason, `prepare_dynamic_plan.rs`). Coalesce/broadcast outputs are
-        replicated single tables — task counts do not apply to them."""
+        This method handles SOLO shuffles (consumer stage fed by exactly
+        one shuffle). Co-shuffled siblings — a hash-join's sides must agree
+        on `hash % t` — adapt together through the deferred group decision
+        in `_finish_shuffle`/`_decide_group` (the reference re-plans whole
+        stages for the same reason, `prepare_dynamic_plan.rs`).
+        Coalesce/broadcast outputs are replicated single tables — task
+        counts do not apply to them."""
         from datafusion_distributed_tpu.planner.statistics import row_width
 
         if not isinstance(exchange, ShuffleExchangeExec):
@@ -667,11 +967,92 @@ class AdaptiveCoordinator(Coordinator):
             nbytes = sum(int(o.num_rows) for o in outputs) * width
         want = max(1, -(-nbytes // self.bytes_per_task))
         t = min(exchange.num_tasks, int(want))
+        # cost-informed floor: size by the consumer STAGE's modeled device
+        # work, not bytes alone (the compute_based_task_count of
+        # `prepare_dynamic_plan.rs:60-69`) — a compute-heavy consumer
+        # (join probe, multi-round aggregate) keeps more tasks than its
+        # input bytes would suggest
+        head_info = self._stage_heads.get(exchange.stage_id)
+        if head_info is not None:
+            from datafusion_distributed_tpu.planner.statistics import (
+                PlanStatistics,
+                compute_based_task_count,
+                stage_cost,
+            )
+
+            head, orig_nid = head_info
+            rows = (pred.rows if pred is not None
+                    else sum(int(o.num_rows) for o in outputs))
+            cost = stage_cost(
+                head, PlanStatistics(rows={orig_nid: float(rows)})
+            )
+            t_cost = compute_based_task_count(
+                cost, float(max(self.bytes_per_task, 1)), exchange.num_tasks
+            )
+            t = min(exchange.num_tasks, max(t, t_cost))
         self.task_count_decisions.append(
             (exchange.stage_id if exchange.stage_id is not None else -1,
              exchange.num_tasks, t)
         )
         return t
+
+    def _finish_shuffle(self, exchange, outputs, producer):
+        """Co-shuffled siblings defer their regroup until EVERY member of
+        the group has materialized its producers; the shared consumer count
+        is then decided once from the combined statistics. Solo shuffles
+        keep the immediate path (base + adaptive `_consumer_task_count`)."""
+        gid = self._group_of.get(exchange.stage_id)
+        if gid is None:
+            return super()._finish_shuffle(exchange, outputs, producer)
+        pend = self._group_pending.setdefault(gid, {})
+        # placeholder scan, filled in-place when the group decides: the
+        # consumer stage only reads it after all its feeds materialized
+        # (the recursion finishes every feed before the parent stage runs)
+        scan = MemoryScanExec([], producer.schema())
+        pend[exchange.stage_id] = (exchange, outputs, scan)
+        if len(pend) == len(self._group_members[gid]):
+            self._decide_group(gid)
+        return scan
+
+    def _decide_group(self, gid) -> None:
+        from datafusion_distributed_tpu.planner.statistics import (
+            PlanStatistics,
+            compute_based_task_count,
+            row_width,
+            stage_cost,
+        )
+
+        pend = self._group_pending.pop(gid)
+        head = self._group_heads[gid]
+        planned = min(ex.num_tasks for ex, _, _ in pend.values())
+        total_bytes = 0
+        rows_stats: dict = {}
+        for sid, (ex, outputs, _scan) in pend.items():
+            pred = self._predicted.get(sid)
+            if pred is not None:
+                rows, nbytes = pred.rows, pred.bytes
+            else:
+                width = row_width(outputs[0].schema()) if outputs else 8
+                rows = sum(int(o.num_rows) for o in outputs)
+                nbytes = rows * width
+            total_bytes += nbytes
+            head_info = self._stage_heads.get(sid)
+            if head_info is not None:
+                rows_stats[head_info[1]] = float(rows)
+        if self.bytes_per_task > 0:
+            t_bytes = max(1, -(-int(total_bytes) // self.bytes_per_task))
+        else:
+            t_bytes = planned
+        cost = stage_cost(head, PlanStatistics(rows=rows_stats))
+        t_cost = compute_based_task_count(
+            cost, float(max(self.bytes_per_task, 1)), planned
+        )
+        t = min(planned, max(t_bytes, t_cost))
+        for sid, (ex, outputs, scan) in pend.items():
+            scan.tasks[:] = _shuffle_regroup(
+                outputs, ex.key_names, t, ex.per_dest_capacity
+            )
+            self.task_count_decisions.append((sid, ex.num_tasks, t))
 
     def _prepare_stage_plan(self, stage_plan):
         """Resize stage capacities from runtime LoadInfo (exact or
@@ -710,10 +1091,13 @@ class AdaptiveCoordinator(Coordinator):
         return merged
 
 
-def _find_solo_shuffles(plan: ExecutionPlan) -> set:
-    """ids of ShuffleExchangeExec nodes whose consumer stage is fed by no
-    OTHER shuffle (safe to re-size independently: no co-partition contract
-    with a sibling)."""
+def _shuffle_consumer_groups(plan: ExecutionPlan) -> list:
+    """-> [(consumer head node, [feeding ShuffleExchangeExec nodes])] for
+    every stage of the ORIGINAL plan tree. A head fed by ONE shuffle can
+    re-size that shuffle independently; a head fed by several (a co-shuffled
+    join) must re-size them TOGETHER or `hash % t` co-partitioning breaks —
+    the situation the reference solves by re-running boundary injection per
+    stage at runtime (`prepare_dynamic_plan.rs:26-141`)."""
 
     def frontier(node) -> list:
         out = []
@@ -724,19 +1108,33 @@ def _find_solo_shuffles(plan: ExecutionPlan) -> set:
                 out.extend(frontier(c))
         return out
 
-    solo: set = set()
+    groups = []
     heads = [plan] + [
         e.children()[0]
         for e in plan.collect(lambda n: getattr(n, "is_exchange", False))
     ]
     for head in heads:
-        feeds = frontier(head)
-        shuffles = [f for f in feeds if isinstance(f, ShuffleExchangeExec)]
-        if len(shuffles) == 1 and shuffles[0].stage_id is not None:
-            # keyed by stage_id: materialization rebuilds nodes, object
-            # identity does not survive with_new_children
-            solo.add(shuffles[0].stage_id)
-    return solo
+        shuffles = [
+            f for f in frontier(head)
+            if isinstance(f, ShuffleExchangeExec)
+            and not isinstance(f, RangeShuffleExchangeExec)
+            and f.stage_id is not None
+        ]
+        if shuffles:
+            groups.append((head, shuffles))
+    return groups
+
+
+def _find_solo_shuffles(plan: ExecutionPlan) -> set:
+    """ids of ShuffleExchangeExec nodes whose consumer stage is fed by no
+    OTHER shuffle (safe to re-size independently; keyed by stage_id —
+    materialization rebuilds nodes, object identity does not survive
+    with_new_children)."""
+    return {
+        s[0].stage_id
+        for _, s in _shuffle_consumer_groups(plan)
+        if len(s) == 1
+    }
 
 
 def _task_specialized(plan: ExecutionPlan, task_number: int) -> ExecutionPlan:
@@ -753,7 +1151,24 @@ def _task_specialized(plan: ExecutionPlan, task_number: int) -> ExecutionPlan:
     `DistributedTaskContext` remap for union children
     (`children_isolator_union.rs:84-100`)."""
 
+    from datafusion_distributed_tpu.runtime.peer import PeerShuffleScanExec
+
     def walk(node: ExecutionPlan, in_arm: bool) -> ExecutionPlan:
+        if isinstance(node, PeerShuffleScanExec):
+            if node.pinned_task is not None or node.pull_all:
+                return node  # already specialized
+            if node.replicated:
+                # broadcast: EVERY virtual partition is the producer's full
+                # output — pull exactly ONE, in or out of an arm (pull_all
+                # here would duplicate the build side num_partitions x);
+                # modulo guards a consumer stage forced wider than the
+                # broadcast's planned fan-out by a sibling feed
+                return node.pinned_copy(
+                    task_number % max(node.num_partitions, 1)
+                )
+            # in an arm: the sole consumer pulls EVERY partition (same
+            # argument as the MemoryScan concat below)
+            return node.pinned_copy(task_number, pull_all=in_arm)
         if isinstance(node, IsolatedArmExec):
             if node.assigned_task != task_number:
                 # ChildrenIsolatorUnion semantics: this arm belongs to
@@ -884,11 +1299,15 @@ def _leaf_dictionaries(plan: ExecutionPlan, schema) -> Optional[dict]:
     dependent consumers (literal code lookups) break on a bare None."""
     from datafusion_distributed_tpu.plan.physical import ParquetScanExec
 
+    from datafusion_distributed_tpu.runtime.peer import PeerShuffleScanExec
+
     out: dict = {}
     names = {f.name for f in schema.fields}
     for leaf in plan.collect(lambda n: not n.children()):
         dicts: dict = {}
         if isinstance(leaf, ParquetScanExec) and leaf.dictionaries:
+            dicts = leaf.dictionaries
+        elif isinstance(leaf, PeerShuffleScanExec) and leaf.dictionaries:
             dicts = leaf.dictionaries
         elif isinstance(leaf, MemoryScanExec) and leaf.tasks:
             ref = leaf.tasks[0]

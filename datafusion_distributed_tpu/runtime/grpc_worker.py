@@ -174,10 +174,18 @@ def _handlers(worker: Worker):
         p = worker.task_progress(_key_from_obj(msg["key"]))
         return json.dumps({"progress": p}).encode()
 
+    def invalidate(request: bytes, context) -> bytes:
+        # query-end release (the coordinator's EOS sweep for peer-plane
+        # producer tasks that were never, or only partially, pulled)
+        msg = json.loads(request.decode())
+        worker.release_task(_key_from_obj(msg["key"]))
+        return json.dumps({"ok": True}).encode()
+
     unary = {
         "SetPlan": set_plan,
         "GetInfo": get_info,
         "TaskProgress": task_progress,
+        "Invalidate": invalidate,
     }
     method_handlers = {
         name: grpc.unary_unary_rpc_method_handler(
@@ -198,8 +206,12 @@ def serve_worker(worker: Worker, port: int = 0, host: str = "0.0.0.0"):
     loopback-only fixture."""
     import grpc
 
+    # Peer-plane recursion holds a server thread per in-flight consumer
+    # execute while its producer streams are served by the SAME pool (a
+    # deep staged query can pin several threads per worker); size the pool
+    # well past the worst realistic stage depth x concurrent streams.
     server = grpc.server(
-        futures.ThreadPoolExecutor(max_workers=8),
+        futures.ThreadPoolExecutor(max_workers=32),
         options=[
             ("grpc.max_receive_message_length", -1),
             ("grpc.max_send_message_length", -1),
@@ -395,6 +407,11 @@ class GrpcWorkerClient:
     def get_info(self) -> dict:
         return self._call("GetInfo", {})
 
+    def release_task(self, key: TaskKey) -> None:
+        self._shipped_ids.pop(key, None)
+        self._progress_cache.pop(key, None)
+        self._call("Invalidate", {"key": _key_to_obj(key)})
+
     def task_progress(self, key: TaskKey):
         if key in self._progress_cache:
             return self._progress_cache[key]
@@ -416,6 +433,24 @@ class _NullRegistry:
 # ---------------------------------------------------------------------------
 
 
+class GrpcPeerResolver:
+    """Worker-side channel resolver for the peer data plane: url -> cached
+    GrpcWorkerClient (the reference's DefaultChannelResolver channel cache,
+    `channel_resolver.rs:113-171`). Shared by all workers in a process."""
+
+    def __init__(self) -> None:
+        import threading
+
+        self._clients: dict[str, GrpcWorkerClient] = {}
+        self._lock = threading.Lock()
+
+    def get_worker(self, url: str) -> GrpcWorkerClient:
+        with self._lock:
+            if url not in self._clients:
+                self._clients[url] = GrpcWorkerClient(url)
+            return self._clients[url]
+
+
 class GrpcCluster:
     """N gRPC workers on random localhost ports, one process — the
     `start_localhost_context` analogue (`src/test_utils/localhost.rs`)."""
@@ -423,14 +458,18 @@ class GrpcCluster:
     def __init__(self, num_workers: int, ttl_seconds: float = 600.0):
         self.servers = []
         self.urls = []
+        self.local_workers: list[Worker] = []  # test introspection
         self._clients: dict[str, GrpcWorkerClient] = {}
+        peer_resolver = GrpcPeerResolver()
         for i in range(num_workers):
-            w = Worker(url=f"grpc-local-{i}", ttl_seconds=ttl_seconds)
+            w = Worker(url=f"grpc-local-{i}", ttl_seconds=ttl_seconds,
+                       peer_channels=peer_resolver)
             server, port = serve_worker(w)
             url = f"grpc://127.0.0.1:{port}"
             w.url = url
             self.servers.append(server)
             self.urls.append(url)
+            self.local_workers.append(w)
 
     def get_urls(self):
         return list(self.urls)

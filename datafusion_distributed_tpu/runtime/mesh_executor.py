@@ -61,7 +61,10 @@ except ImportError:  # pragma: no cover - depends on jax version
 # more than the cap's worth of memoized plans (dashboard refresh loops)
 # can raise DFTPU_MESH_CACHE to trade memory for recompiles.
 _MESH_COMPILE_CACHE: dict = {}
-_MESH_COMPILE_CACHE_CAP = int(os.environ.get("DFTPU_MESH_CACHE", "8"))
+# clamped to >= 1: a zero/negative cap would make the eviction loop pop from
+# an empty dict on the first compile (the cache cannot be disabled, only
+# minimized — every execution needs its own entry live while running)
+_MESH_COMPILE_CACHE_CAP = max(int(os.environ.get("DFTPU_MESH_CACHE", "8")), 1)
 
 
 def make_mesh(num_tasks: Optional[int] = None, devices=None) -> Mesh:

@@ -71,6 +71,11 @@ class TaskData:
     partitions_remaining: Optional[int] = None
     partitions_served: set = field(default_factory=set)
     lock: threading.Lock = field(default_factory=threading.Lock)
+    # shipment-store ids this task's plan references: released whenever the
+    # registry entry dies (drop-driven cleanup OR TTL eviction), so a
+    # cancelled/errored partition stream cannot leak TableStore entries on
+    # a long-lived worker (ADVICE r4)
+    shipped_table_ids: list = field(default_factory=list)
 
 
 RESERVED_HEADER_PREFIX = "x-dftpu-"
@@ -92,10 +97,15 @@ class TaskRegistry:
     entries idle longer than `ttl_seconds` are evicted so abandoned queries
     cannot leak plans/buffers)."""
 
-    def __init__(self, ttl_seconds: float = 600.0):
+    def __init__(self, ttl_seconds: float = 600.0,
+                 on_evict: Optional[Callable[[TaskData], None]] = None):
         self.ttl = ttl_seconds
         self._entries: dict[TaskKey, tuple[float, TaskData]] = {}
         self._lock = threading.Lock()
+        # fired (outside hot paths, under the registry lock) for EVERY entry
+        # leaving the registry — invalidate, TTL expiry, or sweep — so owners
+        # can release per-task resources (the worker's shipped table slices)
+        self.on_evict = on_evict
 
     def put(self, data: TaskData) -> None:
         with self._lock:
@@ -111,19 +121,30 @@ class TaskRegistry:
             ts, data = hit
             if time.time() - ts > self.ttl:
                 del self._entries[key]
+                self._fire_evict(data)
                 return None
             self._entries[key] = (time.time(), data)  # touch (TTI semantics)
             return data
 
     def invalidate(self, key: TaskKey) -> None:
         with self._lock:
-            self._entries.pop(key, None)
+            hit = self._entries.pop(key, None)
+            if hit is not None:
+                self._fire_evict(hit[1])
 
     def _evict(self) -> None:
         now = time.time()
         dead = [k for k, (ts, _) in self._entries.items() if now - ts > self.ttl]
         for k in dead:
-            del self._entries[k]
+            _, data = self._entries.pop(k)
+            self._fire_evict(data)
+
+    def _fire_evict(self, data: TaskData) -> None:
+        if self.on_evict is not None:
+            try:
+                self.on_evict(data)
+            except Exception:
+                pass  # cleanup must never poison the registry paths
 
     def __len__(self) -> int:
         with self._lock:
@@ -145,12 +166,22 @@ class Worker:
         ttl_seconds: float = 600.0,
         version: str = "0.1.0",
         on_plan: Optional[Callable[[ExecutionPlan, TaskKey], ExecutionPlan]] = None,
+        peer_channels=None,
     ):
         self.url = url
         self.version = version
-        self.registry = TaskRegistry(ttl_seconds)
+        self.registry = TaskRegistry(
+            ttl_seconds,
+            on_evict=lambda data: self.table_store.remove(
+                data.shipped_table_ids
+            ),
+        )
         self.on_plan = on_plan
         self.table_store = TableStore()
+        # ChannelResolver-like (get_worker(url)) used by the peer-to-peer
+        # data plane to open streams to producer workers (the reference's
+        # consumer-side WorkerConnectionPool, `worker_connection_pool.rs`)
+        self.peer_channels = peer_channels
         # final progress of partition-range tasks, retained past their
         # drop-driven invalidation (consumed once by task_progress)
         self._final_progress: dict[TaskKey, Optional[dict]] = {}
@@ -167,9 +198,16 @@ class Worker:
                 plan = self.on_plan(plan, key)
         except Exception as e:  # structured propagation to the coordinator
             raise wrap_worker_exception(e, self.url, key) from e
+        from datafusion_distributed_tpu.runtime.codec import collect_table_ids
+        from datafusion_distributed_tpu.runtime.peer import (
+            attach_peer_channels,
+        )
+
+        attach_peer_channels(plan, self.peer_channels, self)
         self.registry.put(TaskData(
             key=key, plan=plan, task_count=task_count,
             config=dict(config or {}), headers=dict(headers or {}),
+            shipped_table_ids=collect_table_ids(plan_obj),
         ))
 
     # -- data plane ---------------------------------------------------------
@@ -263,17 +301,27 @@ class Worker:
         with data.lock:
             if data.partition_slices is None or data.partition_spec != spec:
                 out = self.execute_task(key)
-                # same hash as the in-mesh shuffle kernel, so codes
-                # co-locate across tiers (function-level import:
-                # runtime/coordinator.py imports this module at top level)
-                from datafusion_distributed_tpu.runtime.coordinator import (
-                    _shuffle_regroup,
-                )
+                if not key_names:
+                    # replicate mode (peer broadcast / gather): the FULL
+                    # output serves under every virtual partition id — the
+                    # reference's NetworkBroadcastExec virtual-partition
+                    # scheme (`broadcast.rs:30-69`); entries are references,
+                    # not copies, and the per-partition drop accounting
+                    # self-invalidates after the last consumer pulled
+                    data.partition_slices = [out] * num_partitions
+                else:
+                    # same hash as the in-mesh shuffle kernel, so codes
+                    # co-locate across tiers (function-level import:
+                    # runtime/coordinator.py imports this module at top
+                    # level)
+                    from datafusion_distributed_tpu.runtime.coordinator import (  # noqa: E501
+                        _shuffle_regroup,
+                    )
 
-                cap = per_dest_capacity or max(int(out.capacity), 8)
-                data.partition_slices = _shuffle_regroup(
-                    [out], key_names, num_partitions, cap
-                )
+                    cap = per_dest_capacity or max(int(out.capacity), 8)
+                    data.partition_slices = _shuffle_regroup(
+                        [out], key_names, num_partitions, cap
+                    )
                 data.partition_spec = spec
                 data.partitions_served = set()
                 data.partitions_remaining = num_partitions
@@ -302,7 +350,16 @@ class Worker:
                 done = data.partitions_remaining is not None and (
                     data.partitions_remaining <= 0
                 )
-            if done:
+            # Replicate mode (empty key_names: peer broadcast/gather) must
+            # NOT self-invalidate on the last distinct partition — a
+            # consumer stage forced wider than the planned fan-out re-pulls
+            # a virtual partition id (modulo wrap), and racing that pull
+            # against the drop-invalidation fails it with "no plan".
+            # Broadcast producers are released by the coordinator's
+            # query-end sweep instead (the reference keeps its broadcast
+            # batch cache for the query lifetime the same way,
+            # `broadcast.rs:71-98`).
+            if done and key_names:
                 # metrics fire on last drop (impl_execute_task.rs:97-112):
                 # retain the final progress past the invalidation so the
                 # consumer's post-stream progress read still sees it
@@ -312,6 +369,12 @@ class Worker:
     def partitions_remaining(self, key: TaskKey) -> Optional[int]:
         data = self.registry.get(key)
         return None if data is None else data.partitions_remaining
+
+    def release_task(self, key: TaskKey) -> None:
+        """Query-end release of a task that may never have been pulled
+        (failed query / unpulled virtual partitions); registry eviction
+        frees its shipped table slices."""
+        self.registry.invalidate(key)
 
     def _stash_final_progress(self, key: TaskKey) -> None:
         """Bounded retention (a worker serving many queries must not grow

@@ -172,7 +172,9 @@ class DataFrame:
                 # planning is inside the try: scalar subqueries execute at
                 # plan time and their overflows must trigger the same retry
                 plan = self.physical_plan(cfg)
-                return execute_plan(plan)
+                out = execute_plan(plan)
+                self.last_retry_count = _attempt  # observability (sweeps)
+                return out
             except RuntimeError as e:
                 if "overflow" not in str(e):
                     raise
@@ -292,7 +294,9 @@ class DataFrame:
         for _attempt in range(self.ctx.config.overflow_retries + 1):
             try:
                 plan = self.distributed_plan(t, dcfg, pcfg, mesh=mesh)
-                return execute_on_mesh(plan, mesh)
+                out = execute_on_mesh(plan, mesh)
+                self.last_retry_count = _attempt
+                return out
             except RuntimeError as e:
                 if "overflow" not in str(e):
                     raise
@@ -369,7 +373,9 @@ class DataFrame:
                 plan = self.distributed_plan(
                     num_tasks, dcfg, pcfg, coordinator=coordinator
                 )
-                return coordinator.execute(plan)
+                out = coordinator.execute(plan)
+                self.last_retry_count = _attempt
+                return out
             except RuntimeError as e:
                 if "overflow" not in str(e):
                     raise

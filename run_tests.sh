@@ -5,7 +5,10 @@
 # image the XLA:CPU backend segfaults sporadically deep inside
 # backend_compile after enough compilations in ONE process (observed twice,
 # different tests each time — tracked as an environment issue, not an
-# engine bug; every file passes in isolation). Process-per-file keeps each
+# engine bug; every file passes in isolation — consistent with the
+# poisoned-AOT-cache mechanism conftest.py now fingerprints away:
+# cross-host cache loads with mismatched CPU features). Process-per-file
+# keeps each
 # XLA instance small and makes a crash attributable.
 set -u
 FAILED=()

@@ -33,13 +33,39 @@ jax.config.update("jax_platforms", "cpu")
 # Multi-device (mesh-8) executables are deliberately NOT cached — serializing
 # them aborts the process (see the patch below) — so the distributed
 # matrices recompile each run; their per-case cost is bounded by module-
-# scoped fixtures reusing one compiled program per query within a run. The
-# cache lives out-of-repo per-user, keyed by XLA to backend + CPU features,
-# so a container/machine change just misses instead of reloading foreign
-# code. DFTPU_TEST_CACHE=0 disables.
+# scoped fixtures reusing one compiled program per query within a run.
+# DFTPU_TEST_CACHE=0 disables.
+#
+# The cache DIRECTORY is fingerprinted by the host's CPU flags: this VM
+# lands on heterogeneous physical CPUs across runs, and XLA's cache key
+# does NOT include host machine features — it happily loads an AOT
+# executable compiled on a host with e.g. +prefer-no-scatter onto one
+# without it, warning "could lead to execution errors such as SIGILL".
+# That is the best available explanation for the suite's sporadic
+# mid-run SIGSEGVs (different test each time, every file passing in
+# isolation): a migration now MISSES the cache instead of executing
+# foreign machine code.
+
+
+def _cpu_fingerprint() -> str:
+    import hashlib
+
+    try:
+        with open("/proc/cpuinfo") as f:
+            flags = next(
+                (line for line in f if line.startswith("flags")), ""
+            )
+    except OSError:
+        import platform
+
+        flags = platform.processor()
+    return hashlib.sha1(flags.encode()).hexdigest()[:12]
+
+
 _test_cache = os.environ.get(
     "DFTPU_TEST_CACHE",
-    os.path.join(os.path.expanduser("~"), ".cache", "dftpu_test_xla"),
+    os.path.join(os.path.expanduser("~"), ".cache",
+                 f"dftpu_test_xla_{_cpu_fingerprint()}"),
 )
 if _test_cache != "0":
     os.makedirs(_test_cache, exist_ok=True)

@@ -167,3 +167,69 @@ def test_adaptive_overlap_partial_decision():
     )
     for done, total in coord.partial_decisions.values():
         assert 0 < done < total
+
+
+def test_coshuffled_join_stage_adapts_shared_count():
+    """A join stage fed by TWO shuffles re-decides its SHARED task count at
+    runtime (the reference re-runs boundary injection per stage,
+    `prepare_dynamic_plan.rs:26-141`): small inputs shrink both feeds to
+    the same adapted count; large inputs keep more tasks. Both sides MUST
+    agree or `hash % t` co-partitioning breaks — verified by result parity
+    and by the recorded per-stage decisions."""
+    import pandas as pd
+
+    from datafusion_distributed_tpu.sql.context import SessionContext
+
+    def run(n_rows):
+        rng = np.random.default_rng(7)
+        ctx = SessionContext()
+        ctx.register_arrow("a", pa.table({
+            "k": rng.integers(0, 40, n_rows),
+            "v": rng.normal(size=n_rows),
+        }))
+        # unique build keys: join output stays n_rows (a many-to-many
+        # build would blow up the single-node oracle's fan-out)
+        ctx.register_arrow("b", pa.table({
+            "k": np.arange(40),
+            "w": rng.normal(size=40),
+        }))
+        # above the broadcast threshold so the join co-shuffles both sides
+        ctx.config.distributed_options["broadcast_joins"] = False
+        ctx.config.distributed_options["bytes_per_task"] = 1
+        df = ctx.sql(
+            "select a.k, sum(a.v) sv, sum(b.w) sw from a join b "
+            "on a.k = b.k group by a.k order by a.k"
+        )
+        cluster = InMemoryCluster(2)
+        coord = AdaptiveCoordinator(
+            resolver=cluster, channels=cluster, bytes_per_task=1 << 16
+        )
+        got = df._strip_quals(
+            df.collect_coordinated_table(coordinator=coord, num_tasks=4)
+        ).to_pandas()
+        exp = df.to_pandas()
+        np.testing.assert_array_equal(got["k"].to_numpy(),
+                                      exp["k"].to_numpy())
+        np.testing.assert_allclose(got["sv"], exp["sv"], rtol=FLOAT_RTOL)
+        np.testing.assert_allclose(got["sw"], exp["sw"], rtol=FLOAT_RTOL)
+        return coord.task_count_decisions
+
+    small = run(200)
+    large = run(60_000)
+
+    def join_group(decisions):
+        # the join's feeds are the two LOWEST stage ids; later solo
+        # shuffles (the post-join aggregate's) decide independently
+        d = {sid: t for sid, _planned, t in decisions}
+        assert len(d) >= 2, decisions
+        lo = sorted(d)[:2]
+        return d[lo[0]], d[lo[1]]
+
+    ts = join_group(small)
+    tl = join_group(large)
+    # both feeds AGREED on one adapted count, per run
+    assert ts[0] == ts[1], small
+    assert tl[0] == tl[1], large
+    # skinny input shrinks the stage; fat input keeps the planned width
+    assert ts[0] == 1, small
+    assert tl[0] == 4, large

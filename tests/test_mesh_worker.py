@@ -1,0 +1,136 @@
+"""Meshes-as-workers tier: workers own device meshes, stage task spans run
+as single SPMD programs, the host peer plane exchanges between meshes
+(SURVEY §2.10 "same-mesh = collective, off-mesh = RPC"; reference topology:
+`worker_service.rs:42-52` with mesh-SPMD replacing the thread pool)."""
+
+import jax
+import numpy as np
+
+from datafusion_distributed_tpu import precision as _precision
+
+FLOAT_RTOL = _precision.test_rtol()
+
+import pyarrow as pa
+import pytest
+
+from datafusion_distributed_tpu.runtime.coordinator import Coordinator
+from datafusion_distributed_tpu.runtime.mesh_worker import (
+    InMemoryMeshCluster,
+    MeshWorker,
+    span_specialized,
+)
+from datafusion_distributed_tpu.sql.context import SessionContext
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    assert len(jax.devices()) >= 8
+    return InMemoryMeshCluster(2, 4)
+
+
+def _ctx(n=20_000, seed=0):
+    rng = np.random.default_rng(seed)
+    ctx = SessionContext()
+    ctx.register_arrow("t", pa.table({
+        "k": rng.integers(0, 50, n),
+        "v": rng.normal(size=n),
+    }))
+    ctx.register_arrow("u", pa.table({
+        "k": np.arange(50),
+        "name": np.asarray([f"name{i:02d}" for i in range(50)],
+                           dtype=object),
+    }))
+    ctx.config.distributed_options["bytes_per_task"] = 1
+    return ctx
+
+
+def test_mesh_worker_join_agg_parity(cluster):
+    """Join + aggregate + sort across 2 workers x 4-device meshes matches
+    single-node; every worker executed at least one span as ONE SPMD
+    program (not 4 host-scheduled tasks)."""
+    ctx = _ctx()
+    ctx.config.distributed_options["broadcast_joins"] = False
+    df = ctx.sql(
+        "select u.name, sum(t.v) s, count(*) c from t join u on t.k = u.k "
+        "group by u.name order by s desc"
+    )
+    coord = Coordinator(resolver=cluster, channels=cluster)
+    got = df._strip_quals(
+        df.collect_coordinated_table(coordinator=coord, num_tasks=8)
+    ).to_pandas()
+    single = df.to_pandas()
+    np.testing.assert_array_equal(
+        got["name"].to_numpy(), single["name"].to_numpy()
+    )
+    np.testing.assert_allclose(got["s"], single["s"], rtol=FLOAT_RTOL)
+    np.testing.assert_array_equal(got["c"], single["c"])
+    for url, w in cluster.workers.items():
+        assert w._spans, f"{url} never ran a span program"
+    # the exchange between the two meshes went through the peer plane
+    peer = [m for m in coord.stream_metrics.values()
+            if m.get("plane") == "peer"]
+    assert peer and all(m["coordinator_bytes"] == 0 for m in peer)
+
+
+def test_mesh_worker_broadcast_parity(cluster):
+    """A small build side broadcasts between meshes (replicate-mode peer
+    pulls, one FULL copy per consumer task)."""
+    ctx = _ctx(seed=1)
+    ctx.config.distributed_options["broadcast_joins"] = True
+    ctx.config.distributed_options["broadcast_threshold_rows"] = 1 << 17
+    df = ctx.sql(
+        "select u.name, sum(t.v) s from t join u on t.k = u.k "
+        "group by u.name order by u.name"
+    )
+    coord = Coordinator(resolver=cluster, channels=cluster)
+    got = df._strip_quals(
+        df.collect_coordinated_table(coordinator=coord, num_tasks=8)
+    ).to_pandas()
+    single = df.to_pandas()
+    np.testing.assert_array_equal(
+        got["name"].to_numpy(), single["name"].to_numpy()
+    )
+    np.testing.assert_allclose(got["s"], single["s"], rtol=FLOAT_RTOL)
+
+
+def test_span_specialized_reslices_leaves():
+    """span_specialized re-indexes leaf slices to local mesh positions."""
+    from datafusion_distributed_tpu.io.parquet import arrow_to_table
+    from datafusion_distributed_tpu.plan.physical import MemoryScanExec
+
+    tables = [
+        arrow_to_table(pa.table({"x": np.arange(4) + 10 * i}))
+        for i in range(8)
+    ]
+    scan = MemoryScanExec(tables, tables[0].schema())
+    sub = span_specialized(scan, 4, 8)
+    assert len(sub.tasks) == 4
+    got = np.asarray(sub.tasks[0].to_numpy()["x"])
+    np.testing.assert_array_equal(got, np.arange(4) + 40)
+
+
+def test_mesh_worker_union_falls_back_to_per_task(cluster):
+    """Plans with isolated union arms are span-inexpressible: dispatch
+    falls back to per-task execution and stays correct."""
+    rng = np.random.default_rng(5)
+    n = 6_000
+    ctx = SessionContext()
+    ctx.register_arrow("a", pa.table({
+        "k": rng.integers(0, 30, n), "v": rng.normal(size=n),
+    }))
+    ctx.register_arrow("b", pa.table({
+        "k": rng.integers(0, 30, n), "v": rng.normal(size=n),
+    }))
+    ctx.config.distributed_options["bytes_per_task"] = 1
+    df = ctx.sql(
+        "select k, sum(v) s from (select k, v from a union all "
+        "select k, v from b) u group by k order by k"
+    )
+    coord = Coordinator(resolver=cluster, channels=cluster)
+    got = df._strip_quals(
+        df.collect_coordinated_table(coordinator=coord, num_tasks=8)
+    ).to_pandas()
+    single = df.to_pandas()
+    np.testing.assert_array_equal(got["k"].to_numpy(),
+                                  single["k"].to_numpy())
+    np.testing.assert_allclose(got["s"], single["s"], rtol=FLOAT_RTOL)

@@ -1,0 +1,229 @@
+"""Peer-to-peer data plane: consumer tasks pull shuffle/broadcast inputs
+directly from producer workers; the coordinator ships plans only.
+
+Reference architecture under test: `worker_connection_pool.rs:62-142`
+(consumer-side pool on the WORKER), `prepare_static_plan.rs:10-56`
+(coordinator ships plans, never row data). The key assertion throughout:
+`stream_metrics[...]["coordinator_bytes"] == 0` for every peer boundary.
+"""
+
+import numpy as np
+
+from datafusion_distributed_tpu import precision as _precision
+
+FLOAT_RTOL = _precision.test_rtol()
+
+import pyarrow as pa
+import pytest
+
+from datafusion_distributed_tpu.runtime.coordinator import (
+    Coordinator,
+    InMemoryCluster,
+)
+from datafusion_distributed_tpu.sql.context import SessionContext
+
+
+def _join_ctx(n=20_000, seed=0) -> SessionContext:
+    rng = np.random.default_rng(seed)
+    ctx = SessionContext()
+    ctx.register_arrow("t", pa.table({
+        "k": rng.integers(0, 50, n),
+        "v": rng.normal(size=n),
+    }))
+    ctx.register_arrow("u", pa.table({
+        "k": np.arange(50),
+        "name": np.asarray([f"name{i:02d}" for i in range(50)], dtype=object),
+    }))
+    # keep the build side above the broadcast threshold so the join
+    # co-shuffles both sides (the peer shuffle path under test)
+    ctx.config.distributed_options["bytes_per_task"] = 1
+    return ctx
+
+
+_JOIN_SQL = (
+    "select u.name, sum(t.v) s, count(*) c from t join u on t.k = u.k "
+    "group by u.name order by s desc"
+)
+
+
+def _peer_stats(coord) -> list[dict]:
+    return [m for m in coord.stream_metrics.values()
+            if m.get("plane") == "peer"]
+
+
+def test_peer_shuffle_zero_coordinator_bytes():
+    """A co-shuffled join + shuffled aggregate run through the peer plane:
+    results match single-node and NO row bytes route through the
+    coordinator for those boundaries."""
+    ctx = _join_ctx()
+    ctx.config.distributed_options["broadcast_joins"] = False
+    df = ctx.sql(_JOIN_SQL)
+    cluster = InMemoryCluster(3)
+    coord = Coordinator(resolver=cluster, channels=cluster)
+    got = df._strip_quals(
+        df.collect_coordinated_table(coordinator=coord, num_tasks=4)
+    ).to_pandas()
+    single = df.to_pandas()
+    np.testing.assert_array_equal(
+        got["name"].to_numpy(), single["name"].to_numpy()
+    )
+    np.testing.assert_allclose(got["s"], single["s"], rtol=FLOAT_RTOL)
+    np.testing.assert_array_equal(got["c"], single["c"])
+    stats = _peer_stats(coord)
+    assert stats, f"no peer boundaries used: {coord.stream_metrics}"
+    assert all(s["coordinator_bytes"] == 0 for s in stats)
+    # the shuffle boundaries of this plan all went peer
+    assert len(stats) >= 2, coord.stream_metrics
+
+
+def test_peer_plane_cleans_up_worker_state():
+    """After a peer-plane query every worker's table store and registry are
+    empty: drop-driven self-invalidation plus the query-end sweep released
+    all shipped slices (the ADVICE r4 TableStore-leak regression test)."""
+    ctx = _join_ctx(seed=1)
+    df = ctx.sql(_JOIN_SQL)
+    cluster = InMemoryCluster(3)
+    coord = Coordinator(resolver=cluster, channels=cluster)
+    df.collect_coordinated_table(coordinator=coord, num_tasks=4)
+    for url, w in cluster.workers.items():
+        assert len(w.registry) == 0, f"{url} kept registry entries"
+        assert w.table_store.tables == {}, (
+            f"{url} leaked {len(w.table_store.tables)} table-store entries"
+        )
+
+
+def test_peer_plane_failure_sweep_releases_producers():
+    """A failure AFTER producer plans shipped still releases every shipped
+    slice (the coordinator's query-end EOS sweep)."""
+    ctx = _join_ctx(seed=2)
+    ctx.config.distributed_options["broadcast_joins"] = False
+    df = ctx.sql(_JOIN_SQL)
+    cluster = InMemoryCluster(2)
+
+    # fail a LATER stage's plan ship: by then the first boundary's
+    # producers are already sitting shipped-but-unexecuted on workers
+    # (peer plane) and only the sweep can release them
+    target = cluster.workers["mem://worker-0"]
+    calls = {"n": 0}
+    real_set_plan = target.set_plan
+
+    def flaky_set_plan(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] >= 3:
+            raise RuntimeError("injected plan-ship failure")
+        return real_set_plan(*a, **kw)
+
+    target.set_plan = flaky_set_plan
+    coord = Coordinator(resolver=cluster, channels=cluster)
+    with pytest.raises(Exception, match="injected"):
+        df.collect_coordinated_table(coordinator=coord, num_tasks=4)
+    target.set_plan = real_set_plan
+    assert calls["n"] >= 3, "failure was never injected"
+    for url, w in cluster.workers.items():
+        assert len(w.registry) == 0, f"{url} kept registry entries"
+        assert w.table_store.tables == {}, f"{url} leaked store entries"
+
+
+def test_peer_broadcast_boundary():
+    """A small build side broadcasts: every consumer task pulls the full
+    build output from the producer worker (virtual-partition replicate
+    mode), never via the coordinator."""
+    ctx = _join_ctx(seed=3)
+    ctx.config.distributed_options["broadcast_joins"] = True
+    ctx.config.distributed_options["broadcast_threshold_rows"] = 1 << 17
+    df = ctx.sql(_JOIN_SQL)
+    cluster = InMemoryCluster(3)
+    coord = Coordinator(resolver=cluster, channels=cluster)
+    got = df._strip_quals(
+        df.collect_coordinated_table(coordinator=coord, num_tasks=4)
+    ).to_pandas()
+    single = df.to_pandas()
+    np.testing.assert_array_equal(
+        got["name"].to_numpy(), single["name"].to_numpy()
+    )
+    np.testing.assert_allclose(got["s"], single["s"], rtol=FLOAT_RTOL)
+    stats = _peer_stats(coord)
+    assert stats, coord.stream_metrics
+
+
+def test_peer_plane_config_off_restores_coordinator_plane():
+    """`SET distributed.peer_shuffle = false` restores the
+    coordinator-mediated plane; results are identical either way."""
+    ctx = _join_ctx(seed=4)
+    ctx.config.distributed_options["broadcast_joins"] = False
+    df = ctx.sql(_JOIN_SQL)
+    cluster = InMemoryCluster(3)
+    peer = Coordinator(resolver=cluster, channels=cluster)
+    got_peer = df._strip_quals(
+        df.collect_coordinated_table(coordinator=peer, num_tasks=4)
+    ).to_pandas()
+    off = Coordinator(resolver=cluster, channels=cluster,
+                      config_options={"peer_shuffle": False})
+    got_off = df._strip_quals(
+        df.collect_coordinated_table(coordinator=off, num_tasks=4)
+    ).to_pandas()
+    assert _peer_stats(peer) and not _peer_stats(off)
+    np.testing.assert_array_equal(
+        got_peer["name"].to_numpy(), got_off["name"].to_numpy()
+    )
+    np.testing.assert_allclose(got_peer["s"], got_off["s"], rtol=FLOAT_RTOL)
+
+
+def test_peer_union_isolated_arm_pulls_all_partitions():
+    """A UNION whose arm is pinned to one task: the arm's peer scan pulls
+    EVERY partition of its boundary (sole-consumer semantics) — the q5-class
+    arm-data-loss scenario, now through the peer plane."""
+    rng = np.random.default_rng(5)
+    n = 8_000
+    ctx = SessionContext()
+    ctx.register_arrow("a", pa.table({
+        "k": rng.integers(0, 30, n), "v": rng.normal(size=n),
+    }))
+    ctx.register_arrow("b", pa.table({
+        "k": rng.integers(0, 30, n), "v": rng.normal(size=n),
+    }))
+    ctx.config.distributed_options["bytes_per_task"] = 1
+    sql = (
+        "select k, sum(v) s from (select k, v from a union all "
+        "select k, v from b) u group by k order by k"
+    )
+    df = ctx.sql(sql)
+    cluster = InMemoryCluster(3)
+    coord = Coordinator(resolver=cluster, channels=cluster)
+    got = df._strip_quals(
+        df.collect_coordinated_table(coordinator=coord, num_tasks=4)
+    ).to_pandas()
+    single = df.to_pandas()
+    np.testing.assert_array_equal(got["k"].to_numpy(),
+                                  single["k"].to_numpy())
+    np.testing.assert_allclose(got["s"], single["s"], rtol=FLOAT_RTOL)
+
+
+def test_peer_plane_over_grpc_cluster():
+    """The same architecture over real localhost gRPC workers: peers pull
+    partition-range streams from each other's servers; worker state drains
+    after the query."""
+    from datafusion_distributed_tpu.runtime.grpc_worker import (
+        start_localhost_cluster,
+    )
+
+    ctx = _join_ctx(n=6_000, seed=6)
+    ctx.config.distributed_options["broadcast_joins"] = False
+    df = ctx.sql(_JOIN_SQL)
+    cluster = start_localhost_cluster(2)
+    try:
+        coord = Coordinator(resolver=cluster, channels=cluster)
+        got = df._strip_quals(
+            df.collect_coordinated_table(coordinator=coord, num_tasks=2)
+        ).to_pandas()
+        single = df.to_pandas()
+        np.testing.assert_array_equal(
+            got["name"].to_numpy(), single["name"].to_numpy()
+        )
+        np.testing.assert_allclose(got["s"], single["s"], rtol=FLOAT_RTOL)
+        stats = _peer_stats(coord)
+        assert stats and all(s["coordinator_bytes"] == 0 for s in stats)
+        for w in cluster.local_workers:
+            assert w.table_store.tables == {}, "gRPC worker leaked store"
+    finally:
+        cluster.shutdown()

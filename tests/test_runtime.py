@@ -94,6 +94,41 @@ def test_table_ipc_roundtrip():
     assert back.to_pandas()["s"].fillna("@").tolist() == ["x", "@", "z"]
 
 
+def test_wire_dictionary_gc():
+    """Shipped slices re-encode string dictionaries to only the values the
+    live rows reference (the reference's pre-Flight dictionary GC,
+    `impl_execute_task.rs:244-274`): a selective filter shrinks the wire
+    bytes by orders of magnitude, and the receiver adopts the compacted
+    dictionary directly."""
+    import jax.numpy as jnp
+
+    vals = [f"value_{i:04d}" for i in range(1000)]
+    arrow = pa.table({
+        "s": np.asarray(vals * 20, dtype=object),
+        "x": np.arange(20000),
+    })
+    t = arrow_to_table(arrow)
+    full_bytes = len(encode_table(t))
+    keep = (np.arange(t.capacity) % 1000 < 10) & (
+        np.arange(t.capacity) < 20000
+    )
+    filtered = t.compact(jnp.asarray(keep))
+    wire = encode_table(filtered)
+    assert len(wire) < full_bytes / 10, (len(wire), full_bytes)
+    back = decode_table(wire)
+    col = back.column("s")
+    # GC: only the 10 referenced values shipped; sorted order preserved
+    assert len(col.dictionary.values) == 10
+    assert list(col.dictionary.values) == sorted(col.dictionary.values)
+    pdf = back.to_pandas().sort_values("x").reset_index(drop=True)
+    exp = (
+        arrow.to_pandas()[lambda d: d.x % 1000 < 10]
+        .sort_values("x").reset_index(drop=True)
+    )
+    assert (pdf["s"] == exp["s"]).all()
+    assert (pdf["x"] == exp["x"]).all()
+
+
 def test_coordinator_executes_distributed_plan():
     plan, arrow = sample_plan()
     dplan = distribute_plan(plan, DistributedConfig(num_tasks=NT))
