@@ -226,8 +226,23 @@ def arrow_to_table(
     )
 
 
-def table_to_arrow(table: Table):
-    """Device Table -> Arrow table (host materialization, decodes strings)."""
+def table_to_arrow(table: Table, dictionary_gc: bool = False,
+                   logical_metadata: bool = False):
+    """Device Table -> Arrow table (host materialization).
+
+    Default shape decodes strings to plain arrays (pandas-friendly). The
+    WIRE shape (``dictionary_gc=True``) instead ships string columns as
+    dictionary arrays whose dictionaries are garbage-collected to only the
+    values the live rows reference — the reference's dictionary/view-array
+    GC before Flight encode (`impl_execute_task.rs:244-274`): a slice
+    referencing 10 of a 100k-value dictionary ships 10 values, and
+    repeated strings ship as int32 codes. The GC'd subset of a sorted
+    dictionary stays sorted, so the receiver adopts it directly
+    (arrow_to_host_columns fast path). ``logical_metadata=True`` attaches
+    the columns' LOGICAL dtypes as schema metadata: physical arrow widths
+    narrow in tpu precision mode (FLOAT64 logical -> f32 device data), and
+    a consumer inferring dtypes from the wire would otherwise disagree
+    with a same-worker bypass pull of the identical table."""
     import pyarrow as pa
 
     n = int(table.num_rows)
@@ -238,7 +253,24 @@ def table_to_arrow(table: Table):
         mask = None
         if col.validity is not None:
             mask = ~np.asarray(col.validity[:n])
-        if col.dtype == DataType.STRING:
+        if col.dtype == DataType.STRING and dictionary_gc:
+            assert col.dictionary is not None
+            codes = vals.astype(np.int64)
+            valid = np.ones(n, dtype=bool) if mask is None else ~mask
+            live = valid & (codes >= 0) & (
+                codes < len(col.dictionary.values)
+            )
+            used = np.unique(codes[live])
+            subset = col.dictionary.values[used]
+            fill = used[0] if len(used) else 0
+            new_codes = np.searchsorted(
+                used, np.where(live, codes, fill)
+            ).astype(np.int32)
+            arrays.append(pa.DictionaryArray.from_arrays(
+                pa.array(new_codes, mask=~live),
+                pa.array(subset.tolist(), type=pa.string()),
+            ))
+        elif col.dtype == DataType.STRING:
             assert col.dictionary is not None
             decoded = col.dictionary.decode(vals)
             if mask is not None:
@@ -251,4 +283,14 @@ def table_to_arrow(table: Table):
         else:
             arrays.append(pa.array(vals, mask=mask))
         names.append(name)
-    return pa.table(dict(zip(names, arrays)))
+    out = pa.table(dict(zip(names, arrays)))
+    if logical_metadata:
+        import json as _json
+
+        out = out.replace_schema_metadata({
+            b"dftpu_logical": _json.dumps({
+                name: col.dtype.value
+                for name, col in zip(table.names, table.columns)
+            }).encode()
+        })
+    return out

@@ -39,43 +39,96 @@ from datafusion_distributed_tpu.planner.statistics import row_width
 @dataclass
 class LoadInfo:
     """Observed stage-output statistics (the worker.proto LoadInfo analogue:
-    rows/bytes ready plus per-column NDV and null fractions)."""
+    rows/bytes ready plus per-column NDV and null fractions, and the
+    rows/bytes-per-second velocity the reference's SamplerExec streams,
+    `sampler.rs:30-42`)."""
 
     rows: int
     bytes: int
     ndv: dict = field(default_factory=dict)  # column -> distinct estimate
     null_frac: dict = field(default_factory=dict)  # column -> null fraction
+    rows_per_s: float = 0.0
+    bytes_per_s: float = 0.0
+
+
+class ColumnStreamSampler:
+    """Incremental per-column NDV/null sampler over IN-FLIGHT stage output
+    (chunks on the streaming plane, task outputs on the bulk plane) — the
+    mid-stage half of the reference's SamplerExec: statistics exist while
+    the stage is still producing, so the consumer's sizing decision
+    (partial-sample freeze) can use real column shapes instead of
+    post-materialization measurement."""
+
+    def __init__(self, sample_limit: int = 100_000):
+        import time
+
+        self.sample_limit = sample_limit
+        self.seen: dict = {}
+        self.nulls: dict = {}
+        self.sampled = 0
+        self.rows = 0
+        self._t0 = time.perf_counter()
+
+    def observe(self, table: Table) -> None:
+        from datafusion_distributed_tpu.schema import DataType
+
+        n = int(table.num_rows)
+        self.rows += n
+        if self.sampled >= self.sample_limit or n == 0:
+            return
+        take = min(n, self.sample_limit - self.sampled)
+        for name, col in zip(table.names, table.columns):
+            vals = np.asarray(col.data[:take])
+            if col.validity is not None:
+                mask = np.asarray(col.validity[:take])
+                self.nulls[name] = self.nulls.get(name, 0) + int(
+                    (~mask).sum()
+                )
+                vals = vals[mask]
+            s = self.seen.setdefault(name, set())
+            if col.dtype == DataType.STRING and col.dictionary is not None:
+                # distinct VALUES, not dictionary codes: in-flight chunks
+                # from different producers carry different dictionaries
+                # (unified only later, at concat) — their code spaces
+                # overlap, and a code-based union would under-count NDV
+                # badly enough to size consumers into guaranteed overflow
+                decoded = col.dictionary.decode(vals.astype(np.int64))
+                s.update(v for v in decoded.tolist() if v is not None)
+            else:
+                s.update(np.unique(vals).tolist())
+        self.sampled += take
+
+    def load_info(self, rows: int, width: int) -> LoadInfo:
+        import time
+
+        elapsed = max(time.perf_counter() - self._t0, 1e-9)
+        return LoadInfo(
+            rows=rows,
+            bytes=rows * width,
+            ndv={k: len(v) for k, v in self.seen.items()},
+            null_frac={
+                k: self.nulls.get(k, 0) / max(self.sampled, 1)
+                for k in self.seen
+            },
+            rows_per_s=self.rows / elapsed,
+            bytes_per_s=self.rows * width / elapsed,
+        )
 
 
 def collect_load_info(tables: list[Table], sample_limit: int = 100_000) -> LoadInfo:
     """Exact rows/bytes; NDV/null%% from a bounded sample (the reference
-    samples 20%% and short-circuits, `prepare_dynamic_plan.rs:206-331`)."""
+    samples 20%% and short-circuits, `prepare_dynamic_plan.rs:206-331`).
+    One sampling implementation serves both the post-materialization path
+    (here) and the mid-stream path (`ColumnStreamSampler` fed by in-flight
+    chunks)."""
     rows = sum(int(t.num_rows) for t in tables)
     if not tables:
         return LoadInfo(0, 0)
     width = row_width(tables[0].schema())
-    ndv: dict = {}
-    nulls: dict = {}
-    for name in tables[0].names:
-        seen = set()
-        null_count = 0
-        sampled = 0
-        for t in tables:
-            n = int(t.num_rows)
-            take = min(n, max(sample_limit - sampled, 0))
-            if take <= 0:
-                break
-            col = t.column(name)
-            vals = np.asarray(col.data[:take])
-            if col.validity is not None:
-                mask = np.asarray(col.validity[:take])
-                null_count += int((~mask).sum())
-                vals = vals[mask]
-            seen.update(np.unique(vals).tolist())
-            sampled += take
-        ndv[name] = len(seen)
-        nulls[name] = null_count / max(sampled, 1)
-    return LoadInfo(rows=rows, bytes=rows * width, ndv=ndv, null_frac=nulls)
+    sampler = ColumnStreamSampler(sample_limit)
+    for t in tables:
+        sampler.observe(t)
+    return sampler.load_info(rows, width)
 
 
 class SamplerExec(ExecutionPlan):

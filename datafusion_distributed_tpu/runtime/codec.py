@@ -500,75 +500,16 @@ def decode_plan(o: dict, store: TableStore) -> ExecutionPlan:
 # ---------------------------------------------------------------------------
 
 
-def _table_to_arrow_wire(table: Table):
-    """Table -> Arrow table for the WIRE: string columns ship as
-    dictionary arrays whose dictionaries are garbage-collected to only the
-    values the slice's live rows reference — the reference's
-    dictionary/view-array GC before Flight encode
-    (`/root/reference/src/worker/impl_execute_task.rs:244-274`). A slice
-    that references 10 of a 100k-value dictionary ships 10 values, and
-    repeated strings ship as int32 codes instead of repeated bytes.
-    The GC'd subset of a sorted dictionary stays sorted, so the receiving
-    side can adopt it directly (io/parquet.py fast path)."""
-    import numpy as np
-    import pyarrow as pa
-
-    from datafusion_distributed_tpu.schema import DataType as DT
-
-    n = int(table.num_rows)
-    arrays = []
-    names = []
-    for name, col in zip(table.names, table.columns):
-        vals = np.asarray(col.data[:n])
-        mask = None
-        if col.validity is not None:
-            mask = ~np.asarray(col.validity[:n])
-        if col.dtype == DT.STRING:
-            assert col.dictionary is not None
-            codes = vals.astype(np.int64)
-            valid = np.ones(n, dtype=bool) if mask is None else ~mask
-            live = valid & (codes >= 0) & (
-                codes < len(col.dictionary.values)
-            )
-            used = np.unique(codes[live])
-            subset = col.dictionary.values[used]
-            fill = used[0] if len(used) else 0
-            new_codes = np.searchsorted(
-                used, np.where(live, codes, fill)
-            ).astype(np.int32)
-            indices = pa.array(new_codes, mask=~live)
-            arrays.append(pa.DictionaryArray.from_arrays(
-                indices, pa.array(subset.tolist(), type=pa.string())
-            ))
-        elif col.dtype == DT.DATE32:
-            arr = pa.array(vals.astype(np.int32), type=pa.int32(), mask=mask)
-            arrays.append(arr.cast(pa.date32()))
-        else:
-            arrays.append(pa.array(vals, mask=mask))
-        names.append(name)
-    out = pa.table(dict(zip(names, arrays)))
-    # LOGICAL dtypes ride as metadata: the physical arrow type narrows in
-    # tpu precision mode (FLOAT64 logical -> f32 device data), and a
-    # consumer that infers dtypes from the wire would otherwise disagree
-    # with a same-worker bypass pull of the identical table (concat dtype
-    # mismatch between a wire chunk and a bypass chunk)
-    import json as _json
-
-    out = out.replace_schema_metadata({
-        b"dftpu_logical": _json.dumps({
-            name: col.dtype.value
-            for name, col in zip(table.names, table.columns)
-        }).encode()
-    })
-    return out
-
-
 def encode_table(table: Table) -> bytes:
-    """Table -> Arrow IPC bytes (the Flight data-plane payload analogue),
-    with dictionary GC on string columns (see _table_to_arrow_wire)."""
+    """Table -> Arrow IPC bytes (the Flight data-plane payload analogue):
+    dictionary-GC'd string columns + logical-dtype metadata (the wire
+    shape of io/parquet.table_to_arrow)."""
     import pyarrow as pa
 
-    arrow = _table_to_arrow_wire(table)
+    from datafusion_distributed_tpu.io.parquet import table_to_arrow
+
+    arrow = table_to_arrow(table, dictionary_gc=True,
+                           logical_metadata=True)
     sink = io.BytesIO()
     with pa.ipc.new_stream(sink, arrow.schema) as w:
         w.write_table(arrow)

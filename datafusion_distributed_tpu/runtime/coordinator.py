@@ -276,6 +276,12 @@ class Coordinator:
         LoadInfo stream, `sampler.rs:30-42`). Called while the remaining
         producers are still executing."""
 
+    def _chunk_observer(self, stage_id: int):
+        """Hook: per-chunk observer for stage output in flight (the
+        per-column half of the LoadInfo stream). None = no sampling; the
+        AdaptiveCoordinator returns a ColumnStreamSampler.observe."""
+        return None
+
     # -- peer-to-peer data plane ---------------------------------------------
     def _peer_plane_enabled(self, exchange) -> bool:
         """Default plane for shuffle/broadcast/N:M-coalesce boundaries when
@@ -304,13 +310,23 @@ class Coordinator:
     def _workers_peer_capable(self) -> bool:
         """Cached capability probe: cluster membership is static per
         coordinator — probing every worker per boundary would put O(stages
-        x workers) resolver calls on the dispatch path."""
+        x workers) resolver calls on the dispatch path.
+
+        Checks the data-plane surface AND actual peer WIRING
+        (`Worker.peer_capable` / the gRPC GetInfo flag): a user-built
+        cluster of plain Worker(url) objects without peer_channels must
+        keep the coordinator-mediated plane, not fail at consumer load
+        time. A single-worker cluster is always capable (every pull
+        short-circuits to the local bypass)."""
         cached = getattr(self, "_peer_capable", None)
         if cached is None:
+            urls = self.resolver.get_urls()
+            workers = [self.channels.get_worker(u) for u in urls]
             cached = all(
-                hasattr(self.channels.get_worker(u),
-                        "execute_task_partitions")
-                for u in self.resolver.get_urls()
+                hasattr(w, "execute_task_partitions") for w in workers
+            ) and (
+                len(urls) <= 1
+                or all(getattr(w, "peer_capable", False) for w in workers)
             )
             self._peer_capable = cached
         return cached
@@ -432,10 +448,12 @@ class Coordinator:
 
             return pull
 
+        obs = self._chunk_observer(stage_id)
         chunks, stats = stream_stage_chunks(
             [make_puller(i) for i in range(t_prod)], budget,
             max_concurrent=max(len(self.resolver.get_urls()), 1),
             payload_rows=lambda pr: int(pr[1].num_rows),
+            on_chunk=(lambda pr: obs(pr[1])) if obs is not None else None,
         )
         self.stream_metrics[(query_id, stage_id)] = {
             "bytes_streamed": stats.bytes_streamed,
@@ -601,6 +619,7 @@ class Coordinator:
             row_target=fetch,
             max_concurrent=max(len(self.resolver.get_urls()), 1),
             on_progress=progress,
+            on_chunk=self._chunk_observer(stage_id),
         )
         self.stream_metrics[(query_id, stage_id)] = {
             "bytes_streamed": stats.bytes_streamed,
@@ -636,6 +655,7 @@ class Coordinator:
 
         width = row_width(producer.schema())
         workers = max(len(self.resolver.get_urls()), 1)
+        obs = self._chunk_observer(stage_id)
         if task_count == 1 or workers == 1:
             outs = []
             rows = 0
@@ -644,6 +664,8 @@ class Coordinator:
                                            task_count)
                 outs.append(out)
                 rows += int(out.num_rows)
+                if obs is not None:
+                    obs(out)
                 self._producer_progress(stage_id, i + 1, task_count, rows,
                                         width)
             return outs
@@ -655,12 +677,16 @@ class Coordinator:
             ]
             try:
                 # drain in completion order so mid-execution LoadInfo flows
-                # while the slower producers are still running
+                # while the slower producers are still running (bulk-plane
+                # "chunks" are whole task outputs)
                 rows = 0
                 done = 0
                 for f in cf.as_completed(futs):
-                    rows += int(f.result().num_rows)
+                    out = f.result()
+                    rows += int(out.num_rows)
                     done += 1
+                    if obs is not None:
+                        obs(out)
                     self._producer_progress(stage_id, done, task_count,
                                             rows, width)
                 return [f.result() for f in futs]
@@ -761,9 +787,12 @@ class Coordinator:
         span_ok = getattr(self, "_span_ok_cache", None)
         if span_ok is None:
             span_ok = self._span_ok_cache = {}
-        ok = span_ok.get(id(stage_plan))
+        # keyed by (query, stage): per-task prepared plans are transient
+        # objects (id() recycles within a query) but share one structure
+        ok_key = (query_id, stage_id)
+        ok = span_ok.get(ok_key)
         if ok is None:
-            ok = span_ok[id(stage_plan)] = span_specializable(stage_plan)
+            ok = span_ok[ok_key] = span_specializable(stage_plan)
         if not ok:
             return None
         span = task_number // span_w
@@ -868,6 +897,9 @@ class AdaptiveCoordinator(Coordinator):
         self.task_count_decisions: list[tuple[int, int, int]] = []
         #: stage_id -> LoadInfo predicted from a partial producer sample
         self._predicted: dict[int, object] = {}
+        #: stage_id -> mid-stream per-column sampler (fresh per query:
+        #: stage ids repeat across queries)
+        self._col_samplers: dict = {}
         #: stage_id -> (done, total) at decision time — test/introspection
         #: surface proving the decision predates producer completion
         self.partial_decisions: dict[int, tuple[int, int]] = {}
@@ -915,6 +947,22 @@ class AdaptiveCoordinator(Coordinator):
         return False
 
     # -- mid-execution sampling ------------------------------------------
+    def _chunk_observer(self, stage_id):
+        """Per-stage ColumnStreamSampler fed by in-flight chunks/outputs:
+        per-column NDV + null fractions + velocity exist BEFORE the stage
+        finishes (the reference SamplerExec's LoadInfo stream,
+        `sampler.rs:30-42`)."""
+        from datafusion_distributed_tpu.planner.adaptive import (
+            ColumnStreamSampler,
+        )
+
+        samplers = getattr(self, "_col_samplers", None)
+        if samplers is None:
+            samplers = self._col_samplers = {}
+        if stage_id not in samplers:
+            samplers[stage_id] = ColumnStreamSampler()
+        return samplers[stage_id].observe
+
     def _producer_progress(self, stage_id, done, total, rows, width):
         if stage_id in self._predicted or done >= total or done <= 0:
             return
@@ -925,9 +973,15 @@ class AdaptiveCoordinator(Coordinator):
         from datafusion_distributed_tpu.planner.adaptive import LoadInfo
 
         pred_rows = int(rows * total / done * self.extrapolation_headroom)
-        self._predicted[stage_id] = LoadInfo(
-            rows=pred_rows, bytes=pred_rows * width
-        )
+        sampler = getattr(self, "_col_samplers", {}).get(stage_id)
+        if sampler is not None and sampler.sampled > 0:
+            # freeze WITH the mid-stream column statistics: observed NDV
+            # is a lower bound (resize headroom + overflow-retry absorb
+            # the undercount), null fractions and velocity ride along
+            info = sampler.load_info(pred_rows, width)
+        else:
+            info = LoadInfo(rows=pred_rows, bytes=pred_rows * width)
+        self._predicted[stage_id] = info
         self.partial_decisions[stage_id] = (done, total)
 
     def _seed_consumer_scan(self, exchange, scan) -> None:

@@ -407,6 +407,19 @@ class GrpcWorkerClient:
     def get_info(self) -> dict:
         return self._call("GetInfo", {})
 
+    @property
+    def peer_capable(self) -> bool:
+        """Asks the SERVER whether it was wired with a peer resolver (the
+        client handle cannot know); cached — cluster wiring is static."""
+        cached = getattr(self, "_peer_capable_cache", None)
+        if cached is None:
+            try:
+                cached = bool(self.get_info().get("peer_capable", False))
+            except Exception:
+                cached = False
+            self._peer_capable_cache = cached
+        return cached
+
     def release_task(self, key: TaskKey) -> None:
         self._shipped_ids.pop(key, None)
         self._progress_cache.pop(key, None)

@@ -81,6 +81,7 @@ def stream_stage_chunks(
     max_concurrent: Optional[int] = None,
     on_progress: Optional[Callable[[int, int, int, int], None]] = None,
     payload_rows: Optional[Callable] = None,
+    on_chunk: Optional[Callable] = None,
 ) -> tuple[list[list], StreamStats]:
     """Run one chunk stream per producer task concurrently under a shared
     byte budget; -> (per-task chunk lists, stats).
@@ -102,6 +103,11 @@ def stream_stage_chunks(
     coordinator extrapolates the NEXT stage's sizing from these partial
     per-task samples (rows from still-running pullers are excluded so
     `rows * total/done` is an unbiased estimate).
+
+    ``on_chunk(payload)``: called in the consumer thread for EVERY chunk
+    as it arrives — the per-column half of the reference's LoadInfo
+    (NDV %% / null %% sampled from in-flight batches, `sampler.rs:30-42`);
+    the adaptive coordinator feeds a mid-stream column sampler from it.
     """
     import queue as _q
 
@@ -168,6 +174,11 @@ def stream_stage_chunks(
         if cancel.is_set():
             continue  # late chunk after cancellation: drop
         chunks[i].append(payload)
+        if on_chunk is not None:
+            try:
+                on_chunk(payload)
+            except Exception:
+                pass  # sampling must never fail the stream
         stats.chunks += 1
         stats.bytes_streamed += nbytes
         pr = payload_rows(payload)

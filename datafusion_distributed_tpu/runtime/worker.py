@@ -384,9 +384,16 @@ class Worker:
         self._final_progress[key] = self.task_progress(key)
 
     # -- observability ------------------------------------------------------
+    @property
+    def peer_capable(self) -> bool:
+        """Whether this worker can open streams to peers (the peer data
+        plane needs a channel resolver wired at construction)."""
+        return self.peer_channels is not None
+
     def get_info(self) -> dict:
         return {"url": self.url, "version": self.version,
-                "tasks_cached": len(self.registry)}
+                "tasks_cached": len(self.registry),
+                "peer_capable": self.peer_capable}
 
     def task_progress(self, key: TaskKey) -> Optional[dict]:
         data = self.registry.get(key)

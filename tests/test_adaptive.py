@@ -233,3 +233,49 @@ def test_coshuffled_join_stage_adapts_shared_count():
     # skinny input shrinks the stage; fat input keeps the planned width
     assert ts[0] == 1, small
     assert tl[0] == 4, large
+
+
+def test_midstream_column_loadinfo():
+    """The partial-sample freeze carries PER-COLUMN statistics gathered
+    while the stage was still producing (the reference SamplerExec's
+    NDV/null/velocity LoadInfo stream, `sampler.rs:30-42`): the predicted
+    LoadInfo has column NDV and null fractions, and the decision predates
+    producer completion."""
+    import pyarrow as pa
+
+    from datafusion_distributed_tpu.sql.context import SessionContext
+
+    rng = np.random.default_rng(11)
+    n = 40_000
+    ctx = SessionContext()
+    vals = rng.normal(size=n)
+    vals[rng.random(n) < 0.1] = np.nan
+    ctx.register_arrow("t", pa.table({
+        "k": rng.integers(0, 64, n),
+        "v": pa.array(vals, from_pandas=True),  # ~10% nulls
+    }))
+    ctx.config.distributed_options["bytes_per_task"] = 1
+    df = ctx.sql("select k, sum(v) s, count(*) c from t group by k order by k")
+    cluster = InMemoryCluster(2)
+    coord = AdaptiveCoordinator(resolver=cluster, channels=cluster,
+                                sample_fraction=0.25)
+    got = df._strip_quals(
+        df.collect_coordinated_table(coordinator=coord, num_tasks=8)
+    ).to_pandas()
+    exp = df.to_pandas()
+    np.testing.assert_array_equal(got["k"].to_numpy(), exp["k"].to_numpy())
+    np.testing.assert_allclose(
+        got["s"].to_numpy(), exp["s"].to_numpy(), rtol=FLOAT_RTOL,
+        equal_nan=True,
+    )
+    assert coord.partial_decisions, "no mid-execution freeze happened"
+    for done, total in coord.partial_decisions.values():
+        assert done < total
+    infos = [i for i in coord._predicted.values() if i.ndv]
+    assert infos, "predicted LoadInfo carried no per-column statistics"
+    info = infos[0]
+    # the partial-agg producer's group column (__g0 internally) has the
+    # 64 distinct keys; accumulator NDVs ride along
+    assert any(1 <= v <= 64 for v in info.ndv.values()), info.ndv
+    assert info.null_frac, "no null fractions sampled"
+    assert info.rows_per_s > 0 and info.bytes_per_s > 0
