@@ -347,10 +347,15 @@ class Coordinator:
         )
 
         prepared = self._prepare_stage_plan(producer)
+        # peer producers are first PULLED when their consumer stage runs;
+        # on a deep plan that can be far beyond the worker registry's
+        # idle-TTL default, so ship them with a query-lifetime TTL (the
+        # query-end sweep, not the TTI cache, owns their cleanup)
+        peer_ttl = float(self.config_options.get("peer_task_ttl", 3600.0))
         producers = []  # (key_obj, url)
         for i in range(t_prod):
             worker, key, plan_obj, _store = self._dispatch_task(
-                prepared, query_id, stage_id, i, t_prod
+                prepared, query_id, stage_id, i, t_prod, ttl=peer_ttl
             )
             self._peer_shipped.append((worker, key))
             producers.append(
@@ -721,10 +726,12 @@ class Coordinator:
         return stage_plan
 
     def _dispatch_task(self, stage_plan, query_id, stage_id, task_number,
-                       task_count):
-        """Route, task-specialize, ship: -> (worker, key, plan_obj, store)."""
+                       task_count, ttl=None):
+        """Route, task-specialize, ship: -> (worker, key, plan_obj, store).
+        ``ttl`` overrides the worker registry's idle-TTL for this entry
+        (peer producers live until pulled or swept)."""
         disp = self._try_dispatch_span(stage_plan, query_id, stage_id,
-                                       task_number, task_count)
+                                       task_number, task_count, ttl=ttl)
         if disp is not None:
             return disp
         urls = self.resolver.get_urls()
@@ -741,7 +748,8 @@ class Coordinator:
         try:
             worker.set_plan(key, plan_obj, task_count,
                             config=self.config_options,
-                            headers=self.passthrough_headers)
+                            headers=self.passthrough_headers,
+                            ttl=ttl)
         except BaseException:
             # a failed ship leaves no registry entry to own the staged
             # slices — release them here or they leak until process exit
@@ -754,7 +762,7 @@ class Coordinator:
         return worker, key, plan_obj, store
 
     def _try_dispatch_span(self, stage_plan, query_id, stage_id,
-                           task_number, task_count):
+                           task_number, task_count, ttl=None):
         """Meshes-as-workers dispatch (SURVEY §2.10 "same-mesh = collective,
         off-mesh = RPC"): when every worker owns a device mesh
         (`MeshWorker.mesh_width`), a stage's tasks ship as contiguous
@@ -817,6 +825,7 @@ class Coordinator:
                         query_id, stage_id, lo, hi, task_count, plan_obj,
                         config=self.config_options,
                         headers=self.passthrough_headers,
+                        ttl=ttl,
                     )
                 except BaseException:
                     from datafusion_distributed_tpu.runtime.codec import (

@@ -69,11 +69,16 @@ def _handlers(worker: Worker):
                 worker.table_store.tables[tid] = decode_table(raw)
             worker.set_plan(key, header["plan"], header["task_count"],
                             config=header.get("config"),
-                            headers=header.get("headers"))
+                            headers=header.get("headers"),
+                            ttl=header.get("ttl"))
             return json.dumps({"ok": True}).encode()
         except WorkerError as e:
+            # a failed set_plan registered no entry to own the staged
+            # slices — release them or they leak until process exit
+            worker.table_store.remove(list(blobs))
             return json.dumps({"error": e.to_dict()}).encode()
         except Exception as e:  # structured contract for transport errors too
+            worker.table_store.remove(list(blobs))
             return json.dumps(
                 {"error": wrap_worker_exception(e, worker.url, key).to_dict()}
             ).encode()
@@ -268,7 +273,8 @@ class GrpcWorkerClient:
 
     def set_plan(self, key: TaskKey, plan_obj: dict, task_count: int,
                  config: Optional[dict] = None,
-                 headers: Optional[dict] = None) -> None:
+                 headers: Optional[dict] = None,
+                 ttl: Optional[float] = None) -> None:
         tids = collect_table_ids(plan_obj)
         blobs = {
             tid: encode_table(self.table_store.get(tid)) for tid in tids
@@ -281,6 +287,7 @@ class GrpcWorkerClient:
                 "task_count": task_count,
                 "config": config or {},
                 "headers": headers or {},
+                "ttl": ttl,
             },
             blobs,
             codec=self.compression,

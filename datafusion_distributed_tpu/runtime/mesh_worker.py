@@ -316,7 +316,8 @@ class MeshWorker(Worker):
     def set_stage_plan(self, query_id: str, stage_id: int, lo: int, hi: int,
                        task_count: int, plan_obj: dict,
                        config: Optional[dict] = None,
-                       headers: Optional[dict] = None) -> None:
+                       headers: Optional[dict] = None,
+                       ttl: Optional[float] = None) -> None:
         """Ship ONE span-specialized plan covering tasks [lo, hi); registers
         a TaskData per task so the inherited data-plane surfaces work."""
         from datafusion_distributed_tpu.runtime.codec import (
@@ -353,6 +354,7 @@ class MeshWorker(Worker):
                 task_count=task_count, config=dict(config or {}),
                 headers=dict(headers or {}),
                 shipped_table_ids=tids if i == lo else [],
+                ttl=ttl,
             )
             data.span = (state, i - lo)  # type: ignore[attr-defined]
             self.registry.put(data)

@@ -76,6 +76,11 @@ class TaskData:
     # cancelled/errored partition stream cannot leak TableStore entries on
     # a long-lived worker (ADVICE r4)
     shipped_table_ids: list = field(default_factory=list)
+    # per-entry idle TTL override (None = the registry default). Peer-plane
+    # producers ship at plan time but are first PULLED when their consumer
+    # stage finally runs — on a deep plan under load that gap exceeded the
+    # 600 s default and the entry evicted mid-query ("no plan for task").
+    ttl: Optional[float] = None
 
 
 RESERVED_HEADER_PREFIX = "x-dftpu-"
@@ -119,7 +124,9 @@ class TaskRegistry:
             if hit is None:
                 return None
             ts, data = hit
-            if time.time() - ts > self.ttl:
+            if time.time() - ts > (
+                data.ttl if data.ttl is not None else self.ttl
+            ):
                 del self._entries[key]
                 self._fire_evict(data)
                 return None
@@ -134,7 +141,10 @@ class TaskRegistry:
 
     def _evict(self) -> None:
         now = time.time()
-        dead = [k for k, (ts, _) in self._entries.items() if now - ts > self.ttl]
+        dead = [
+            k for k, (ts, d) in self._entries.items()
+            if now - ts > (d.ttl if d.ttl is not None else self.ttl)
+        ]
         for k in dead:
             _, data = self._entries.pop(k)
             self._fire_evict(data)
@@ -189,7 +199,8 @@ class Worker:
     # -- control plane ------------------------------------------------------
     def set_plan(self, key: TaskKey, plan_obj: dict, task_count: int,
                  config: Optional[dict] = None,
-                 headers: Optional[dict] = None) -> None:
+                 headers: Optional[dict] = None,
+                 ttl: Optional[float] = None) -> None:
         if headers:
             validate_passthrough_headers(headers)
         try:
@@ -208,6 +219,7 @@ class Worker:
             key=key, plan=plan, task_count=task_count,
             config=dict(config or {}), headers=dict(headers or {}),
             shipped_table_ids=collect_table_ids(plan_obj),
+            ttl=ttl,
         ))
 
     # -- data plane ---------------------------------------------------------

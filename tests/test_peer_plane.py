@@ -227,3 +227,36 @@ def test_peer_plane_over_grpc_cluster():
             assert w.table_store.tables == {}, "gRPC worker leaked store"
     finally:
         cluster.shutdown()
+
+
+def test_peer_producer_outlives_registry_ttl():
+    """Peer-shipped producers carry a query-lifetime TTL override: a
+    producer that is not pulled until long after the registry's idle-TTL
+    must still serve (observed at SF 0.5: deep plans left stage-4
+    producers unpulled for >600 s and they evicted mid-query)."""
+    import time
+
+    from datafusion_distributed_tpu.io.parquet import arrow_to_table
+    from datafusion_distributed_tpu.runtime.worker import TaskKey, Worker
+
+    from datafusion_distributed_tpu.plan.physical import MemoryScanExec
+    from datafusion_distributed_tpu.runtime.codec import encode_plan
+
+    w = Worker(ttl_seconds=0.2)
+    t = arrow_to_table(pa.table({"x": np.arange(32)}))
+    # separate encodes: the entries must not share shipped table ids, or
+    # the default-TTL entry's eviction would release the survivor's tables
+    plan_a = encode_plan(MemoryScanExec([t], t.schema()), w.table_store)
+    plan_b = encode_plan(MemoryScanExec([t], t.schema()), w.table_store)
+    w.set_plan(TaskKey("q", 0, 0), plan_a, 1, ttl=60.0)  # peer-style
+    w.set_plan(TaskKey("q", 0, 1), plan_b, 1)  # default TTL
+    time.sleep(0.5)
+    assert w.registry.get(TaskKey("q", 0, 0)) is not None, (
+        "peer producer evicted despite TTL override"
+    )
+    # ... and it still actually SERVES (tables intact, plan executable)
+    out = w.execute_task(TaskKey("q", 0, 0))
+    assert int(out.num_rows) == 32
+    assert w.registry.get(TaskKey("q", 0, 1)) is None, (
+        "default-TTL entry should have expired (test setup invalid)"
+    )

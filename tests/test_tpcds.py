@@ -357,30 +357,213 @@ def _oracle_q93(T):
 
 
 def _oracle_q98(T):
-    ss, i, d = T["store_sales"], T["item"], T["date_dim"]
-    j = (ss.merge(i, left_on="ss_item_sk", right_on="i_item_sk")
-           .merge(d, left_on="ss_sold_date_sk", right_on="d_date_sk"))
+    # store_sales variant of the shared q12/q20 shape; q98 has no LIMIT
+    return _revenue_ratio_oracle(T["store_sales"], T["item"], T["date_dim"],
+                                 "ss", limit=None)
+
+
+def _revenue_ratio_oracle(sales, i, d, pre, limit=100):
+    """Shared q12/q20/q98 shape: per-item revenue + share of its class."""
+    j = (sales.merge(i, left_on=f"{pre}_item_sk", right_on="i_item_sk")
+              .merge(d, left_on=f"{pre}_sold_date_sk", right_on="d_date_sk"))
     dd = pd.to_datetime(j.d_date)
     j = j[j.i_category.isin(["Sports", "Books", "Home"])
           & (dd >= "1999-02-22") & (dd <= "1999-03-24")]
+    price = f"{pre}_ext_sales_price"
     g = j.groupby(["i_item_id", "i_item_desc", "i_category", "i_class",
                    "i_current_price"], as_index=False, dropna=False)[
-        "ss_ext_sales_price"].sum().rename(
-        columns={"ss_ext_sales_price": "itemrevenue"})
+        price].sum().rename(columns={price: "itemrevenue"})
     class_sum = g.groupby("i_class", dropna=False)["itemrevenue"].transform(
         "sum")
     g["revenueratio"] = g.itemrevenue * 100.0 / class_sum
     g = g.sort_values(["i_category", "i_class", "i_item_id", "i_item_desc",
                        "revenueratio"])
-    return g.reset_index(drop=True)
+    return (g.head(limit) if limit else g).reset_index(drop=True)
 
 
-_DS_ORACLES = {"q3": _oracle_q3, "q7": _oracle_q7, "q19": _oracle_q19,
-               "q26": _oracle_q26, "q42": _oracle_q42, "q43": _oracle_q43,
-               "q52": _oracle_q52, "q55": _oracle_q55, "q62": _oracle_q62,
-               "q65": _oracle_q65, "q79": _oracle_q79, "q88": _oracle_q88,
-               "q90": _oracle_q90, "q93": _oracle_q93, "q96": _oracle_q96,
-               "q98": _oracle_q98}
+def _oracle_q12(T):
+    return _revenue_ratio_oracle(T["web_sales"], T["item"], T["date_dim"],
+                                 "ws")
+
+
+def _oracle_q20(T):
+    return _revenue_ratio_oracle(T["catalog_sales"], T["item"],
+                                 T["date_dim"], "cs")
+
+
+def _oracle_q15(T):
+    cs, c, ca, d = (T["catalog_sales"], T["customer"],
+                    T["customer_address"], T["date_dim"])
+    j = (cs.merge(c, left_on="cs_bill_customer_sk", right_on="c_customer_sk")
+           .merge(ca, left_on="c_current_addr_sk", right_on="ca_address_sk")
+           .merge(d, left_on="cs_sold_date_sk", right_on="d_date_sk"))
+    zips = {"85669", "86197", "88274", "83405", "86475", "85392", "85460",
+            "80348", "81792"}
+    j = j[(j.ca_zip.str[:5].isin(zips) | j.ca_state.isin(["CA", "WA", "GA"])
+           | (j.cs_sales_price > 500))
+          & (j.d_qoy == 2) & (j.d_year == 2001)]
+    g = j.groupby("ca_zip", as_index=False, dropna=False)[
+        "cs_sales_price"].sum()
+    return g.sort_values("ca_zip").head(100).reset_index(drop=True)
+
+
+def _inventory_price_oracle(T, fact, fact_item, price_lo, mfids, d_lo, d_hi):
+    """Shared q37/q82 shape: items in a price/manufacturer band with
+    mid-range inventory during a window, appearing in a sales fact."""
+    i, inv, d = T["item"], T["inventory"], T["date_dim"]
+    j = (inv.merge(i, left_on="inv_item_sk", right_on="i_item_sk")
+            .merge(d, left_on="inv_date_sk", right_on="d_date_sk"))
+    dd = pd.to_datetime(j.d_date)
+    j = j[(j.i_current_price >= price_lo)
+          & (j.i_current_price <= price_lo + 30)
+          & (dd >= d_lo) & (dd <= d_hi)
+          & j.i_manufact_id.isin(mfids)
+          & (j.inv_quantity_on_hand >= 100)
+          & (j.inv_quantity_on_hand <= 500)]
+    j = j[j.i_item_sk.isin(set(fact[fact_item]))]
+    g = j.groupby(["i_item_id", "i_item_desc", "i_current_price"],
+                  as_index=False, dropna=False).size()[
+        ["i_item_id", "i_item_desc", "i_current_price"]]
+    return g.sort_values("i_item_id").head(100).reset_index(drop=True)
+
+
+def _oracle_q37(T):
+    return _inventory_price_oracle(
+        T, T["catalog_sales"], "cs_item_sk", 68, [677, 940, 694, 808],
+        "2000-02-01", "2000-04-01")
+
+
+def _oracle_q82(T):
+    return _inventory_price_oracle(
+        T, T["store_sales"], "ss_item_sk", 62, [129, 270, 821, 423],
+        "2000-05-25", "2000-07-24")
+
+
+def _oracle_q91(T):
+    cc, cr, d = T["call_center"], T["catalog_returns"], T["date_dim"]
+    c, ca = T["customer"], T["customer_address"]
+    cd, hd = T["customer_demographics"], T["household_demographics"]
+    j = (cr.merge(cc, left_on="cr_call_center_sk",
+                  right_on="cc_call_center_sk")
+           .merge(d, left_on="cr_returned_date_sk", right_on="d_date_sk")
+           .merge(c, left_on="cr_returning_customer_sk",
+                  right_on="c_customer_sk")
+           .merge(cd, left_on="c_current_cdemo_sk", right_on="cd_demo_sk")
+           .merge(hd, left_on="c_current_hdemo_sk", right_on="hd_demo_sk")
+           .merge(ca, left_on="c_current_addr_sk", right_on="ca_address_sk"))
+    j = j[(j.d_year == 1998) & (j.d_moy == 11)
+          & (((j.cd_marital_status == "M")
+              & (j.cd_education_status == "Unknown"))
+             | ((j.cd_marital_status == "W")
+                & (j.cd_education_status == "Advanced Degree")))
+          & j.hd_buy_potential.str.startswith("Unknown")
+          & (j.ca_gmt_offset == -7)]
+    g = j.groupby(["cc_call_center_id", "cc_name", "cc_manager",
+                   "cd_marital_status", "cd_education_status"],
+                  as_index=False, dropna=False)["cr_net_loss"].sum()
+    g = g.sort_values("cr_net_loss", ascending=False)
+    return g[["cc_call_center_id", "cc_name", "cc_manager",
+              "cr_net_loss"]].reset_index(drop=True)
+
+
+def _oracle_q84(T):
+    c, ca, cd = (T["customer"], T["customer_address"],
+                 T["customer_demographics"])
+    hd, ib, sr = (T["household_demographics"], T["income_band"],
+                  T["store_returns"])
+    j = (c.merge(ca, left_on="c_current_addr_sk", right_on="ca_address_sk")
+          .merge(cd, left_on="c_current_cdemo_sk", right_on="cd_demo_sk")
+          .merge(hd, left_on="c_current_hdemo_sk", right_on="hd_demo_sk")
+          .merge(ib, left_on="hd_income_band_sk",
+                 right_on="ib_income_band_sk")
+          .merge(sr, left_on="cd_demo_sk", right_on="sr_cdemo_sk"))
+    j = j[(j.ca_city == "Edgewood") & (j.ib_lower_bound >= 38128)
+          & (j.ib_upper_bound <= 38128 + 50000)]
+    out = pd.DataFrame({
+        "customer_id": j.c_customer_id,
+        "customername": (j.c_last_name.fillna("") + ", "
+                         + j.c_first_name.fillna("")),
+    })
+    return out.sort_values("customer_id").head(100).reset_index(drop=True)
+
+
+def _sold_pairs(T, fact, cust_col, item_col, date_col):
+    d = T["date_dim"]
+    j = fact.merge(d, left_on=date_col, right_on="d_date_sk")
+    j = j[(j.d_month_seq >= 1200) & (j.d_month_seq <= 1211)]
+    return j[[cust_col, item_col]].drop_duplicates().rename(
+        columns={cust_col: "customer_sk", item_col: "item_sk"})
+
+
+def _oracle_q97(T):
+    ss = _sold_pairs(T, T["store_sales"], "ss_customer_sk", "ss_item_sk",
+                     "ss_sold_date_sk")
+    cs = _sold_pairs(T, T["catalog_sales"], "cs_bill_customer_sk",
+                     "cs_item_sk", "cs_sold_date_sk")
+    # NULL-customer groups count NOWHERE: the query's CASE arms all test
+    # customer_sk IS [NOT] NULL on one side, and a NULL-keyed row from the
+    # FULL OUTER JOIN satisfies none of them (also keeps pandas' NaN==NaN
+    # merge semantics from fabricating SQL-impossible matches)
+    ss = ss[ss.customer_sk.notna()]
+    cs = cs[cs.customer_sk.notna()]
+    m = ss.merge(cs, on=["customer_sk", "item_sk"], how="outer",
+                 indicator=True)
+    return pd.DataFrame({
+        "store_only": [int((m._merge == "left_only").sum())],
+        "catalog_only": [int((m._merge == "right_only").sum())],
+        "store_and_catalog": [int((m._merge == "both").sum())],
+    })
+
+
+def _oracle_q38(T):
+    d, c = T["date_dim"], T["customer"]
+
+    def distinct(fact, date_col, cust_col):
+        j = (fact.merge(d, left_on=date_col, right_on="d_date_sk")
+                 .merge(c, left_on=cust_col, right_on="c_customer_sk"))
+        j = j[(j.d_month_seq >= 1200) & (j.d_month_seq <= 1211)]
+        return set(map(tuple, j[["c_last_name", "c_first_name", "d_date"]]
+                       .fillna("\0").itertuples(index=False)))
+
+    s1 = distinct(T["store_sales"], "ss_sold_date_sk", "ss_customer_sk")
+    s2 = distinct(T["catalog_sales"], "cs_sold_date_sk",
+                  "cs_bill_customer_sk")
+    s3 = distinct(T["web_sales"], "ws_sold_date_sk", "ws_bill_customer_sk")
+    return pd.DataFrame({"count": [len(s1 & s2 & s3)]})
+
+
+def _oracle_q99(T):
+    cs, w, sm = T["catalog_sales"], T["warehouse"], T["ship_mode"]
+    cc, d = T["call_center"], T["date_dim"]
+    j = (cs.merge(d, left_on="cs_ship_date_sk", right_on="d_date_sk")
+           .merge(w, left_on="cs_warehouse_sk", right_on="w_warehouse_sk")
+           .merge(sm, left_on="cs_ship_mode_sk", right_on="sm_ship_mode_sk")
+           .merge(cc, left_on="cs_call_center_sk",
+                  right_on="cc_call_center_sk"))
+    j = j[(j.d_month_seq >= 1200) & (j.d_month_seq <= 1211)]
+    j["w_substr"] = j.w_warehouse_name.str[:20]
+    j["cc_lower"] = j.cc_name.str.lower()
+    lag = j.cs_ship_date_sk - j.cs_sold_date_sk
+    j["b1"] = (lag <= 30).astype("int64")
+    j["b2"] = ((lag > 30) & (lag <= 60)).astype("int64")
+    j["b3"] = ((lag > 60) & (lag <= 90)).astype("int64")
+    j["b4"] = ((lag > 90) & (lag <= 120)).astype("int64")
+    j["b5"] = (lag > 120).astype("int64")
+    g = j.groupby(["w_substr", "sm_type", "cc_lower"], as_index=False,
+                  dropna=False)[["b1", "b2", "b3", "b4", "b5"]].sum()
+    return g.sort_values(["w_substr", "sm_type", "cc_lower"]).head(
+        100).reset_index(drop=True)
+
+
+_DS_ORACLES = {"q3": _oracle_q3, "q7": _oracle_q7, "q12": _oracle_q12,
+               "q15": _oracle_q15, "q19": _oracle_q19, "q20": _oracle_q20,
+               "q26": _oracle_q26, "q37": _oracle_q37, "q38": _oracle_q38,
+               "q42": _oracle_q42, "q43": _oracle_q43, "q52": _oracle_q52,
+               "q55": _oracle_q55, "q62": _oracle_q62, "q65": _oracle_q65,
+               "q79": _oracle_q79, "q82": _oracle_q82, "q84": _oracle_q84,
+               "q88": _oracle_q88, "q90": _oracle_q90, "q91": _oracle_q91,
+               "q93": _oracle_q93, "q96": _oracle_q96, "q97": _oracle_q97,
+               "q98": _oracle_q98, "q99": _oracle_q99}
 
 
 @pytest.mark.parametrize("qname", sorted(_DS_ORACLES))
