@@ -1,0 +1,100 @@
+"""Compose SWEEP_r05.md from the JSONL emitted by benchmarks/sweep_sf.py.
+
+Usage: python benchmarks/compose_sweep_md.py [--in .sweep_r05.jsonl] [--out SWEEP_r05.md]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from collections import defaultdict
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--inp", default="/root/repo/.sweep_r05.jsonl")
+    ap.add_argument("--out", default="/root/repo/SWEEP_r05.md")
+    args = ap.parse_args()
+
+    rows = [json.loads(l) for l in open(args.inp) if l.strip()]
+    datagen = next((r for r in rows if r.get("stage") == "datagen"), None)
+    per = defaultdict(dict)   # query -> tier -> record
+    tiers_seen: list[str] = []
+    for r in rows:
+        if "tier" not in r:
+            continue
+        q, tier = r["query"], r["tier"]
+        per[q][tier] = r
+        if tier not in tiers_seen:
+            tiers_seen.append(tier)
+
+    # stream / adaptive evidence aggregated across queries
+    n_retries = sum(r.get("retries") or 0
+                    for byt in per.values() for r in byt.values())
+    partials = sum(1 for byt in per.values()
+                   for r in byt.values() if r.get("partial_decisions"))
+    resized = sum(1 for byt in per.values() for r in byt.values()
+                  for (sid, planned, got) in (r.get("task_count_decisions") or [])
+                  if got != planned)
+    multi_chunk = 0
+    for byt in per.values():
+        for r in byt.values():
+            for m in r.get("streams") or []:
+                if (m.get("chunks") or 0) > 1:
+                    multi_chunk += 1
+
+    def qkey(q: str) -> int:
+        return int(q[1:])
+
+    lines = ["# SWEEP r05 — scale-up TPC-H parity (non-trivial data)", ""]
+    if datagen:
+        rws = datagen.get("rows", {})
+        lines += [
+            f"Data: TPC-H SF {datagen['sf']} generated in "
+            f"{datagen['seconds']}s — lineitem {rws.get('lineitem', '?'):,} rows, "
+            f"orders {rws.get('orders', '?'):,}, customer {rws.get('customer', '?'):,}.",
+            "",
+            "Every tier is checked for multiset equality against the single-node"
+            " result (float rtol 5e-4). `bytes_per_task=1` forces maximum"
+            " distribution, the forced-heavy-distribution intent of the"
+            " reference's `tpch_correctness_test.rs:23-80`.",
+            "",
+        ]
+    hdr = "| query | " + " | ".join(
+        f"{t} (s)" for t in tiers_seen) + " | parity |"
+    lines += [hdr, "|" + "---|" * (len(tiers_seen) + 2)]
+    n_ok = n_bad = 0
+    for q in sorted(per, key=qkey):
+        cells, all_ok = [], True
+        for t in tiers_seen:
+            r = per[q].get(t)
+            if r is None:
+                cells.append("—")
+            elif r.get("ok"):
+                cells.append(f"{r['seconds']}")
+            else:
+                all_ok = False
+                cells.append(f"FAIL: {r.get('mismatch') or r.get('error', '?')[:60]}")
+        n_ok += all_ok
+        n_bad += not all_ok
+        lines.append(f"| {q} | " + " | ".join(cells)
+                     + (" | ok |" if all_ok else " | MISMATCH |"))
+    lines += [
+        "",
+        f"**{n_ok} queries match across all tiers; {n_bad} mismatch.**",
+        "",
+        "## Machinery exercised at this scale",
+        "",
+        f"- overflow retries observed: {n_retries}",
+        f"- mid-execution partial-sample decisions frozen: {partials}",
+        f"- adaptive task-count resizes (got != planned): {resized}",
+        f"- multi-chunk producer streams: {multi_chunk}",
+        "",
+    ]
+    open(args.out, "w").write("\n".join(lines))
+    print(f"wrote {args.out}: {n_ok} ok / {n_bad} bad")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,468 @@
+"""AST-level helpers shared by the binder and its mixins (split out of
+logical.py): conjunct splitting, aggregate/window call collection, constant
+folding over dates/decimals, ROLLUP expansion, fingerprinting."""
+
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass
+from typing import Any
+
+from datafusion_distributed_tpu.plan import expressions as pe
+from datafusion_distributed_tpu.schema import DataType
+from datafusion_distributed_tpu.sql import parser as ast
+from datafusion_distributed_tpu.sql.lplan import LogicalPlan, LProject
+from datafusion_distributed_tpu.sql.scope import BindError
+
+# ---------------------------------------------------------------------------
+# AST utilities
+# ---------------------------------------------------------------------------
+
+from datafusion_distributed_tpu.ops.aggregate import (  # noqa: E402
+    _VARIANCE_FUNCS,
+)
+
+_AGG_FUNCS = {"sum", "count", "min", "max", "avg"} | _VARIANCE_FUNCS
+_WINDOW_ONLY_FUNCS = {"rank", "dense_rank", "row_number"}
+
+
+def _collect_window_calls(node, out: list) -> None:
+    if isinstance(node, ast.FuncCall) and node.over is not None:
+        out.append(node)
+        _AGG_ID_REGISTRY[id(node)] = node
+        return
+    if isinstance(node, (ast.ScalarSubquery, ast.Exists, ast.InSubquery)):
+        return
+    for ch in _ast_children(node):
+        _collect_window_calls(ch, out)
+_AGG_ID_REGISTRY: dict[int, Any] = {}
+
+
+def _agg_parts(call: ast.FuncCall):
+    arg = call.args[0] if call.args else ast.Star()
+    return call.name, arg, call.distinct
+
+
+def _collect_agg_calls(node, out: list) -> None:
+    if isinstance(node, ast.FuncCall) and node.over is not None:
+        # a window call is NOT a group aggregate, but its argument and spec
+        # may contain ones (sum(sum(x)) over (partition by ...))
+        for a in node.args:
+            _collect_agg_calls(a, out)
+        for p in node.over.partition_by:
+            _collect_agg_calls(p, out)
+        for o in node.over.order_by:
+            _collect_agg_calls(o.expr, out)
+        return
+    if isinstance(node, ast.FuncCall) and node.name in _AGG_FUNCS:
+        out.append(node)
+        _AGG_ID_REGISTRY[id(node)] = node
+        return  # nested aggregates are invalid SQL
+    if isinstance(node, (ast.ScalarSubquery, ast.Exists, ast.InSubquery)):
+        return  # subquery aggregates belong to the subquery
+    for ch in _ast_children(node):
+        _collect_agg_calls(ch, out)
+
+
+def _ast_children(node) -> list:
+    if isinstance(node, ast.Binary):
+        return [node.left, node.right]
+    if isinstance(node, ast.Unary):
+        return [node.child]
+    if isinstance(node, ast.Between):
+        return [node.expr, node.low, node.high]
+    if isinstance(node, ast.InListAst):
+        return [node.expr] + list(node.items)
+    if isinstance(node, ast.InSubquery):
+        return [node.expr]
+    if isinstance(node, ast.LikeAst):
+        return [node.expr]
+    if isinstance(node, ast.IsNullAst):
+        return [node.expr]
+    if isinstance(node, ast.CaseAst):
+        out = []
+        if node.operand is not None:
+            out.append(node.operand)
+        for c, v in node.whens:
+            out += [c, v]
+        if node.else_ is not None:
+            out.append(node.else_)
+        return out
+    if isinstance(node, ast.CastAst):
+        return [node.expr]
+    if isinstance(node, ast.ExtractAst):
+        return [node.expr]
+    if isinstance(node, ast.SubstringAst):
+        return [node.expr]
+    if isinstance(node, ast.FuncCall):
+        return list(node.args)
+    return []
+
+
+def _is_rollup(g) -> bool:
+    return isinstance(g, ast.FuncCall) and g.name.lower() == "rollup"
+
+
+def _ast_substitute(node, fn):
+    """Rebuild an AST bottom-up: fn(node) -> replacement or None (recurse).
+    Does NOT descend into nested Query/SetOp (their own scopes own their
+    identifiers)."""
+    import dataclasses as _dc
+
+    if isinstance(node, (ast.Query, ast.SetOp)):
+        return node
+    rep = fn(node)
+    if rep is not None:
+        return rep
+    if isinstance(node, list):
+        return [_ast_substitute(x, fn) for x in node]
+    if isinstance(node, tuple):
+        return tuple(_ast_substitute(x, fn) for x in node)
+    if _dc.is_dataclass(node) and not isinstance(node, type):
+        changes = {}
+        for fld in _dc.fields(node):
+            v = getattr(node, fld.name)
+            nv = _ast_substitute(v, fn)
+            if nv is not v:
+                changes[fld.name] = nv
+        return _dc.replace(node, **changes) if changes else node
+    return node
+
+
+def _expand_rollup(q: "ast.Query"):
+    """GROUP BY ROLLUP(a, b, ...) -> UNION ALL of one aggregation per prefix
+    of the rollup list (finest to grand total). Rolled-away columns become
+    typed NULLs (ast.NullOf) and GROUPING(col) folds to 0/1 per arm — the
+    standard lowering (the reference gets it from DataFusion's logical
+    planner)."""
+    import dataclasses as _dc
+
+    plain = [g for g in q.group_by if not _is_rollup(g)]
+    roll = next(g for g in q.group_by if _is_rollup(g)).args
+    if sum(1 for g in q.group_by if _is_rollup(g)) > 1:
+        raise BindError("multiple ROLLUPs in one GROUP BY")
+
+    arms = []
+    for k in range(len(roll), -1, -1):
+        dropped = {
+            i.name.lower() for i in roll[k:] if isinstance(i, ast.Ident)
+        }
+
+        def fn(node, dropped=dropped):
+            if isinstance(node, ast.FuncCall) and node.name.lower() == (
+                "grouping"
+            ):
+                arg = node.args[0]
+                flag = 1 if (
+                    isinstance(arg, ast.Ident) and arg.name.lower() in dropped
+                ) else 0
+                return ast.NumberLit(flag)
+            if isinstance(node, ast.Ident) and node.name.lower() in dropped:
+                return ast.NullOf(node)
+            return None
+
+        arm = _dc.replace(
+            q,
+            select_items=_ast_substitute(q.select_items, fn),
+            group_by=plain + list(roll[:k]),
+            having=_ast_substitute(q.having, fn) if q.having else None,
+            order_by=[],
+            limit=None,
+            offset=None,
+            ctes=[],
+        )
+        arms.append(arm)
+
+    combined = arms[0]
+    for arm in arms[1:]:
+        combined = ast.SetOp("union", True, combined, arm)
+
+    def order_fn(node):
+        # ORDER BY applies to the union result, where the arm is no longer
+        # known statically; GROUPING(col) is recovered per row as
+        # `CASE WHEN col IS NULL THEN 1 ELSE 0 END` (exact whenever the
+        # group column itself is non-null, which holds for the rollup
+        # dimensions in the TPC-DS suite).
+        if isinstance(node, ast.FuncCall) and node.name.lower() == "grouping":
+            return ast.CaseAst(
+                None,
+                [(ast.IsNullAst(node.args[0], False), ast.NumberLit(1))],
+                ast.NumberLit(0),
+            )
+        return None
+
+    combined.order_by = _ast_substitute(list(q.order_by), order_fn)
+    combined.limit = q.limit
+    combined.offset = q.offset
+    combined.ctes = list(q.ctes)
+    return combined
+
+
+def _contains_subquery(node) -> bool:
+    if isinstance(node, (ast.ScalarSubquery, ast.Exists, ast.InSubquery)):
+        return True
+    if isinstance(node, ast.Unary) and node.op == "not":
+        return _contains_subquery(node.child)
+    return any(_contains_subquery(ch) for ch in _ast_children(node))
+
+
+def _common_or_conjuncts(node: ast.Binary) -> list:
+    """Conjuncts present (by fingerprint) in every branch of an OR tree."""
+
+    def branches(n):
+        if isinstance(n, ast.Binary) and n.op == "or":
+            return branches(n.left) + branches(n.right)
+        return [n]
+
+    bs = branches(node)
+    if len(bs) < 2:
+        return []
+    sets = []
+    by_fp: dict[str, Any] = {}
+    for b in bs:
+        cs = _split_conjuncts(b)
+        fps = set()
+        for c in cs:
+            fp = _ast_fingerprint(c)
+            fps.add(fp)
+            by_fp.setdefault(fp, c)
+        sets.append(fps)
+    common = set.intersection(*sets)
+    return [by_fp[fp] for fp in sorted(common)]
+
+
+def _hoist_common_or(c) -> list:
+    """OR whose every branch repeats the same conjuncts ->
+    [common..., OR(branches stripped of them)] — an EQUIVALENT rewrite
+    (unlike _common_or_conjuncts, which only surfaces the implied
+    conjuncts). TPC-DS q41 hides its correlation this way:
+    `(corr AND colorsA) OR (corr AND colorsB)`."""
+    if not (isinstance(c, ast.Binary) and c.op == "or"):
+        return [c]
+    common = _common_or_conjuncts(c)
+    if not common:
+        return [c]
+    common_fps = {_ast_fingerprint(x) for x in common}
+
+    def branches(n):
+        if isinstance(n, ast.Binary) and n.op == "or":
+            return branches(n.left) + branches(n.right)
+        return [n]
+
+    stripped = []
+    for b in branches(c):
+        rest = [
+            x for x in _split_conjuncts(b)
+            if _ast_fingerprint(x) not in common_fps
+        ]
+        if not rest:
+            # one branch reduces to TRUE -> the whole OR is implied by the
+            # common conjuncts
+            return list(common)
+        stripped.append(_join_conjuncts(rest))
+    out = stripped[0]
+    for b in stripped[1:]:
+        out = ast.Binary("or", out, b)
+    return list(common) + [out]
+
+
+def _sort_fetch(q) -> "int | None":
+    """Top-k bound for a sort feeding LIMIT/OFFSET: limit+offset rows."""
+    if q.limit is None:
+        return None
+    return q.limit + (q.offset or 0)
+
+
+def _split_conjuncts(node) -> list:
+    if isinstance(node, ast.Binary) and node.op == "and":
+        return _split_conjuncts(node.left) + _split_conjuncts(node.right)
+    return [node]
+
+
+def _join_conjuncts(conjuncts: list):
+    if not conjuncts:
+        return None
+    out = conjuncts[0]
+    for c in conjuncts[1:]:
+        out = ast.Binary("and", out, c)
+    return out
+
+
+def _has_aggregates(q: ast.Query) -> bool:
+    out: list = []
+    for item in q.select_items:
+        _collect_agg_calls(item.expr, out)
+    return bool(out) or bool(q.group_by)
+
+
+def _ast_fingerprint(node) -> str:
+    """Structural fingerprint for matching GROUP BY exprs to SELECT exprs."""
+    if isinstance(node, ast.Ident):
+        return f"id:{node.qualifier or ''}.{node.name}"
+    if isinstance(node, ast.NumberLit):
+        return f"n:{node.value}"
+    if isinstance(node, ast.StringLit):
+        return f"s:{node.value}"
+    if isinstance(node, ast.DateLit):
+        return f"d:{node.days}"
+    if isinstance(node, ast.FuncCall):
+        args = ",".join(_ast_fingerprint(a) for a in node.args)
+        return f"f:{node.name}({args}){'D' if node.distinct else ''}"
+    if isinstance(node, ast.Star):
+        return f"*:{node.qualifier or ''}"
+    parts = ",".join(_ast_fingerprint(c) for c in _ast_children(node))
+    op = getattr(node, "op", "")
+    extra = ""
+    if isinstance(node, ast.LikeAst):
+        extra = f":{node.pattern}:{node.negated}"
+    if isinstance(node, ast.CastAst):
+        extra = f":{node.type_name}"
+    if isinstance(node, ast.ExtractAst):
+        extra = f":{node.part}"
+    return f"{type(node).__name__}:{op}{extra}({parts})"
+
+
+def _display_name(e, idx: int) -> str:
+    if isinstance(e, ast.Ident):
+        return e.name
+    return f"col{idx}"
+
+
+def _literal_expr(v):
+    if v is None:
+        # untyped NULL: the type comes from context (set-op peer, CASE arm,
+        # comparison partner) via _promote's NULL rule
+        return pe.Literal(None, DataType.NULL)
+    if isinstance(v, bool):
+        return pe.Literal(v, DataType.BOOL)
+    if isinstance(v, int):
+        return pe.Literal(v, DataType.INT64)
+    return pe.Literal(float(v), DataType.FLOAT64)
+
+
+def _cast_type(name: str) -> DataType:
+    name = name.strip().lower()
+    mapping = {
+        "int": DataType.INT32,
+        "integer": DataType.INT32,
+        "bigint": DataType.INT64,
+        "smallint": DataType.INT32,
+        "double": DataType.FLOAT64,
+        "double precision": DataType.FLOAT64,
+        "float": DataType.FLOAT32,
+        "real": DataType.FLOAT32,
+        "decimal": DataType.FLOAT64,
+        "numeric": DataType.FLOAT64,
+        "date": DataType.DATE32,
+        "boolean": DataType.BOOL,
+        "varchar": DataType.STRING,
+        "char": DataType.STRING,
+        "text": DataType.STRING,
+        "string": DataType.STRING,
+    }
+    if name in mapping:
+        return mapping[name]
+    raise BindError(f"unsupported cast type {name!r}")
+
+
+def _fold_date_arith(e: ast.Binary):
+    """Fold DATE +/- INTERVAL into a DateLit (TPC-H parameterized dates)."""
+    if e.op not in ("+", "-"):
+        return None
+    l, r = e.left, e.right
+    if isinstance(l, ast.DateLit) and isinstance(r, ast.IntervalLit):
+        sign = 1 if e.op == "+" else -1
+        days = _shift_date(l.days, sign * r.months, sign * r.days)
+        return pe.Literal(days, DataType.DATE32)
+    if isinstance(l, ast.IntervalLit) and isinstance(r, ast.DateLit) and e.op == "+":
+        days = _shift_date(r.days, l.months, l.days)
+        return pe.Literal(days, DataType.DATE32)
+    return None
+
+
+def _as_decimal(node):
+    """NumberLit (or +/-/*// tree of them) -> decimal.Decimal, else None."""
+    import decimal
+
+    if isinstance(node, ast.NumberLit):
+        if node.raw is not None:
+            return decimal.Decimal(node.raw)
+        if isinstance(node.value, int):
+            return decimal.Decimal(node.value)
+        return None
+    if isinstance(node, ast.Unary) and node.op == "-":
+        d = _as_decimal(node.child)
+        return -d if d is not None else None
+    if isinstance(node, ast.Binary) and node.op in ("+", "-", "*", "/"):
+        l = _as_decimal(node.left)
+        r = _as_decimal(node.right)
+        if l is None or r is None:
+            return None
+        if node.op == "+":
+            return l + r
+        if node.op == "-":
+            return l - r
+        if node.op == "*":
+            return l * r
+        if r == 0:
+            return None
+        return l / r
+
+
+def _fold_decimal_arith(e: ast.Binary):
+    if e.op not in ("+", "-", "*", "/"):
+        return None
+    if not (
+        isinstance(e.left, (ast.NumberLit, ast.Binary, ast.Unary))
+        and isinstance(e.right, (ast.NumberLit, ast.Binary, ast.Unary))
+    ):
+        return None
+    d = _as_decimal(e)
+    if d is None:
+        return None
+    if d == d.to_integral_value() and "." not in str(d):
+        return pe.Literal(int(d), DataType.INT64)
+    return pe.Literal(float(d), DataType.FLOAT64)
+
+
+def _shift_date(epoch_days: int, months: int, days: int) -> int:
+    import datetime
+
+    d = datetime.date(1970, 1, 1) + datetime.timedelta(days=epoch_days)
+    if months:
+        total = d.year * 12 + (d.month - 1) + months
+        y, m = divmod(total, 12)
+        import calendar
+
+        day = min(d.day, calendar.monthrange(y, m + 1)[1])
+        d = datetime.date(y, m + 1, day)
+    d = d + datetime.timedelta(days=days)
+    return (d - datetime.date(1970, 1, 1)).days
+
+
+def _collect_col_names(exprs) -> list[str]:
+    out: list[str] = []
+
+    def walk(x):
+        if isinstance(x, pe.Col):
+            out.append(x.name)
+        for c in x.children():
+            walk(c)
+
+    for e in exprs:
+        walk(e)
+    return out
+
+
+def _project_through(plan: LogicalPlan, exprs) -> LogicalPlan:
+    """Append columns to a plan's output by re-projecting through its top
+    projection (used to expose correlation key columns of a subquery)."""
+    if isinstance(plan, LProject):
+        have = {n for _, n in plan.exprs}
+        extra = []
+        cs = plan.child.schema()
+        for e, n in exprs:
+            if n not in have:
+                extra.append((e, n))
+        return LProject(plan.exprs + extra, plan.child)
+    return LProject(exprs, plan)

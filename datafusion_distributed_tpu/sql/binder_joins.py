@@ -1,0 +1,233 @@
+"""Join-ordering half of the binder (mixin; split out of logical.py).
+
+Implicit comma joins: WHERE conjuncts are classified into single-relation
+filters (pushed down), equi-join edges (drive a greedy left-deep join order
+by estimated fan-out), and residual post-join filters. Explicit [OUTER]
+JOINs fold in written order (outer joins are never reordered).
+"""
+
+from __future__ import annotations
+
+from datafusion_distributed_tpu.plan import expressions as pe
+from datafusion_distributed_tpu.sql import parser as ast
+from datafusion_distributed_tpu.sql.ast_utils import _split_conjuncts
+from datafusion_distributed_tpu.sql.lplan import (
+    LFilter,
+    LJoin,
+    LProject,
+    LScan,
+    LSetOp,
+    LogicalPlan,
+)
+from datafusion_distributed_tpu.sql.scope import BindError
+
+
+class JoinOrderingMixin:
+    """Binder methods for explicit-join folding and implicit join ordering."""
+
+    # -- join ordering --------------------------------------------------------
+    def _fold_explicit_join(self, uplan, ualiases, jc, ralias, rplan, scope,
+                            outer_refs):
+        """Fold one explicit [OUTER] JOIN clause in written order (outer joins
+        must not be reordered; the preserved side is the accumulated left)."""
+        if jc.kind == "cross":
+            return LJoin(uplan, rplan, "cross", [], [])
+        on_conjuncts = _split_conjuncts(jc.on) if jc.on is not None else []
+        lkeys, rkeys = [], []
+        post: list = []
+        for c in on_conjuncts:
+            aliases = self._aliases_of(c, scope)
+            if (
+                isinstance(c, ast.Binary) and c.op == "=="
+                and len(aliases) == 2
+            ):
+                la = self._aliases_of(c.left, scope)
+                ra = self._aliases_of(c.right, scope)
+                if la <= ualiases and ra == {ralias}:
+                    lkeys.append(self._bind_expr(c.left, scope, outer_refs))
+                    rkeys.append(self._bind_expr(c.right, scope, outer_refs))
+                    continue
+                if ra <= ualiases and la == {ralias}:
+                    lkeys.append(self._bind_expr(c.right, scope, outer_refs))
+                    rkeys.append(self._bind_expr(c.left, scope, outer_refs))
+                    continue
+            if aliases == {ralias} and jc.kind in ("left", "inner"):
+                # null-supplying-side-only conjunct: pre-filtering that side
+                # is equivalent for LEFT (and INNER) joins
+                rplan = LFilter(self._bind_expr(c, scope, outer_refs), rplan)
+                continue
+            post.append(c)
+        if post:
+            if jc.kind != "inner":
+                raise BindError(
+                    f"unsupported non-equi ON conjunct for {jc.kind.upper()} "
+                    f"JOIN: {post[0]!r}"
+                )
+        if not lkeys:
+            raise BindError(
+                f"{jc.kind.upper()} JOIN without an equi ON condition"
+            )
+        kind = jc.kind
+        fanout = self._scan_fanout(rplan, rkeys)
+        if kind == "right":
+            # preserved side must be the probe: swap
+            out = LJoin(rplan, uplan, "left", rkeys, lkeys)
+        elif kind == "full":
+            # FULL OUTER = LEFT JOIN  UNION ALL  (right rows with no match,
+            # left columns padded with typed NULLs) — the mirror of the
+            # reference's HashJoinExec Full mode, built from the primitives
+            # the TPU kernels already have (left + anti).
+            lj = LJoin(uplan, rplan, "left", lkeys, rkeys)
+            anti = LJoin(rplan, uplan, "anti", rkeys, lkeys)
+            null_left = LProject(
+                [(pe.Literal(None, f.dtype), f.name)
+                 for f in uplan.schema().fields]
+                + [(pe.Col(f.name), f.name) for f in rplan.schema().fields],
+                anti,
+            )
+            out = LSetOp("union", True, lj, null_left)
+        else:
+            out = LJoin(uplan, rplan, kind, lkeys, rkeys,
+                        fanout_hint=fanout)
+        for c in post:
+            out = LFilter(self._bind_expr(c, scope, outer_refs), out)
+        return out
+
+    def _scan_fanout(self, rplan: LogicalPlan, rkeys: list) -> float:
+        """Estimated matches per probe row for a join against ``rplan`` on
+        ``rkeys`` (bound Cols): rows(build) / ndv(build key). Explicit JOINs
+        (q72's catalog_sales x inventory on item_sk) can be many-to-many;
+        starting the output capacity at the NDV-implied expansion avoids
+        burning every overflow retry on a 1x initial guess."""
+        scans: dict[str, LScan] = {}
+
+        def walk(n):
+            if isinstance(n, LScan):
+                scans[n.alias] = n
+            for c in n.children():
+                walk(c)
+
+        walk(rplan)
+        if not scans:
+            return 1.0
+        fanouts = []
+        for k in rkeys:
+            if not isinstance(k, pe.Col) or "." not in k.name:
+                continue
+            alias, _, col = k.name.partition(".")
+            scan = scans.get(alias)
+            if scan is None:
+                continue
+            try:
+                # filter-discounted build rows (same heuristic as
+                # _relation_rows: /3 per filter above the scan) — the full
+                # table row count would overstate the fan-out by the build
+                # side's selectivity
+                rows = self._relation_rows(alias, rplan)
+                ndv = self.catalog.column_ndv(scan.table, col)
+            except Exception:
+                continue
+            if ndv:
+                fanouts.append(max(float(rows) / float(ndv), 1.0))
+        # several equi keys bound the fan-out by the most selective one
+        return min(fanouts) if fanouts else 1.0
+
+    def _join_fanout(self, edge, ualiases, urows, alias_tables) -> float:
+        """Estimated output rows per probe row if this edge attaches the
+        unit: rows(new) / ndv(new-side key). FK->PK joins (unique key on the
+        new side) give ~1; low-cardinality keys (nationkey=nationkey) give a
+        blow-up factor the orderer must avoid."""
+        la, le, ra, re_ = edge
+        inner_ast = le if la in ualiases else re_
+        if not isinstance(inner_ast, ast.Ident):
+            return 1.0
+        # resolve alias for the ident within the unit
+        alias = inner_ast.qualifier
+        if alias is None:
+            alias = la if la in ualiases else ra
+        table = alias_tables.get(alias)
+        if table is None:
+            return 1.0
+        ndv = self.catalog.column_ndv(table, inner_ast.name)
+        if not ndv:
+            return 1.0
+        return max(float(urows) / float(ndv), 1.0)
+
+    def _order_joins(self, units, equi_edges, scope, outer_refs,
+                     alias_tables=None):
+        """Greedily join units (relations or pre-folded outer-join groups):
+        probe side = the largest unit (the fact table keeps output
+        cardinality bounded by the probe side, which is what the static
+        output-capacity model wants); among connected candidates, attach the
+        one with the smallest estimated fan-out first (FK->PK dimension
+        joins before many-to-many edges), breaking ties by unit size."""
+        alias_tables = alias_tables or {}
+        units = [list(u) for u in units]
+        if len(units) == 1:
+            return units[0][0]
+        start = max(range(len(units)), key=lambda i: units[i][2])
+        plan, joined, _rows = units[start]
+        remaining = [u for i, u in enumerate(units) if i != start]
+        edges = list(equi_edges)
+        while remaining:
+            candidates = []
+            for ui, u in enumerate(remaining):
+                _, ualiases, urows = u
+                fanouts = []
+                for e in edges:
+                    la, _, ra, _ = e
+                    if (la in joined and ra in ualiases) or (
+                        ra in joined and la in ualiases
+                    ):
+                        fanouts.append(
+                            self._join_fanout(e, ualiases, urows, alias_tables)
+                        )
+                if fanouts:
+                    # several edges bound the fan-out by the most selective
+                    candidates.append((min(fanouts), urows, ui))
+            if not candidates:
+                u = remaining.pop(0)
+                plan = LJoin(plan, u[0], "cross", [], [])
+                joined |= u[1]
+                continue
+            candidates.sort()
+            best_fanout, _, ui = candidates[0]
+            u = remaining.pop(ui)
+            _, ualiases, _ = u
+            lkeys, rkeys, rest = [], [], []
+            for e in edges:
+                la, le, ra, re_ = e
+                if la in joined and ra in ualiases:
+                    lkeys.append(self._bind_expr(le, scope, outer_refs))
+                    rkeys.append(self._bind_expr(re_, scope, outer_refs))
+                elif ra in joined and la in ualiases:
+                    lkeys.append(self._bind_expr(re_, scope, outer_refs))
+                    rkeys.append(self._bind_expr(le, scope, outer_refs))
+                else:
+                    rest.append(e)
+            edges = rest
+            plan = LJoin(plan, u[0], "inner", lkeys, rkeys,
+                         fanout_hint=float(best_fanout))
+            joined |= ualiases
+        # edges whose endpoints ended up in the same unit: residual filters
+        for la, le, ra, re_ in edges:
+            pred = pe.BinaryOp(
+                "==",
+                self._bind_expr(le, scope, outer_refs),
+                self._bind_expr(re_, scope, outer_refs),
+            )
+            plan = LFilter(pred, plan)
+        return plan
+
+    def _relation_rows(self, alias: str, plan: LogicalPlan) -> int:
+        """Estimate rows under a relation's plan (scan size, filter discount)."""
+        if isinstance(plan, LFilter):
+            return max(self._relation_rows(alias, plan.child) // 3, 1)
+        if isinstance(plan, LScan):
+            try:
+                return self.catalog.table_rows(plan.table)
+            except Exception:
+                return 1000
+        if plan.children():
+            return max(self._relation_rows(alias, c) for c in plan.children())
+        return 1000

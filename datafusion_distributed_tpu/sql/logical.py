@@ -1,4 +1,4 @@
-"""Logical plan + binder (AST -> resolved logical tree).
+"""SQL binder (AST -> resolved logical tree).
 
 The reference gets logical planning from DataFusion (SURVEY.md L0). This is an
 original binder covering what the TPC suites need:
@@ -8,366 +8,91 @@ original binder covering what the TPC suites need:
   unambiguous all the way into the physical Table),
 - implicit comma joins: WHERE conjuncts are classified into single-relation
   filters (pushed down), equi-join edges (drive a greedy left-deep join
-  order), and residual post-join filters,
+  order), and residual post-join filters (`binder_joins.py`),
 - aggregate extraction (SELECT/HAVING/ORDER BY aggregate calls become
   LAggregate outputs; COUNT(DISTINCT x) rewrites to a two-level aggregate),
-- subquery handling: uncorrelated scalar subqueries become lazily-executed
-  scalar expressions; correlated scalar-aggregate subqueries decorrelate into
-  GROUP BY + LEFT JOIN (TPC-H q2/q17/q20 shape); [NOT] EXISTS and [NOT] IN
-  become semi/anti joins with optional residual predicates (q4/q21/q22).
+- subquery handling (`binder_subqueries.py`): uncorrelated scalar subqueries
+  become lazily-executed scalar expressions; correlated scalar-aggregate
+  subqueries decorrelate into GROUP BY + LEFT JOIN (TPC-H q2/q17/q20 shape);
+  [NOT] EXISTS and [NOT] IN become semi/anti joins with optional residual
+  predicates (q4/q21/q22).
+
+The module split (logical plan nodes in `lplan.py`, scopes in `scope.py`,
+AST helpers in `ast_utils.py`, join ordering and decorrelation as binder
+mixins) keeps each concern independently reviewable; this module re-exports
+everything so `sql.logical` remains the single public entry point.
 """
 
 from __future__ import annotations
 
-import itertools
-from dataclasses import dataclass, field as dc_field
-from typing import Any, Callable, Optional, Sequence
+from typing import Callable, Optional
 
 from datafusion_distributed_tpu.plan import expressions as pe
 from datafusion_distributed_tpu.schema import DataType, Field, Schema
 from datafusion_distributed_tpu.sql import parser as ast
-
-# mark-join column namer: process-wide so two filters in one query can't
-# collide, resettable (like planner._TMP) so plan snapshots are reproducible
-_MARK_SEQ = itertools.count()
-
-
-# ---------------------------------------------------------------------------
-# Logical nodes
-# ---------------------------------------------------------------------------
-
-
-class LogicalPlan:
-    def schema(self) -> Schema:
-        raise NotImplementedError
-
-    def children(self) -> list["LogicalPlan"]:
-        raise NotImplementedError
-
-    def display_tree(self, indent=0) -> str:
-        lines = ["  " * indent + self.display()]
-        for c in self.children():
-            lines.append(c.display_tree(indent + 1))
-        return "\n".join(lines)
-
-    def display(self) -> str:
-        return type(self).__name__
-
-
-@dataclass
-class LScan(LogicalPlan):
-    table: str
-    alias: str
-    table_schema: Schema  # original column names
-    flat_schema: Schema  # alias.column names
-
-    def schema(self):
-        return self.flat_schema
-
-    def children(self):
-        return []
-
-    def display(self):
-        return f"Scan {self.table} AS {self.alias}"
-
-
-@dataclass
-class LFilter(LogicalPlan):
-    predicate: pe.PhysicalExpr
-    child: LogicalPlan
-
-    def schema(self):
-        return self.child.schema()
-
-    def children(self):
-        return [self.child]
-
-    def display(self):
-        return f"Filter {self.predicate.display()}"
-
-
-@dataclass
-class LProject(LogicalPlan):
-    exprs: list  # [(PhysicalExpr, out_name)]
-    child: LogicalPlan
-
-    def schema(self):
-        cs = self.child.schema()
-        return Schema(
-            [Field(n, e.output_field(cs).dtype, e.output_field(cs).nullable)
-             for e, n in self.exprs]
-        )
-
-    def children(self):
-        return [self.child]
-
-    def display(self):
-        return "Project " + ", ".join(n for _, n in self.exprs)
-
-
-@dataclass
-class AggCall:
-    func: str  # sum|count|count_star|min|max|avg
-    arg: Optional[pe.PhysicalExpr]
-    name: str
-    distinct: bool = False
-
-
-@dataclass
-class LAggregate(LogicalPlan):
-    groups: list  # [(PhysicalExpr, name)]
-    aggs: list  # [AggCall]
-    child: LogicalPlan
-
-    def schema(self):
-        cs = self.child.schema()
-        fields = []
-        for e, n in self.groups:
-            f = e.output_field(cs)
-            fields.append(Field(n, f.dtype, f.nullable))
-        for a in self.aggs:
-            fields.append(Field(a.name, _agg_dtype(a, cs), True))
-        return Schema(fields)
-
-    def children(self):
-        return [self.child]
-
-    def display(self):
-        gs = ", ".join(n for _, n in self.groups)
-        as_ = ", ".join(f"{a.func}({a.arg.display() if a.arg else '*'})"
-                        for a in self.aggs)
-        return f"Aggregate gby=[{gs}] aggs=[{as_}]"
-
-
-def _agg_dtype(a: AggCall, cs: Schema) -> DataType:
-    if a.func in ("count", "count_star"):
-        return DataType.INT64
-    if a.func == "avg" or a.func in _VARIANCE_FUNCS:
-        return DataType.FLOAT64
-    f = a.arg.output_field(cs)
-    if a.func == "sum":
-        return DataType.FLOAT64 if f.dtype.is_float else DataType.INT64
-    return f.dtype
-
-
-@dataclass
-class LJoin(LogicalPlan):
-    left: LogicalPlan
-    right: LogicalPlan
-    how: str  # inner|left|semi|anti|mark|cross
-    left_keys: list  # [PhysicalExpr]
-    right_keys: list
-    residual: Optional[pe.PhysicalExpr] = None  # evaluated on joined schema
-    mark_name: Optional[str] = None
-    null_aware: bool = False  # NOT IN semantics for anti joins
-    # estimated output rows per probe row (the join orderer's NDV-based
-    # fan-out; sizes the physical join's output capacity so many-to-many
-    # joins do not start at 1x and burn overflow retries)
-    fanout_hint: float = 1.0
-
-    def schema(self):
-        if self.how in ("semi", "anti"):
-            return self.left.schema()
-        if self.how == "mark":
-            return Schema(
-                list(self.left.schema().fields)
-                + [Field(self.mark_name or "__mark", DataType.BOOL, False)]
-            )
-        left = self.left.schema().fields
-        right = [
-            Field(f.name, f.dtype, True if self.how == "left" else f.nullable)
-            for f in self.right.schema().fields
-        ]
-        return Schema(list(left) + right)
-
-    def children(self):
-        return [self.left, self.right]
-
-    def display(self):
-        ks = ", ".join(
-            f"{l.display()}={r.display()}"
-            for l, r in zip(self.left_keys, self.right_keys)
-        )
-        res = f" residual={self.residual.display()}" if self.residual else ""
-        return f"Join {self.how} on [{ks}]{res}"
-
-
-@dataclass
-class LWindowExpr:
-    func: str  # rank|dense_rank|row_number|sum|avg|min|max|count|count_star
-    arg: Optional[pe.PhysicalExpr]
-    partition_by: list  # [PhysicalExpr]
-    order_by: list  # [(PhysicalExpr, ascending, nulls_first|None)]
-    name: str
-    frame: str = "range"
-
-
-@dataclass
-class LWindow(LogicalPlan):
-    """Window evaluation: appends one column per LWindowExpr (post-GROUP BY,
-    pre-final-projection — standard SQL evaluation order)."""
-
-    exprs: list  # [LWindowExpr]
-    child: LogicalPlan
-
-    def schema(self):
-        fields = list(self.child.schema().fields)
-        cs = self.child.schema()
-        for w in self.exprs:
-            fields.append(Field(w.name, _window_dtype(w, cs), True))
-        return Schema(fields)
-
-    def children(self):
-        return [self.child]
-
-    def display(self):
-        inner = ", ".join(f"{w.func}() AS {w.name}" for w in self.exprs)
-        return f"Window [{inner}]"
-
-
-def _window_dtype(w: LWindowExpr, cs: Schema) -> DataType:
-    from datafusion_distributed_tpu.ops.window import window_output_dtype
-
-    input_dtype = w.arg.output_field(cs).dtype if w.arg is not None else None
-    return window_output_dtype(w.func, input_dtype)
-
-
-@dataclass
-class LSort(LogicalPlan):
-    keys: list  # [(PhysicalExpr, ascending, nulls_first|None)]
-    child: LogicalPlan
-    fetch: Optional[int] = None
-
-    def schema(self):
-        return self.child.schema()
-
-    def children(self):
-        return [self.child]
-
-    def display(self):
-        ks = ", ".join(
-            f"{e.display()} {'ASC' if asc else 'DESC'}" for e, asc, _ in self.keys
-        )
-        return f"Sort [{ks}]" + (f" fetch={self.fetch}" if self.fetch else "")
-
-
-@dataclass
-class LLimit(LogicalPlan):
-    child: LogicalPlan
-    fetch: Optional[int]
-    skip: int = 0
-
-    def schema(self):
-        return self.child.schema()
-
-    def children(self):
-        return [self.child]
-
-    def display(self):
-        return f"Limit fetch={self.fetch} skip={self.skip}"
-
-
-@dataclass
-class LDistinct(LogicalPlan):
-    child: LogicalPlan
-
-    def schema(self):
-        return self.child.schema()
-
-    def children(self):
-        return [self.child]
-
-
-@dataclass
-class LSetOp(LogicalPlan):
-    op: str  # union|intersect|except
-    all: bool
-    left: LogicalPlan
-    right: LogicalPlan
-
-    def schema(self):
-        return self.left.schema()
-
-    def children(self):
-        return [self.left, self.right]
-
-    def display(self):
-        return f"{self.op.upper()}{' ALL' if self.all else ''}"
-
-
-# ---------------------------------------------------------------------------
-# Catalog protocol
-# ---------------------------------------------------------------------------
-
-
-class CatalogProtocol:
-    """What the binder needs: schema lookup + view/CTE resolution."""
-
-    def table_schema(self, name: str) -> Schema:
-        raise NotImplementedError
-
-    def has_table(self, name: str) -> bool:
-        raise NotImplementedError
-
-    def table_rows(self, name: str) -> int:
-        """Row-count estimate for join ordering; override when known."""
-        return 1000
-
-    def column_ndv(self, table: str, column: str) -> Optional[int]:
-        """Distinct-count estimate for a column (join fan-out estimation);
-        None when unknown."""
-        return None
-
-
-# ---------------------------------------------------------------------------
-# Binder
-# ---------------------------------------------------------------------------
-
-_ANON = itertools.count()
-
-
-class BindError(ValueError):
-    pass
-
-
-@dataclass
-class Scope:
-    """In-scope relations: [(alias, original Schema)] resolving to flat names."""
-
-    entries: list  # [(alias, Schema)]
-    parent: Optional["Scope"] = None
-
-    def resolve(self, ident: ast.Ident) -> tuple[str, Field, int]:
-        """-> (flat_name, field, depth); depth 0 = local, 1+ = outer scope."""
-        depth = 0
-        scope: Optional[Scope] = self
-        while scope is not None:
-            hits = []
-            for alias, schema in scope.entries:
-                if ident.qualifier is not None and ident.qualifier != alias:
-                    continue
-                if ident.name in schema:
-                    hits.append((alias, schema.field(ident.name)))
-            if len(hits) > 1:
-                raise BindError(f"ambiguous column {ident.key()!r}")
-            if hits:
-                alias, f = hits[0]
-                flat = f"{alias}.{ident.name}" if alias else ident.name
-                return flat, f, depth
-            scope = scope.parent
-            depth += 1
-        raise BindError(f"unknown column {ident.key()!r}")
-
-
-@dataclass
-class OuterRef:
-    """Recorded reference from a subquery into an enclosing scope."""
-
-    flat_name: str
-    field: Field
-
-
-class Binder:
+from datafusion_distributed_tpu.sql.ast_utils import (  # noqa: F401
+    _AGG_FUNCS,
+    _AGG_ID_REGISTRY,
+    _WINDOW_ONLY_FUNCS,
+    _agg_parts,
+    _as_decimal,
+    _ast_children,
+    _ast_fingerprint,
+    _ast_substitute,
+    _cast_type,
+    _collect_agg_calls,
+    _collect_col_names,
+    _collect_window_calls,
+    _common_or_conjuncts,
+    _contains_subquery,
+    _display_name,
+    _expand_rollup,
+    _fold_date_arith,
+    _fold_decimal_arith,
+    _has_aggregates,
+    _hoist_common_or,
+    _is_rollup,
+    _join_conjuncts,
+    _literal_expr,
+    _project_through,
+    _shift_date,
+    _sort_fetch,
+    _split_conjuncts,
+)
+from datafusion_distributed_tpu.sql.binder_joins import JoinOrderingMixin
+from datafusion_distributed_tpu.sql.binder_subqueries import (  # noqa: F401
+    ScalarSubqueryExpr,
+    SubqueryDecorrelationMixin,
+)
+
+# NOTE: _MARK_SEQ deliberately NOT re-exported — rebinding a re-export would
+# not affect the mixin's module global; reset it on `sql.binder_subqueries`.
+from datafusion_distributed_tpu.sql.lplan import (  # noqa: F401
+    AggCall,
+    CatalogProtocol,
+    LAggregate,
+    LDistinct,
+    LFilter,
+    LJoin,
+    LLimit,
+    LProject,
+    LScan,
+    LSetOp,
+    LSort,
+    LWindow,
+    LWindowExpr,
+    LogicalPlan,
+    _agg_dtype,
+    _window_dtype,
+)
+from datafusion_distributed_tpu.sql.scope import (  # noqa: F401
+    BindError,
+    OuterRef,
+    Scope,
+)
+
+
+class Binder(JoinOrderingMixin, SubqueryDecorrelationMixin):
     def __init__(self, catalog: CatalogProtocol, ctes: Optional[dict] = None):
         self.catalog = catalog
         self.ctes: dict[str, LogicalPlan] = dict(ctes or {})
@@ -632,634 +357,6 @@ class Binder:
                 f"unresolved outer references: {[r.flat_name for r in outer_refs]}"
             )
         return plan
-
-    # -- join ordering --------------------------------------------------------
-    def _fold_explicit_join(self, uplan, ualiases, jc, ralias, rplan, scope,
-                            outer_refs):
-        """Fold one explicit [OUTER] JOIN clause in written order (outer joins
-        must not be reordered; the preserved side is the accumulated left)."""
-        if jc.kind == "cross":
-            return LJoin(uplan, rplan, "cross", [], [])
-        on_conjuncts = _split_conjuncts(jc.on) if jc.on is not None else []
-        lkeys, rkeys = [], []
-        post: list = []
-        for c in on_conjuncts:
-            aliases = self._aliases_of(c, scope)
-            if (
-                isinstance(c, ast.Binary) and c.op == "=="
-                and len(aliases) == 2
-            ):
-                la = self._aliases_of(c.left, scope)
-                ra = self._aliases_of(c.right, scope)
-                if la <= ualiases and ra == {ralias}:
-                    lkeys.append(self._bind_expr(c.left, scope, outer_refs))
-                    rkeys.append(self._bind_expr(c.right, scope, outer_refs))
-                    continue
-                if ra <= ualiases and la == {ralias}:
-                    lkeys.append(self._bind_expr(c.right, scope, outer_refs))
-                    rkeys.append(self._bind_expr(c.left, scope, outer_refs))
-                    continue
-            if aliases == {ralias} and jc.kind in ("left", "inner"):
-                # null-supplying-side-only conjunct: pre-filtering that side
-                # is equivalent for LEFT (and INNER) joins
-                rplan = LFilter(self._bind_expr(c, scope, outer_refs), rplan)
-                continue
-            post.append(c)
-        if post:
-            if jc.kind != "inner":
-                raise BindError(
-                    f"unsupported non-equi ON conjunct for {jc.kind.upper()} "
-                    f"JOIN: {post[0]!r}"
-                )
-        if not lkeys:
-            raise BindError(
-                f"{jc.kind.upper()} JOIN without an equi ON condition"
-            )
-        kind = jc.kind
-        fanout = self._scan_fanout(rplan, rkeys)
-        if kind == "right":
-            # preserved side must be the probe: swap
-            out = LJoin(rplan, uplan, "left", rkeys, lkeys)
-        elif kind == "full":
-            # FULL OUTER = LEFT JOIN  UNION ALL  (right rows with no match,
-            # left columns padded with typed NULLs) — the mirror of the
-            # reference's HashJoinExec Full mode, built from the primitives
-            # the TPU kernels already have (left + anti).
-            lj = LJoin(uplan, rplan, "left", lkeys, rkeys)
-            anti = LJoin(rplan, uplan, "anti", rkeys, lkeys)
-            null_left = LProject(
-                [(pe.Literal(None, f.dtype), f.name)
-                 for f in uplan.schema().fields]
-                + [(pe.Col(f.name), f.name) for f in rplan.schema().fields],
-                anti,
-            )
-            out = LSetOp("union", True, lj, null_left)
-        else:
-            out = LJoin(uplan, rplan, kind, lkeys, rkeys,
-                        fanout_hint=fanout)
-        for c in post:
-            out = LFilter(self._bind_expr(c, scope, outer_refs), out)
-        return out
-
-    def _scan_fanout(self, rplan: LogicalPlan, rkeys: list) -> float:
-        """Estimated matches per probe row for a join against ``rplan`` on
-        ``rkeys`` (bound Cols): rows(build) / ndv(build key). Explicit JOINs
-        (q72's catalog_sales x inventory on item_sk) can be many-to-many;
-        starting the output capacity at the NDV-implied expansion avoids
-        burning every overflow retry on a 1x initial guess."""
-        scans: dict[str, LScan] = {}
-
-        def walk(n):
-            if isinstance(n, LScan):
-                scans[n.alias] = n
-            for c in n.children():
-                walk(c)
-
-        walk(rplan)
-        if not scans:
-            return 1.0
-        fanouts = []
-        for k in rkeys:
-            if not isinstance(k, pe.Col) or "." not in k.name:
-                continue
-            alias, _, col = k.name.partition(".")
-            scan = scans.get(alias)
-            if scan is None:
-                continue
-            try:
-                # filter-discounted build rows (same heuristic as
-                # _relation_rows: /3 per filter above the scan) — the full
-                # table row count would overstate the fan-out by the build
-                # side's selectivity
-                rows = self._relation_rows(alias, rplan)
-                ndv = self.catalog.column_ndv(scan.table, col)
-            except Exception:
-                continue
-            if ndv:
-                fanouts.append(max(float(rows) / float(ndv), 1.0))
-        # several equi keys bound the fan-out by the most selective one
-        return min(fanouts) if fanouts else 1.0
-
-    def _join_fanout(self, edge, ualiases, urows, alias_tables) -> float:
-        """Estimated output rows per probe row if this edge attaches the
-        unit: rows(new) / ndv(new-side key). FK->PK joins (unique key on the
-        new side) give ~1; low-cardinality keys (nationkey=nationkey) give a
-        blow-up factor the orderer must avoid."""
-        la, le, ra, re_ = edge
-        inner_ast = le if la in ualiases else re_
-        if not isinstance(inner_ast, ast.Ident):
-            return 1.0
-        # resolve alias for the ident within the unit
-        alias = inner_ast.qualifier
-        if alias is None:
-            alias = la if la in ualiases else ra
-        table = alias_tables.get(alias)
-        if table is None:
-            return 1.0
-        ndv = self.catalog.column_ndv(table, inner_ast.name)
-        if not ndv:
-            return 1.0
-        return max(float(urows) / float(ndv), 1.0)
-
-    def _order_joins(self, units, equi_edges, scope, outer_refs,
-                     alias_tables=None):
-        """Greedily join units (relations or pre-folded outer-join groups):
-        probe side = the largest unit (the fact table keeps output
-        cardinality bounded by the probe side, which is what the static
-        output-capacity model wants); among connected candidates, attach the
-        one with the smallest estimated fan-out first (FK->PK dimension
-        joins before many-to-many edges), breaking ties by unit size."""
-        alias_tables = alias_tables or {}
-        units = [list(u) for u in units]
-        if len(units) == 1:
-            return units[0][0]
-        start = max(range(len(units)), key=lambda i: units[i][2])
-        plan, joined, _rows = units[start]
-        remaining = [u for i, u in enumerate(units) if i != start]
-        edges = list(equi_edges)
-        while remaining:
-            candidates = []
-            for ui, u in enumerate(remaining):
-                _, ualiases, urows = u
-                fanouts = []
-                for e in edges:
-                    la, _, ra, _ = e
-                    if (la in joined and ra in ualiases) or (
-                        ra in joined and la in ualiases
-                    ):
-                        fanouts.append(
-                            self._join_fanout(e, ualiases, urows, alias_tables)
-                        )
-                if fanouts:
-                    # several edges bound the fan-out by the most selective
-                    candidates.append((min(fanouts), urows, ui))
-            if not candidates:
-                u = remaining.pop(0)
-                plan = LJoin(plan, u[0], "cross", [], [])
-                joined |= u[1]
-                continue
-            candidates.sort()
-            best_fanout, _, ui = candidates[0]
-            u = remaining.pop(ui)
-            _, ualiases, _ = u
-            lkeys, rkeys, rest = [], [], []
-            for e in edges:
-                la, le, ra, re_ = e
-                if la in joined and ra in ualiases:
-                    lkeys.append(self._bind_expr(le, scope, outer_refs))
-                    rkeys.append(self._bind_expr(re_, scope, outer_refs))
-                elif ra in joined and la in ualiases:
-                    lkeys.append(self._bind_expr(re_, scope, outer_refs))
-                    rkeys.append(self._bind_expr(le, scope, outer_refs))
-                else:
-                    rest.append(e)
-            edges = rest
-            plan = LJoin(plan, u[0], "inner", lkeys, rkeys,
-                         fanout_hint=float(best_fanout))
-            joined |= ualiases
-        # edges whose endpoints ended up in the same unit: residual filters
-        for la, le, ra, re_ in edges:
-            pred = pe.BinaryOp(
-                "==",
-                self._bind_expr(le, scope, outer_refs),
-                self._bind_expr(re_, scope, outer_refs),
-            )
-            plan = LFilter(pred, plan)
-        return plan
-
-    def _relation_rows(self, alias: str, plan: LogicalPlan) -> int:
-        """Estimate rows under a relation's plan (scan size, filter discount)."""
-        if isinstance(plan, LFilter):
-            return max(self._relation_rows(alias, plan.child) // 3, 1)
-        if isinstance(plan, LScan):
-            try:
-                return self.catalog.table_rows(plan.table)
-            except Exception:
-                return 1000
-        if plan.children():
-            return max(self._relation_rows(alias, c) for c in plan.children())
-        return 1000
-
-    # -- subquery predicates ----------------------------------------------------
-    def _apply_subquery_pred(self, c, plan, scope, outer_refs) -> LogicalPlan:
-        if isinstance(c, ast.Exists):
-            return self._bind_exists(c.query, c.negated, plan, scope)
-        if isinstance(c, ast.Unary) and c.op == "not" and isinstance(
-            c.child, ast.Exists
-        ):
-            return self._bind_exists(c.child.query, not c.child.negated, plan, scope)
-        if isinstance(c, ast.InSubquery):
-            return self._bind_in_subquery(c, plan, scope, outer_refs)
-        if isinstance(c, ast.Between) and not c.negated:
-            # BETWEEN with subquery bounds (TPC-DS q54): split into the two
-            # comparisons and route each through the right binder
-            for shard in (
-                ast.Binary(">=", c.expr, c.low),
-                ast.Binary("<=", c.expr, c.high),
-            ):
-                if _contains_subquery(shard):
-                    plan = self._apply_subquery_pred(
-                        shard, plan, scope, outer_refs
-                    )
-                else:
-                    plan = LFilter(
-                        self._bind_expr(shard, scope, outer_refs), plan
-                    )
-            return plan
-        if isinstance(c, ast.Binary) and c.op == "and":
-            for side in (c.left, c.right):
-                if _contains_subquery(side):
-                    plan = self._apply_subquery_pred(
-                        side, plan, scope, outer_refs
-                    )
-                else:
-                    plan = LFilter(
-                        self._bind_expr(side, scope, outer_refs), plan
-                    )
-            return plan
-        if isinstance(c, ast.Binary) and c.op == "or":
-            # disjunction containing EXISTS/IN-subquery (TPC-DS q35/q45):
-            # each subquery becomes a MARK join; the disjunction then
-            # evaluates over the mark columns as a plain filter
-            return self._apply_disjunctive_subquery(c, plan, scope, outer_refs)
-        # scalar subquery inside a comparison
-        return self._bind_scalar_pred(c, plan, scope, outer_refs)
-
-    def _apply_disjunctive_subquery(self, c, plan, scope, outer_refs):
-        """Rewrite a boolean expression whose leaves include EXISTS /
-        IN-subquery into mark joins + a boolean filter over the mark columns
-        (the reference gets this from DataFusion's subquery decorrelation,
-        which lowers to the same mark-join shape)."""
-        plan_box = [plan]
-
-        def walk(node):
-            if isinstance(node, ast.Binary) and node.op in ("and", "or"):
-                l = walk(node.left)
-                r = walk(node.right)
-                return pe.BooleanOp(node.op, l, r)
-            if isinstance(node, ast.Unary) and node.op == "not":
-                return pe.Not(walk(node.child))
-            if isinstance(node, ast.Exists):
-                mark = self._mark_join_exists(node, plan_box, scope)
-                return pe.Not(mark) if node.negated else mark
-            if isinstance(node, ast.InSubquery):
-                mark = self._mark_join_in(node, plan_box, scope, outer_refs)
-                return pe.Not(mark) if node.negated else mark
-            return self._bind_expr(node, scope, outer_refs)
-
-        def _mark_name():
-            # process-wide monotonic counter: unique across every mark join
-            # in the query AND deterministic (resettable) for plan snapshots
-            return f"__mark_{next(_MARK_SEQ)}"
-
-        self.__mark_name = _mark_name  # shared with helpers below
-        pred = walk(c)
-        return LFilter(pred, plan_box[0])
-
-    def _mark_join_exists(self, node: ast.Exists, plan_box, scope):
-        sub_binder = Binder(self.catalog, self.ctes)
-        sub_refs: list = []
-        sub_plan, corr_pairs, residual = sub_binder._bind_correlated(
-            node.query, scope, sub_refs
-        )
-        if not corr_pairs:
-            raise BindError("uncorrelated EXISTS not supported yet")
-        name = self.__mark_name()
-        plan_box[0] = LJoin(
-            plan_box[0], sub_plan, "mark",
-            [pe.Col(outer) for outer, _ in corr_pairs],
-            [inner for _, inner in corr_pairs],
-            residual=residual, mark_name=name,
-        )
-        return pe.Col(name)
-
-    def _mark_join_in(self, node: ast.InSubquery, plan_box, scope, outer_refs):
-        expr = self._bind_expr(node.expr, scope, outer_refs)
-        sub_binder = Binder(self.catalog, self.ctes)
-        sub_refs: list = []
-        sub_plan, corr_pairs, residual = sub_binder._bind_correlated(
-            node.query, scope, sub_refs
-        )
-        out_cols = sub_plan.schema()
-        if len(out_cols) - len(corr_pairs) != 1 and len(out_cols) != 1:
-            raise BindError("IN subquery must produce one column")
-        name = self.__mark_name()
-        plan_box[0] = LJoin(
-            plan_box[0], sub_plan, "mark",
-            [expr] + [pe.Col(outer) for outer, _ in corr_pairs],
-            [pe.Col(out_cols.fields[0].name)] + [
-                inner for _, inner in corr_pairs
-            ],
-            residual=residual, mark_name=name,
-        )
-        return pe.Col(name)
-
-    def _bind_exists(self, subq: ast.Query, negated: bool, plan, scope):
-        sub_binder = Binder(self.catalog, self.ctes)
-        sub_refs: list[OuterRef] = []
-        sub_plan, corr_pairs, residual = sub_binder._bind_correlated(
-            subq, scope, sub_refs
-        )
-        if not corr_pairs:
-            raise BindError("uncorrelated EXISTS not supported yet")
-        lkeys = [pe.Col(outer) for outer, _ in corr_pairs]
-        rkeys = [inner for _, inner in corr_pairs]
-        how = "anti" if negated else "semi"
-        return LJoin(plan, sub_plan, how, lkeys, rkeys, residual=residual)
-
-    def _bind_in_subquery(self, c: ast.InSubquery, plan, scope, outer_refs):
-        expr = self._bind_expr(c.expr, scope, outer_refs)
-        sub_binder = Binder(self.catalog, self.ctes)
-        sub_refs: list[OuterRef] = []
-        sub_plan, corr_pairs, residual = sub_binder._bind_correlated(
-            c.query, scope, sub_refs
-        )
-        out_cols = sub_plan.schema()
-        if len(out_cols) - len(corr_pairs) != 1 and len(out_cols) != 1:
-            raise BindError("IN subquery must produce one column")
-        value_col = pe.Col(out_cols.fields[0].name)
-        lkeys = [expr] + [pe.Col(outer) for outer, _ in corr_pairs]
-        rkeys = [value_col] + [inner for _, inner in corr_pairs]
-        how = "anti" if c.negated else "semi"
-        return LJoin(plan, sub_plan, how, lkeys, rkeys, residual=residual,
-                     null_aware=c.negated)
-
-    def _bind_scalar_pred(self, c, plan, scope, outer_refs):
-        """Comparison against a scalar subquery (correlated or not)."""
-        if not (isinstance(c, ast.Binary) and c.op in ("==", "!=", "<", "<=",
-                                                       ">", ">=")):
-            raise BindError(
-                f"unsupported subquery predicate shape: {type(c).__name__}"
-            )
-        # The subquery may sit anywhere inside the comparison (TPC-DS q6:
-        # `price > 1.2 * (select avg(...))`): locate it, bind it, splice the
-        # bound scalar back in, then bind the whole comparison normally.
-        found: list = []
-
-        def hunt(node):
-            if isinstance(node, ast.ScalarSubquery):
-                found.append(node)
-                return node  # do not descend further
-            return None
-
-        _ast_substitute(c, hunt)
-        if len(found) != 1:
-            raise BindError("expected scalar subquery in comparison")
-        sub_ast = found[0]
-
-        sub_binder = Binder(self.catalog, self.ctes)
-        sub_refs: list[OuterRef] = []
-        sub_plan, corr_pairs, residual = sub_binder._bind_correlated(
-            sub_ast.query, scope, sub_refs
-        )
-        if residual is not None:
-            raise BindError("non-equi correlation in scalar subquery")
-
-        if not corr_pairs:
-            # uncorrelated: evaluate eagerly at execution time
-            spliced = _ast_substitute(
-                c, lambda n: ast.PreBound(ScalarSubqueryExpr(sub_plan))
-                if n is sub_ast else None,
-            )
-            return LFilter(self._bind_expr(spliced, scope, outer_refs), plan)
-
-        # correlated scalar aggregate: sub_plan is Aggregate(groups=corr keys)
-        scalar_col = pe.Col(sub_plan.schema().fields[-1].name)
-        lkeys = [pe.Col(outer) for outer, _ in corr_pairs]
-        rkeys = [inner for _, inner in corr_pairs]
-        joined = LJoin(plan, sub_plan, "left", lkeys, rkeys)
-        spliced = _ast_substitute(
-            c, lambda n: ast.PreBound(scalar_col) if n is sub_ast else None,
-        )
-        filtered = LFilter(
-            self._bind_expr(spliced, scope, outer_refs), joined
-        )
-        # project away subquery columns
-        keep = [
-            (pe.Col(f.name), f.name) for f in plan.schema().fields
-        ]
-        return LProject(keep, filtered)
-
-    def _bind_correlated(self, subq: ast.Query, outer_scope, sub_refs):
-        """Bind a subquery that may reference the outer scope.
-
-        Returns (plan, corr_pairs, residual) where corr_pairs are
-        (outer_flat_name, inner key PhysicalExpr) equi correlations hoisted
-        out of the subquery's WHERE, and residual is a bound predicate over
-        the [outer columns joined with subquery output] schema for non-equi
-        correlated conjuncts (EXISTS with <> as in TPC-H q21).
-        """
-        q = subq
-        conjuncts = _split_conjuncts(q.where) if q.where is not None else []
-        # surface correlations hidden inside OR branches (q41 shape)
-        conjuncts = [x for c in conjuncts for x in _hoist_common_or(c)]
-        corr: list[tuple[str, ast.Ident]] = []  # (outer flat, inner ast)
-        residual_asts: list = []
-        local: list = []
-        probe_scope = self._subquery_scope(q, outer_scope)
-        for c in conjuncts:
-            side = self._correlation_side(c, probe_scope)
-            if side == "local":
-                local.append(c)
-            elif side == "equi":
-                outer_ast, inner_ast = self._split_correlation(c, probe_scope)
-                corr.append((outer_ast, inner_ast))
-            else:  # residual correlated
-                residual_asts.append(c)
-
-        q2 = ast.Query(
-            select_items=q.select_items,
-            from_refs=q.from_refs,
-            where=_join_conjuncts(local),
-            group_by=q.group_by,
-            having=q.having,
-            order_by=q.order_by,
-            limit=q.limit,
-            offset=q.offset,
-            distinct=q.distinct,
-            ctes=q.ctes,
-        )
-
-        if corr and _has_aggregates(q2):
-            # correlated scalar aggregate -> group by correlation keys
-            inner_group_asts = [inner for _, inner in corr]
-            q2 = ast.Query(
-                select_items=list(q2.select_items)
-                + [ast.SelectItem(a, f"__corr{i}") for i, a in
-                   enumerate(inner_group_asts)],
-                from_refs=q2.from_refs,
-                where=q2.where,
-                group_by=list(q2.group_by) + inner_group_asts,
-                having=q2.having,
-                order_by=[],
-                limit=None,
-                offset=None,
-                distinct=False,
-                ctes=q2.ctes,
-            )
-            plan = self._bind_query(q2, None)
-            fields = plan.schema().fields
-            ncorr = len(corr)
-            pairs = []
-            for (outer_flat, _), f in zip(corr, fields[-ncorr:]):
-                pairs.append((outer_flat, pe.Col(f.name)))
-            # keep scalar as last col before corr keys: re-project so schema =
-            # [corr keys..., scalar]
-            scalar_field = fields[-ncorr - 1]
-            proj = [(pe.Col(f.name), f.name) for f in fields[-ncorr:]]
-            proj.append((pe.Col(scalar_field.name), scalar_field.name))
-            plan = LProject(proj, plan)
-            return plan, pairs, None
-
-        plan = self._bind_query(q2, None)
-        pairs = []
-        for outer_flat, inner_ast in corr:
-            inner_scope = self._subquery_scope(q2, None)
-            inner_bound = Binder(self.catalog, self.ctes)._bind_expr(
-                inner_ast, inner_scope, None
-            )
-            # the subquery's output schema must expose the key column; ensure
-            # it by projecting the join keys alongside existing outputs
-            pairs.append((outer_flat, inner_bound))
-        residual = None
-        if residual_asts:
-            # bind residual against outer+inner: inner entries SHADOW outer
-            # ones (an unqualified name over two `item` relations must pick
-            # the subquery's own, q41), while outer names stay reachable —
-            # qualified or via the parent scope
-            combined = Scope(
-                self._subquery_scope(q2, None).entries, parent=outer_scope
-            )
-            shadow_refs: list = []
-            bound = [
-                self._bind_expr(a, combined, shadow_refs)
-                for a in residual_asts
-            ]
-            residual = bound[0]
-            for b in bound[1:]:
-                residual = pe.BooleanOp("and", residual, b)
-        if pairs or residual is not None:
-            # Expose referenced inner columns through the subquery's output
-            # projection. Outer-side names in the residual stay out — they
-            # resolve against the probe side of the join at execution.
-            inner_aliases = {
-                alias for alias, _ in self._subquery_scope(q2, None).entries
-            }
-            needed = _collect_col_names(
-                [p for _, p in pairs] + ([residual] if residual is not None else [])
-            )
-            existing = set(f.name for f in plan.schema().fields)
-            missing = [
-                n for n in needed
-                if n not in existing and n.split(".")[0] in inner_aliases
-            ]
-            if missing:
-                exprs = [(pe.Col(f.name), f.name) for f in plan.schema().fields]
-                exprs += [(pe.Col(n), n) for n in missing]
-                plan = _project_through(plan, exprs)
-        return plan, pairs, residual
-
-    def _subquery_scope(self, q: ast.Query, outer_scope) -> Scope:
-        entries = []
-        for base, joins in q.from_refs:
-            for ref in [base] + [j.right for j in joins]:
-                if isinstance(ref, ast.TableRef):
-                    alias = ref.alias or ref.name
-                    if ref.name in self.ctes:
-                        sub = self.ctes[ref.name]
-                        names = [f.name.split(".")[-1] for f in sub.schema().fields]
-                        entries.append(
-                            (alias, Schema([Field(n, f.dtype, f.nullable)
-                                            for n, f in zip(names, sub.schema().fields)]))
-                        )
-                    else:
-                        entries.append((alias, self.catalog.table_schema(ref.name)))
-                else:
-                    sub_binder = Binder(self.catalog, self.ctes)
-                    sub = sub_binder._bind_query(ref.query, None)
-                    names = ref.column_aliases or [
-                        f.name.split(".")[-1] for f in sub.schema().fields
-                    ]
-                    entries.append(
-                        (ref.alias, Schema([Field(n, f.dtype, f.nullable)
-                                            for n, f in zip(names, sub.schema().fields)]))
-                    )
-        return Scope(entries, parent=outer_scope)
-
-    def _combined_scope(self, q: ast.Query, outer_scope) -> Scope:
-        inner = self._subquery_scope(q, None)
-        entries = list(inner.entries) + (
-            list(outer_scope.entries) if outer_scope else []
-        )
-        return Scope(entries)
-
-    def _correlation_side(self, c, probe_scope: Scope) -> str:
-        """'local' (no outer refs) | 'equi' (outer = inner) | 'residual'."""
-        refs = self._outer_ref_names(c, probe_scope)
-        if not refs:
-            return "local"
-        if isinstance(c, ast.Binary) and c.op == "==":
-            lrefs = self._outer_ref_names(c.left, probe_scope)
-            rrefs = self._outer_ref_names(c.right, probe_scope)
-            if (
-                isinstance(c.left, ast.Ident)
-                and lrefs
-                and not rrefs
-                or isinstance(c.right, ast.Ident)
-                and rrefs
-                and not lrefs
-            ):
-                return "equi"
-        return "residual"
-
-    def _split_correlation(self, c: ast.Binary, probe_scope: Scope):
-        lrefs = self._outer_ref_names(c.left, probe_scope)
-        if lrefs and isinstance(c.left, ast.Ident):
-            outer_ast, inner_ast = c.left, c.right
-        else:
-            outer_ast, inner_ast = c.right, c.left
-        flat, _, _ = probe_scope.parent.resolve(outer_ast) if probe_scope.parent else (
-            None, None, None
-        )
-        if flat is None:
-            raise BindError("failed to resolve correlation")
-        return flat, inner_ast
-
-    def _outer_ref_names(self, node, probe_scope: Scope) -> list[str]:
-        out = []
-
-        def walk(n):
-            if isinstance(n, ast.Ident):
-                try:
-                    _, _, depth = probe_scope.resolve(n)
-                    if depth > 0:
-                        out.append(n.key())
-                except BindError:
-                    pass
-                return
-            for ch in _ast_children(n):
-                walk(ch)
-
-        walk(node)
-        return out
-
-    def _aliases_of(self, node, scope: Scope) -> set:
-        out: set = set()
-
-        def walk(n):
-            if isinstance(n, ast.Ident):
-                try:
-                    flat, _, depth = scope.resolve(n)
-                    if depth == 0:
-                        out.add(flat.split(".")[0])
-                except BindError:
-                    pass
-                return
-            for ch in _ast_children(n):
-                walk(ch)
-
-        walk(node)
-        return out
 
     # -- projection & aggregation ------------------------------------------
     def _bind_projection_and_aggregates(self, q: ast.Query, plan, scope,
@@ -1802,488 +899,3 @@ class Binder:
             raise BindError(f"unknown function {e.name}")
         raise BindError(f"cannot bind {type(e).__name__}")
 
-
-# ---------------------------------------------------------------------------
-# Scalar subquery expression (executed lazily by the physical layer)
-# ---------------------------------------------------------------------------
-
-
-class ScalarSubqueryExpr(pe.PhysicalExpr):
-    """Placeholder for an uncorrelated scalar subquery; the physical planner
-    replaces it with a literal after executing the subplan (the reference
-    disables DataFusion's uncorrelated-subquery pushdown and relies on plain
-    planning, `session_state_builder_ext.rs:17-27` — here we evaluate it as a
-    prepared constant instead)."""
-
-    def __init__(self, logical: LogicalPlan):
-        self.logical = logical
-        self.physical = None  # filled by the physical planner
-
-    def children(self):
-        return []
-
-    def evaluate(self, table):
-        raise RuntimeError(
-            "ScalarSubqueryExpr must be resolved by the physical planner"
-        )
-
-    def output_field(self, schema):
-        f = self.logical.schema().fields[0]
-        return Field("__scalar_subquery", f.dtype, True)
-
-    def display(self):
-        return "(scalar subquery)"
-
-
-# ---------------------------------------------------------------------------
-# AST utilities
-# ---------------------------------------------------------------------------
-
-from datafusion_distributed_tpu.ops.aggregate import (  # noqa: E402
-    _VARIANCE_FUNCS,
-)
-
-_AGG_FUNCS = {"sum", "count", "min", "max", "avg"} | _VARIANCE_FUNCS
-_WINDOW_ONLY_FUNCS = {"rank", "dense_rank", "row_number"}
-
-
-def _collect_window_calls(node, out: list) -> None:
-    if isinstance(node, ast.FuncCall) and node.over is not None:
-        out.append(node)
-        _AGG_ID_REGISTRY[id(node)] = node
-        return
-    if isinstance(node, (ast.ScalarSubquery, ast.Exists, ast.InSubquery)):
-        return
-    for ch in _ast_children(node):
-        _collect_window_calls(ch, out)
-_AGG_ID_REGISTRY: dict[int, Any] = {}
-
-
-def _agg_parts(call: ast.FuncCall):
-    arg = call.args[0] if call.args else ast.Star()
-    return call.name, arg, call.distinct
-
-
-def _collect_agg_calls(node, out: list) -> None:
-    if isinstance(node, ast.FuncCall) and node.over is not None:
-        # a window call is NOT a group aggregate, but its argument and spec
-        # may contain ones (sum(sum(x)) over (partition by ...))
-        for a in node.args:
-            _collect_agg_calls(a, out)
-        for p in node.over.partition_by:
-            _collect_agg_calls(p, out)
-        for o in node.over.order_by:
-            _collect_agg_calls(o.expr, out)
-        return
-    if isinstance(node, ast.FuncCall) and node.name in _AGG_FUNCS:
-        out.append(node)
-        _AGG_ID_REGISTRY[id(node)] = node
-        return  # nested aggregates are invalid SQL
-    if isinstance(node, (ast.ScalarSubquery, ast.Exists, ast.InSubquery)):
-        return  # subquery aggregates belong to the subquery
-    for ch in _ast_children(node):
-        _collect_agg_calls(ch, out)
-
-
-def _ast_children(node) -> list:
-    if isinstance(node, ast.Binary):
-        return [node.left, node.right]
-    if isinstance(node, ast.Unary):
-        return [node.child]
-    if isinstance(node, ast.Between):
-        return [node.expr, node.low, node.high]
-    if isinstance(node, ast.InListAst):
-        return [node.expr] + list(node.items)
-    if isinstance(node, ast.InSubquery):
-        return [node.expr]
-    if isinstance(node, ast.LikeAst):
-        return [node.expr]
-    if isinstance(node, ast.IsNullAst):
-        return [node.expr]
-    if isinstance(node, ast.CaseAst):
-        out = []
-        if node.operand is not None:
-            out.append(node.operand)
-        for c, v in node.whens:
-            out += [c, v]
-        if node.else_ is not None:
-            out.append(node.else_)
-        return out
-    if isinstance(node, ast.CastAst):
-        return [node.expr]
-    if isinstance(node, ast.ExtractAst):
-        return [node.expr]
-    if isinstance(node, ast.SubstringAst):
-        return [node.expr]
-    if isinstance(node, ast.FuncCall):
-        return list(node.args)
-    return []
-
-
-def _is_rollup(g) -> bool:
-    return isinstance(g, ast.FuncCall) and g.name.lower() == "rollup"
-
-
-def _ast_substitute(node, fn):
-    """Rebuild an AST bottom-up: fn(node) -> replacement or None (recurse).
-    Does NOT descend into nested Query/SetOp (their own scopes own their
-    identifiers)."""
-    import dataclasses as _dc
-
-    if isinstance(node, (ast.Query, ast.SetOp)):
-        return node
-    rep = fn(node)
-    if rep is not None:
-        return rep
-    if isinstance(node, list):
-        return [_ast_substitute(x, fn) for x in node]
-    if isinstance(node, tuple):
-        return tuple(_ast_substitute(x, fn) for x in node)
-    if _dc.is_dataclass(node) and not isinstance(node, type):
-        changes = {}
-        for fld in _dc.fields(node):
-            v = getattr(node, fld.name)
-            nv = _ast_substitute(v, fn)
-            if nv is not v:
-                changes[fld.name] = nv
-        return _dc.replace(node, **changes) if changes else node
-    return node
-
-
-def _expand_rollup(q: "ast.Query"):
-    """GROUP BY ROLLUP(a, b, ...) -> UNION ALL of one aggregation per prefix
-    of the rollup list (finest to grand total). Rolled-away columns become
-    typed NULLs (ast.NullOf) and GROUPING(col) folds to 0/1 per arm — the
-    standard lowering (the reference gets it from DataFusion's logical
-    planner)."""
-    import dataclasses as _dc
-
-    plain = [g for g in q.group_by if not _is_rollup(g)]
-    roll = next(g for g in q.group_by if _is_rollup(g)).args
-    if sum(1 for g in q.group_by if _is_rollup(g)) > 1:
-        raise BindError("multiple ROLLUPs in one GROUP BY")
-
-    arms = []
-    for k in range(len(roll), -1, -1):
-        dropped = {
-            i.name.lower() for i in roll[k:] if isinstance(i, ast.Ident)
-        }
-
-        def fn(node, dropped=dropped):
-            if isinstance(node, ast.FuncCall) and node.name.lower() == (
-                "grouping"
-            ):
-                arg = node.args[0]
-                flag = 1 if (
-                    isinstance(arg, ast.Ident) and arg.name.lower() in dropped
-                ) else 0
-                return ast.NumberLit(flag)
-            if isinstance(node, ast.Ident) and node.name.lower() in dropped:
-                return ast.NullOf(node)
-            return None
-
-        arm = _dc.replace(
-            q,
-            select_items=_ast_substitute(q.select_items, fn),
-            group_by=plain + list(roll[:k]),
-            having=_ast_substitute(q.having, fn) if q.having else None,
-            order_by=[],
-            limit=None,
-            offset=None,
-            ctes=[],
-        )
-        arms.append(arm)
-
-    combined = arms[0]
-    for arm in arms[1:]:
-        combined = ast.SetOp("union", True, combined, arm)
-
-    def order_fn(node):
-        # ORDER BY applies to the union result, where the arm is no longer
-        # known statically; GROUPING(col) is recovered per row as
-        # `CASE WHEN col IS NULL THEN 1 ELSE 0 END` (exact whenever the
-        # group column itself is non-null, which holds for the rollup
-        # dimensions in the TPC-DS suite).
-        if isinstance(node, ast.FuncCall) and node.name.lower() == "grouping":
-            return ast.CaseAst(
-                None,
-                [(ast.IsNullAst(node.args[0], False), ast.NumberLit(1))],
-                ast.NumberLit(0),
-            )
-        return None
-
-    combined.order_by = _ast_substitute(list(q.order_by), order_fn)
-    combined.limit = q.limit
-    combined.offset = q.offset
-    combined.ctes = list(q.ctes)
-    return combined
-
-
-def _contains_subquery(node) -> bool:
-    if isinstance(node, (ast.ScalarSubquery, ast.Exists, ast.InSubquery)):
-        return True
-    if isinstance(node, ast.Unary) and node.op == "not":
-        return _contains_subquery(node.child)
-    return any(_contains_subquery(ch) for ch in _ast_children(node))
-
-
-def _common_or_conjuncts(node: ast.Binary) -> list:
-    """Conjuncts present (by fingerprint) in every branch of an OR tree."""
-
-    def branches(n):
-        if isinstance(n, ast.Binary) and n.op == "or":
-            return branches(n.left) + branches(n.right)
-        return [n]
-
-    bs = branches(node)
-    if len(bs) < 2:
-        return []
-    sets = []
-    by_fp: dict[str, Any] = {}
-    for b in bs:
-        cs = _split_conjuncts(b)
-        fps = set()
-        for c in cs:
-            fp = _ast_fingerprint(c)
-            fps.add(fp)
-            by_fp.setdefault(fp, c)
-        sets.append(fps)
-    common = set.intersection(*sets)
-    return [by_fp[fp] for fp in sorted(common)]
-
-
-def _hoist_common_or(c) -> list:
-    """OR whose every branch repeats the same conjuncts ->
-    [common..., OR(branches stripped of them)] — an EQUIVALENT rewrite
-    (unlike _common_or_conjuncts, which only surfaces the implied
-    conjuncts). TPC-DS q41 hides its correlation this way:
-    `(corr AND colorsA) OR (corr AND colorsB)`."""
-    if not (isinstance(c, ast.Binary) and c.op == "or"):
-        return [c]
-    common = _common_or_conjuncts(c)
-    if not common:
-        return [c]
-    common_fps = {_ast_fingerprint(x) for x in common}
-
-    def branches(n):
-        if isinstance(n, ast.Binary) and n.op == "or":
-            return branches(n.left) + branches(n.right)
-        return [n]
-
-    stripped = []
-    for b in branches(c):
-        rest = [
-            x for x in _split_conjuncts(b)
-            if _ast_fingerprint(x) not in common_fps
-        ]
-        if not rest:
-            # one branch reduces to TRUE -> the whole OR is implied by the
-            # common conjuncts
-            return list(common)
-        stripped.append(_join_conjuncts(rest))
-    out = stripped[0]
-    for b in stripped[1:]:
-        out = ast.Binary("or", out, b)
-    return list(common) + [out]
-
-
-def _sort_fetch(q) -> "int | None":
-    """Top-k bound for a sort feeding LIMIT/OFFSET: limit+offset rows."""
-    if q.limit is None:
-        return None
-    return q.limit + (q.offset or 0)
-
-
-def _split_conjuncts(node) -> list:
-    if isinstance(node, ast.Binary) and node.op == "and":
-        return _split_conjuncts(node.left) + _split_conjuncts(node.right)
-    return [node]
-
-
-def _join_conjuncts(conjuncts: list):
-    if not conjuncts:
-        return None
-    out = conjuncts[0]
-    for c in conjuncts[1:]:
-        out = ast.Binary("and", out, c)
-    return out
-
-
-def _has_aggregates(q: ast.Query) -> bool:
-    out: list = []
-    for item in q.select_items:
-        _collect_agg_calls(item.expr, out)
-    return bool(out) or bool(q.group_by)
-
-
-def _ast_fingerprint(node) -> str:
-    """Structural fingerprint for matching GROUP BY exprs to SELECT exprs."""
-    if isinstance(node, ast.Ident):
-        return f"id:{node.qualifier or ''}.{node.name}"
-    if isinstance(node, ast.NumberLit):
-        return f"n:{node.value}"
-    if isinstance(node, ast.StringLit):
-        return f"s:{node.value}"
-    if isinstance(node, ast.DateLit):
-        return f"d:{node.days}"
-    if isinstance(node, ast.FuncCall):
-        args = ",".join(_ast_fingerprint(a) for a in node.args)
-        return f"f:{node.name}({args}){'D' if node.distinct else ''}"
-    if isinstance(node, ast.Star):
-        return f"*:{node.qualifier or ''}"
-    parts = ",".join(_ast_fingerprint(c) for c in _ast_children(node))
-    op = getattr(node, "op", "")
-    extra = ""
-    if isinstance(node, ast.LikeAst):
-        extra = f":{node.pattern}:{node.negated}"
-    if isinstance(node, ast.CastAst):
-        extra = f":{node.type_name}"
-    if isinstance(node, ast.ExtractAst):
-        extra = f":{node.part}"
-    return f"{type(node).__name__}:{op}{extra}({parts})"
-
-
-def _display_name(e, idx: int) -> str:
-    if isinstance(e, ast.Ident):
-        return e.name
-    return f"col{idx}"
-
-
-def _literal_expr(v):
-    if v is None:
-        # untyped NULL: the type comes from context (set-op peer, CASE arm,
-        # comparison partner) via _promote's NULL rule
-        return pe.Literal(None, DataType.NULL)
-    if isinstance(v, bool):
-        return pe.Literal(v, DataType.BOOL)
-    if isinstance(v, int):
-        return pe.Literal(v, DataType.INT64)
-    return pe.Literal(float(v), DataType.FLOAT64)
-
-
-def _cast_type(name: str) -> DataType:
-    name = name.strip().lower()
-    mapping = {
-        "int": DataType.INT32,
-        "integer": DataType.INT32,
-        "bigint": DataType.INT64,
-        "smallint": DataType.INT32,
-        "double": DataType.FLOAT64,
-        "double precision": DataType.FLOAT64,
-        "float": DataType.FLOAT32,
-        "real": DataType.FLOAT32,
-        "decimal": DataType.FLOAT64,
-        "numeric": DataType.FLOAT64,
-        "date": DataType.DATE32,
-        "boolean": DataType.BOOL,
-        "varchar": DataType.STRING,
-        "char": DataType.STRING,
-        "text": DataType.STRING,
-        "string": DataType.STRING,
-    }
-    if name in mapping:
-        return mapping[name]
-    raise BindError(f"unsupported cast type {name!r}")
-
-
-def _fold_date_arith(e: ast.Binary):
-    """Fold DATE +/- INTERVAL into a DateLit (TPC-H parameterized dates)."""
-    if e.op not in ("+", "-"):
-        return None
-    l, r = e.left, e.right
-    if isinstance(l, ast.DateLit) and isinstance(r, ast.IntervalLit):
-        sign = 1 if e.op == "+" else -1
-        days = _shift_date(l.days, sign * r.months, sign * r.days)
-        return pe.Literal(days, DataType.DATE32)
-    if isinstance(l, ast.IntervalLit) and isinstance(r, ast.DateLit) and e.op == "+":
-        days = _shift_date(r.days, l.months, l.days)
-        return pe.Literal(days, DataType.DATE32)
-    return None
-
-
-def _as_decimal(node):
-    """NumberLit (or +/-/*// tree of them) -> decimal.Decimal, else None."""
-    import decimal
-
-    if isinstance(node, ast.NumberLit):
-        if node.raw is not None:
-            return decimal.Decimal(node.raw)
-        if isinstance(node.value, int):
-            return decimal.Decimal(node.value)
-        return None
-    if isinstance(node, ast.Unary) and node.op == "-":
-        d = _as_decimal(node.child)
-        return -d if d is not None else None
-    if isinstance(node, ast.Binary) and node.op in ("+", "-", "*", "/"):
-        l = _as_decimal(node.left)
-        r = _as_decimal(node.right)
-        if l is None or r is None:
-            return None
-        if node.op == "+":
-            return l + r
-        if node.op == "-":
-            return l - r
-        if node.op == "*":
-            return l * r
-        if r == 0:
-            return None
-        return l / r
-
-
-def _fold_decimal_arith(e: ast.Binary):
-    if e.op not in ("+", "-", "*", "/"):
-        return None
-    if not (
-        isinstance(e.left, (ast.NumberLit, ast.Binary, ast.Unary))
-        and isinstance(e.right, (ast.NumberLit, ast.Binary, ast.Unary))
-    ):
-        return None
-    d = _as_decimal(e)
-    if d is None:
-        return None
-    if d == d.to_integral_value() and "." not in str(d):
-        return pe.Literal(int(d), DataType.INT64)
-    return pe.Literal(float(d), DataType.FLOAT64)
-
-
-def _shift_date(epoch_days: int, months: int, days: int) -> int:
-    import datetime
-
-    d = datetime.date(1970, 1, 1) + datetime.timedelta(days=epoch_days)
-    if months:
-        total = d.year * 12 + (d.month - 1) + months
-        y, m = divmod(total, 12)
-        import calendar
-
-        day = min(d.day, calendar.monthrange(y, m + 1)[1])
-        d = datetime.date(y, m + 1, day)
-    d = d + datetime.timedelta(days=days)
-    return (d - datetime.date(1970, 1, 1)).days
-
-
-def _collect_col_names(exprs) -> list[str]:
-    out: list[str] = []
-
-    def walk(x):
-        if isinstance(x, pe.Col):
-            out.append(x.name)
-        for c in x.children():
-            walk(c)
-
-    for e in exprs:
-        walk(e)
-    return out
-
-
-def _project_through(plan: LogicalPlan, exprs) -> LogicalPlan:
-    """Append columns to a plan's output by re-projecting through its top
-    projection (used to expose correlation key columns of a subquery)."""
-    if isinstance(plan, LProject):
-        have = {n for _, n in plan.exprs}
-        extra = []
-        cs = plan.child.schema()
-        for e, n in exprs:
-            if n not in have:
-                extra.append((e, n))
-        return LProject(plan.exprs + extra, plan.child)
-    return LProject(exprs, plan)

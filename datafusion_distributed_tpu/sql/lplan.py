@@ -1,0 +1,302 @@
+"""Logical plan nodes + catalog protocol (split out of logical.py).
+
+The reference gets its logical plan types from DataFusion (SURVEY.md L0);
+these are the original TPU-build equivalents. See `sql/logical.py` for the
+binder that produces them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Optional
+
+from datafusion_distributed_tpu.ops.aggregate import _VARIANCE_FUNCS
+from datafusion_distributed_tpu.plan import expressions as pe
+from datafusion_distributed_tpu.schema import DataType, Field, Schema
+
+
+# ---------------------------------------------------------------------------
+# Logical nodes
+# ---------------------------------------------------------------------------
+
+
+class LogicalPlan:
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    def children(self) -> list["LogicalPlan"]:
+        raise NotImplementedError
+
+    def display_tree(self, indent=0) -> str:
+        lines = ["  " * indent + self.display()]
+        for c in self.children():
+            lines.append(c.display_tree(indent + 1))
+        return "\n".join(lines)
+
+    def display(self) -> str:
+        return type(self).__name__
+
+
+@dataclass
+class LScan(LogicalPlan):
+    table: str
+    alias: str
+    table_schema: Schema  # original column names
+    flat_schema: Schema  # alias.column names
+
+    def schema(self):
+        return self.flat_schema
+
+    def children(self):
+        return []
+
+    def display(self):
+        return f"Scan {self.table} AS {self.alias}"
+
+
+@dataclass
+class LFilter(LogicalPlan):
+    predicate: pe.PhysicalExpr
+    child: LogicalPlan
+
+    def schema(self):
+        return self.child.schema()
+
+    def children(self):
+        return [self.child]
+
+    def display(self):
+        return f"Filter {self.predicate.display()}"
+
+
+@dataclass
+class LProject(LogicalPlan):
+    exprs: list  # [(PhysicalExpr, out_name)]
+    child: LogicalPlan
+
+    def schema(self):
+        cs = self.child.schema()
+        return Schema(
+            [Field(n, e.output_field(cs).dtype, e.output_field(cs).nullable)
+             for e, n in self.exprs]
+        )
+
+    def children(self):
+        return [self.child]
+
+    def display(self):
+        return "Project " + ", ".join(n for _, n in self.exprs)
+
+
+@dataclass
+class AggCall:
+    func: str  # sum|count|count_star|min|max|avg
+    arg: Optional[pe.PhysicalExpr]
+    name: str
+    distinct: bool = False
+
+
+@dataclass
+class LAggregate(LogicalPlan):
+    groups: list  # [(PhysicalExpr, name)]
+    aggs: list  # [AggCall]
+    child: LogicalPlan
+
+    def schema(self):
+        cs = self.child.schema()
+        fields = []
+        for e, n in self.groups:
+            f = e.output_field(cs)
+            fields.append(Field(n, f.dtype, f.nullable))
+        for a in self.aggs:
+            fields.append(Field(a.name, _agg_dtype(a, cs), True))
+        return Schema(fields)
+
+    def children(self):
+        return [self.child]
+
+    def display(self):
+        gs = ", ".join(n for _, n in self.groups)
+        as_ = ", ".join(f"{a.func}({a.arg.display() if a.arg else '*'})"
+                        for a in self.aggs)
+        return f"Aggregate gby=[{gs}] aggs=[{as_}]"
+
+
+def _agg_dtype(a: AggCall, cs: Schema) -> DataType:
+    if a.func in ("count", "count_star"):
+        return DataType.INT64
+    if a.func == "avg" or a.func in _VARIANCE_FUNCS:
+        return DataType.FLOAT64
+    f = a.arg.output_field(cs)
+    if a.func == "sum":
+        return DataType.FLOAT64 if f.dtype.is_float else DataType.INT64
+    return f.dtype
+
+
+@dataclass
+class LJoin(LogicalPlan):
+    left: LogicalPlan
+    right: LogicalPlan
+    how: str  # inner|left|semi|anti|mark|cross
+    left_keys: list  # [PhysicalExpr]
+    right_keys: list
+    residual: Optional[pe.PhysicalExpr] = None  # evaluated on joined schema
+    mark_name: Optional[str] = None
+    null_aware: bool = False  # NOT IN semantics for anti joins
+    # estimated output rows per probe row (the join orderer's NDV-based
+    # fan-out; sizes the physical join's output capacity so many-to-many
+    # joins do not start at 1x and burn overflow retries)
+    fanout_hint: float = 1.0
+
+    def schema(self):
+        if self.how in ("semi", "anti"):
+            return self.left.schema()
+        if self.how == "mark":
+            return Schema(
+                list(self.left.schema().fields)
+                + [Field(self.mark_name or "__mark", DataType.BOOL, False)]
+            )
+        left = self.left.schema().fields
+        right = [
+            Field(f.name, f.dtype, True if self.how == "left" else f.nullable)
+            for f in self.right.schema().fields
+        ]
+        return Schema(list(left) + right)
+
+    def children(self):
+        return [self.left, self.right]
+
+    def display(self):
+        ks = ", ".join(
+            f"{l.display()}={r.display()}"
+            for l, r in zip(self.left_keys, self.right_keys)
+        )
+        res = f" residual={self.residual.display()}" if self.residual else ""
+        return f"Join {self.how} on [{ks}]{res}"
+
+
+@dataclass
+class LWindowExpr:
+    func: str  # rank|dense_rank|row_number|sum|avg|min|max|count|count_star
+    arg: Optional[pe.PhysicalExpr]
+    partition_by: list  # [PhysicalExpr]
+    order_by: list  # [(PhysicalExpr, ascending, nulls_first|None)]
+    name: str
+    frame: str = "range"
+
+
+@dataclass
+class LWindow(LogicalPlan):
+    """Window evaluation: appends one column per LWindowExpr (post-GROUP BY,
+    pre-final-projection — standard SQL evaluation order)."""
+
+    exprs: list  # [LWindowExpr]
+    child: LogicalPlan
+
+    def schema(self):
+        fields = list(self.child.schema().fields)
+        cs = self.child.schema()
+        for w in self.exprs:
+            fields.append(Field(w.name, _window_dtype(w, cs), True))
+        return Schema(fields)
+
+    def children(self):
+        return [self.child]
+
+    def display(self):
+        inner = ", ".join(f"{w.func}() AS {w.name}" for w in self.exprs)
+        return f"Window [{inner}]"
+
+
+def _window_dtype(w: LWindowExpr, cs: Schema) -> DataType:
+    from datafusion_distributed_tpu.ops.window import window_output_dtype
+
+    input_dtype = w.arg.output_field(cs).dtype if w.arg is not None else None
+    return window_output_dtype(w.func, input_dtype)
+
+
+@dataclass
+class LSort(LogicalPlan):
+    keys: list  # [(PhysicalExpr, ascending, nulls_first|None)]
+    child: LogicalPlan
+    fetch: Optional[int] = None
+
+    def schema(self):
+        return self.child.schema()
+
+    def children(self):
+        return [self.child]
+
+    def display(self):
+        ks = ", ".join(
+            f"{e.display()} {'ASC' if asc else 'DESC'}" for e, asc, _ in self.keys
+        )
+        return f"Sort [{ks}]" + (f" fetch={self.fetch}" if self.fetch else "")
+
+
+@dataclass
+class LLimit(LogicalPlan):
+    child: LogicalPlan
+    fetch: Optional[int]
+    skip: int = 0
+
+    def schema(self):
+        return self.child.schema()
+
+    def children(self):
+        return [self.child]
+
+    def display(self):
+        return f"Limit fetch={self.fetch} skip={self.skip}"
+
+
+@dataclass
+class LDistinct(LogicalPlan):
+    child: LogicalPlan
+
+    def schema(self):
+        return self.child.schema()
+
+    def children(self):
+        return [self.child]
+
+
+@dataclass
+class LSetOp(LogicalPlan):
+    op: str  # union|intersect|except
+    all: bool
+    left: LogicalPlan
+    right: LogicalPlan
+
+    def schema(self):
+        return self.left.schema()
+
+    def children(self):
+        return [self.left, self.right]
+
+    def display(self):
+        return f"{self.op.upper()}{' ALL' if self.all else ''}"
+
+
+# ---------------------------------------------------------------------------
+# Catalog protocol
+# ---------------------------------------------------------------------------
+
+
+class CatalogProtocol:
+    """What the binder needs: schema lookup + view/CTE resolution."""
+
+    def table_schema(self, name: str) -> Schema:
+        raise NotImplementedError
+
+    def has_table(self, name: str) -> bool:
+        raise NotImplementedError
+
+    def table_rows(self, name: str) -> int:
+        """Row-count estimate for join ordering; override when known."""
+        return 1000
+
+    def column_ndv(self, table: str, column: str) -> Optional[int]:
+        """Distinct-count estimate for a column (join fan-out estimation);
+        None when unknown."""
+        return None

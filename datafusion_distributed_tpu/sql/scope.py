@@ -1,0 +1,55 @@
+"""Name-resolution scopes and binder errors (split out of logical.py)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from datafusion_distributed_tpu.schema import Field, Schema
+from datafusion_distributed_tpu.sql import parser as ast
+
+# ---------------------------------------------------------------------------
+# Binder
+# ---------------------------------------------------------------------------
+
+
+
+class BindError(ValueError):
+    pass
+
+
+@dataclass
+class Scope:
+    """In-scope relations: [(alias, original Schema)] resolving to flat names."""
+
+    entries: list  # [(alias, Schema)]
+    parent: Optional["Scope"] = None
+
+    def resolve(self, ident: ast.Ident) -> tuple[str, Field, int]:
+        """-> (flat_name, field, depth); depth 0 = local, 1+ = outer scope."""
+        depth = 0
+        scope: Optional[Scope] = self
+        while scope is not None:
+            hits = []
+            for alias, schema in scope.entries:
+                if ident.qualifier is not None and ident.qualifier != alias:
+                    continue
+                if ident.name in schema:
+                    hits.append((alias, schema.field(ident.name)))
+            if len(hits) > 1:
+                raise BindError(f"ambiguous column {ident.key()!r}")
+            if hits:
+                alias, f = hits[0]
+                flat = f"{alias}.{ident.name}" if alias else ident.name
+                return flat, f, depth
+            scope = scope.parent
+            depth += 1
+        raise BindError(f"unknown column {ident.key()!r}")
+
+
+@dataclass
+class OuterRef:
+    """Recorded reference from a subquery into an enclosing scope."""
+
+    flat_name: str
+    field: Field

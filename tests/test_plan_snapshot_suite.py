@@ -16,7 +16,7 @@ import re
 import pytest
 
 from datafusion_distributed_tpu.data.tpchgen import register_tpch
-from datafusion_distributed_tpu.sql import logical as logical_mod
+from datafusion_distributed_tpu.sql import binder_subqueries as subq_mod
 from datafusion_distributed_tpu.sql import planner as planner_mod
 from datafusion_distributed_tpu.sql.context import SessionContext
 
@@ -60,7 +60,7 @@ def _check_snapshot(suite: str, ctx: SessionContext, q: str) -> None:
     # deterministic temp/mark column numbering regardless of which queries
     # were planned before this one in the process
     planner_mod._TMP = itertools.count()
-    logical_mod._MARK_SEQ = itertools.count()
+    subq_mod._MARK_SEQ = itertools.count()
     df = ctx.sql(open(sql_path).read())
     tree = normalize(df.explain_distributed(4))
     snap = os.path.join(SNAPDIR, suite, f"{q}.txt")

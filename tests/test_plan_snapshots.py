@@ -137,3 +137,26 @@ def test_stage_ids_are_stamped(ctx):
     ).explain_distributed(4)
     stages = re.findall(r"── stage (\d+)", tree)
     assert stages and sorted(set(stages)) == sorted(stages)
+
+
+def test_agg_fingerprint_fallback_binds(ctx):
+    """An aggregate recreated as a distinct AST object (rollup/decorrelation
+    substitutions) must match its agg_map entry structurally via
+    _match_agg_by_fingerprint — regression for the module split dropping
+    the _AGG_ID_REGISTRY import (NameError instead of a structural match)."""
+    from datafusion_distributed_tpu.sql import parser as ast
+    from datafusion_distributed_tpu.sql.ast_utils import (
+        _AGG_ID_REGISTRY,
+        _collect_agg_calls,
+    )
+    from datafusion_distributed_tpu.sql.logical import Binder
+
+    binder = Binder(ctx.catalog)
+    call_a = ast.FuncCall("sum", [ast.Ident(None, "x")], False)
+    call_b = ast.FuncCall("sum", [ast.Ident(None, "x")], False)  # same shape
+    found: list = []
+    _collect_agg_calls(call_a, found)   # registers call_a in the registry
+    assert id(call_a) in _AGG_ID_REGISTRY
+    agg_map = {id(call_a): ("sum_x", None)}
+    got = binder._match_agg_by_fingerprint(call_b, agg_map)
+    assert got == ("sum_x", None)
