@@ -301,6 +301,25 @@ def global_aggregate(table: Table, aggs: Sequence[AggSpec], mode: str = "single"
                  jnp.asarray(1, dtype=jnp.int32))
 
 
+def _mean_shifted_seg_sum(vals, valid, seg_sum, group_counts):
+    """Per-group float sum as seg_sum(x - m) + m*n_g (f32 storage mode).
+
+    A raw f32 scatter-add over millions of same-sign values drifts
+    ~sqrt(N)*eps relative — enough that two task layouts of the SAME data
+    disagree beyond 5e-4 (seen at TPC-H SF0.5, q1 avg_disc). The identity
+    is algebraically exact for ANY scalar center m; centering residuals
+    near zero makes the scatter-add cancel instead of accumulate (probe:
+    3M rows, max rel err vs f64 truth 8e-8). m only needs to be a rough
+    center, so a plain f32 mean is fine — but it must be FINITE: a
+    non-finite m (any Inf/NaN in the data) would poison every group, so
+    fall back to m=0 (the raw scatter-add, which confines Inf/NaN to the
+    group containing it)."""
+    m = jnp.sum(vals) / jnp.maximum(jnp.sum(valid), 1)
+    m = jnp.where(jnp.isfinite(m), m, jnp.zeros_like(m))
+    return seg_sum(jnp.where(valid, vals - m, 0)) \
+        + m * group_counts.astype(vals.dtype)
+
+
 def _eval_agg(spec, table, gid, live, num_slots, mode, seg_sum,
               prec_flags=None):
     """Produce the output column(s) for one AggSpec in the given mode."""
@@ -406,17 +425,7 @@ def _eval_agg(spec, table, gid, live, num_slots, mode, seg_sum,
         vals = jnp.where(valid, col.data, 0).astype(acc_dtype)
         nonempty = seg_sum(jnp.where(valid, 1, 0).astype(_ACC_INT))
         if col.dtype.is_float and jnp.dtype(acc_dtype) == jnp.float32:
-            # Mean-shifted accumulation (f32 storage mode): a raw f32
-            # scatter-add over millions of same-sign values drifts
-            # ~sqrt(N)*eps relative — enough that two task layouts of the
-            # SAME data disagree beyond 5e-4 (seen at TPC-H SF0.5, avg_disc).
-            # sum_g = seg_sum(x - m) + m*n_g is algebraically exact for any
-            # scalar m; centering residuals near zero makes the scatter-add
-            # cancel instead of accumulate. m itself only needs to be a
-            # rough center, so a plain f32 mean is fine.
-            m = jnp.sum(vals) / jnp.maximum(jnp.sum(valid), 1)
-            s = seg_sum(jnp.where(valid, vals - m, 0)) \
-                + m * nonempty.astype(acc_dtype)
+            s = _mean_shifted_seg_sum(vals, valid, seg_sum, nonempty)
         else:
             s = seg_sum(vals)
             _check_int32_sum_range(vals, seg_sum, prec_flags)
@@ -435,10 +444,7 @@ def _eval_agg(spec, table, gid, live, num_slots, mode, seg_sum,
         vals = jnp.where(valid, col.data, 0).astype(DataType.FLOAT64.np_dtype)
         cnt = seg_sum(jnp.where(valid, 1, 0).astype(_ACC_INT))
         if jnp.dtype(vals.dtype) == jnp.float32:
-            # mean-shifted, same rationale as the sum path above
-            m = jnp.sum(vals) / jnp.maximum(jnp.sum(valid), 1)
-            s = seg_sum(jnp.where(valid, vals - m, 0)) \
-                + m * cnt.astype(vals.dtype)
+            s = _mean_shifted_seg_sum(vals, valid, seg_sum, cnt)
         else:
             s = seg_sum(vals)
         avg = s / jnp.where(cnt == 0, 1, cnt)
