@@ -21,7 +21,7 @@ def main() -> int:
     per = defaultdict(dict)   # query -> tier -> record
     tiers_seen: list[str] = []
     for r in rows:
-        if "tier" not in r:
+        if "tier" not in r or r.get("stage"):
             continue
         q, tier = r["query"], r["tier"]
         per[q][tier] = r

@@ -78,7 +78,40 @@ def main() -> int:
     ap.add_argument("--out", default="/root/repo/.sweep_r05.jsonl")
     ap.add_argument("--workers", type=int, default=4)
     ap.add_argument("--tasks", type=int, default=8)
+    ap.add_argument("--rlimit-gb", type=float, default=96.0,
+                    help="RLIMIT_AS cap so a capacity/compile blowup "
+                         "raises MemoryError instead of OOM-killing")
     args = ap.parse_args()
+
+    if args.rlimit_gb > 0:
+        import resource
+
+        cap = int(args.rlimit_gb * (1 << 30))
+        resource.setrlimit(resource.RLIMIT_AS, (cap, cap))
+
+    # Resumability: one OOM-kill/segfault must only cost the in-flight
+    # pair. Completed (tier, query) pairs are skipped on relaunch. A pair
+    # with ONE dangling `started` marker gets retried (an interrupt is
+    # not a poison pair); TWO dangling markers mean it crashed the
+    # process twice — record it as crashed and skip, else a poison pair
+    # would crash every relaunch forever.
+    done_pairs: set = set()
+    started_counts: dict = {}
+    if os.path.exists(args.out):
+        for line in open(args.out):
+            if not line.strip():
+                continue
+            try:
+                r = json.loads(line)
+            except ValueError:
+                continue
+            if r.get("stage") == "started":
+                key = (r["tier"], r["query"])
+                started_counts[key] = started_counts.get(key, 0) + 1
+            elif "tier" in r and r["tier"] != "single":
+                done_pairs.add((r["tier"], r["query"]))
+                started_counts.pop((r["tier"], r["query"]), None)
+    crashed = {k for k, n in started_counts.items() if n >= 2}
 
     from datafusion_distributed_tpu.data.tpchgen import gen_tpch
     from datafusion_distributed_tpu.runtime.coordinator import (
@@ -87,6 +120,16 @@ def main() -> int:
         InMemoryCluster,
     )
     from datafusion_distributed_tpu.sql.context import SessionContext
+
+    # the crash being recovered from may have torn the final line; a
+    # leading newline isolates it so resumes and the composer stay parseable
+    if os.path.exists(args.out):
+        with open(args.out, "rb+") as f:
+            f.seek(0, 2)
+            if f.tell() > 0:
+                f.seek(-1, 2)
+                if f.read(1) != b"\n":
+                    f.write(b"\n")
 
     def log(**kw):
         kw["ts"] = round(time.time(), 1)
@@ -123,6 +166,14 @@ def main() -> int:
             continue
         sql = open(path).read()
         for tier in tiers:
+            if (tier, q) in done_pairs:
+                continue
+            if (tier, q) in crashed:
+                log(tier=tier, query=q, ok=False,
+                    error="crashed previous sweep process (OOM/abort); "
+                          "skipped on resume")
+                continue
+            log(stage="started", tier=tier, query=q)
             t = time.perf_counter()
             try:
                 df = ctx.sql(sql)

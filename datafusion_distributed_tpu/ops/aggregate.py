@@ -316,8 +316,16 @@ def _mean_shifted_seg_sum(vals, valid, seg_sum, group_counts):
     group containing it)."""
     m = jnp.sum(vals) / jnp.maximum(jnp.sum(valid), 1)
     m = jnp.where(jnp.isfinite(m), m, jnp.zeros_like(m))
-    return seg_sum(jnp.where(valid, vals - m, 0)) \
-        + m * group_counts.astype(vals.dtype)
+    z = seg_sum(jnp.where(valid, vals - m, 0))
+    # optimization_barrier: on this image's XLA:CPU, letting the compiler
+    # fuse the `+ m*n_g` add with the two scatters corrupts the scatter's
+    # contribution entirely (observed: result off by the full residual sum
+    # plus more, ~1e-3 relative, vs ~1e-5 with the pieces computed
+    # separately — reproduced with a python-constant m and bitwise-equal
+    # inputs, so it is a fusion bug, not accumulation noise). The barrier
+    # pins the scatter result before the elementwise add.
+    z = jax.lax.optimization_barrier(z)
+    return z + m * group_counts.astype(vals.dtype)
 
 
 def _eval_agg(spec, table, gid, live, num_slots, mode, seg_sum,

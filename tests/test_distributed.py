@@ -239,7 +239,11 @@ def test_distributed_sql_join_matches_single(mesh):
         ctx.sql(sql).collect_distributed_table(mesh=mesh)
     ).to_pandas()
     np.testing.assert_array_equal(got["k"], single["k"])
-    np.testing.assert_allclose(got["s"], single["s"], rtol=FLOAT_RTOL)
+    # atol: sums of zero-mean products land near 0, where rtol-only
+    # comparison of two equally-f32-accurate layouts (mean-shifted
+    # accumulation centers differ per task) is meaningless
+    np.testing.assert_allclose(got["s"], single["s"], rtol=FLOAT_RTOL,
+                               atol=1e-5)
     np.testing.assert_array_equal(got["n"], single["n"])
 
 
