@@ -294,3 +294,25 @@ def compute_based_task_count(
 
     t = math.ceil(cost.compute / max(bytes_per_task_per_second, 1.0) / target_seconds)
     return max(1, min(t, max_tasks))
+
+
+def plan_device_bytes(plan) -> int:
+    """Coarse upper bound on one program's device-buffer footprint:
+    sum over nodes of output_capacity * row_width. Used by the
+    overflow-retry guard: each retry widens capacity factors 4x, and a
+    few compounding retries can plan buffers beyond physical memory —
+    the guard abandons the retry with a clear overflow error instead of
+    letting dispatch fail with an opaque allocator error (observed: q2
+    SF0.5 adaptive tier, ~100GB planned after two widenings)."""
+    total = 0
+    for node in plan.collect(lambda _n: True):
+        try:
+            w = row_width(node.schema())
+        except Exception:
+            w = 8
+        try:
+            cap = int(node.output_capacity())
+        except Exception:
+            cap = 0
+        total += cap * max(w, 1)
+    return total

@@ -138,6 +138,47 @@ class SessionConfig:
             raise ValueError(f"unknown option scope {scope!r}")
 
 
+
+class OverflowRetryAbandoned(RuntimeError):
+    """Raised (instead of another widening) when an overflow retry's plan
+    would exceed the device-memory budget. A distinct type so the retry
+    loops' `"overflow" in str(e)` filter does not catch it and keep
+    widening — re-planning at 16x/64x factors executes plan-time scalar
+    subqueries at exactly the blown-up capacities the guard exists to
+    prevent."""
+
+
+def _overflow_retry_guard(plan, attempt: int, last_err) -> None:
+    """Abandon an overflow retry whose widened plan would need more device
+    memory than the budget (DFTPU_RETRY_BYTES_BUDGET, default 16 GB):
+    capacity factors compound 4x per retry, and dispatching a ~100GB plan
+    fails with an opaque allocator error (or the OOM killer) instead of
+    the overflow error the caller can reason about."""
+    if attempt == 0:
+        return
+    import os as _os
+
+    from datafusion_distributed_tpu.planner.statistics import (
+        plan_device_bytes,
+    )
+
+    raw = _os.environ.get("DFTPU_RETRY_BYTES_BUDGET", "")
+    try:
+        budget = float(raw) if raw else 16e9
+    except ValueError:
+        raise RuntimeError(
+            f"DFTPU_RETRY_BYTES_BUDGET={raw!r} is not a number"
+        ) from None
+    need = plan_device_bytes(plan)
+    if need > budget:
+        raise OverflowRetryAbandoned(
+            f"overflow-retry abandoned: widened plan needs ~{need/1e9:.1f}GB "
+            f"device buffers (budget {budget/1e9:.1f}GB, "
+            "DFTPU_RETRY_BYTES_BUDGET); original overflow: "
+            f"{last_err}"
+        )
+
+
 class DataFrame:
     """A planned (but unexecuted) query."""
 
@@ -172,10 +213,13 @@ class DataFrame:
                 # planning is inside the try: scalar subqueries execute at
                 # plan time and their overflows must trigger the same retry
                 plan = self.physical_plan(cfg)
+                _overflow_retry_guard(plan, _attempt, last_err)
                 out = execute_plan(plan)
                 self.last_retry_count = _attempt  # observability (sweeps)
                 return out
             except RuntimeError as e:
+                if isinstance(e, OverflowRetryAbandoned):
+                    raise
                 if "overflow" not in str(e):
                     raise
                 last_err = e
@@ -294,10 +338,13 @@ class DataFrame:
         for _attempt in range(self.ctx.config.overflow_retries + 1):
             try:
                 plan = self.distributed_plan(t, dcfg, pcfg, mesh=mesh)
+                _overflow_retry_guard(plan, _attempt, last_err)
                 out = execute_on_mesh(plan, mesh)
                 self.last_retry_count = _attempt
                 return out
             except RuntimeError as e:
+                if isinstance(e, OverflowRetryAbandoned):
+                    raise
                 if "overflow" not in str(e):
                     raise
                 last_err = e
@@ -373,10 +420,13 @@ class DataFrame:
                 plan = self.distributed_plan(
                     num_tasks, dcfg, pcfg, coordinator=coordinator
                 )
+                _overflow_retry_guard(plan, _attempt, last_err)
                 out = coordinator.execute(plan)
                 self.last_retry_count = _attempt
                 return out
             except RuntimeError as e:
+                if isinstance(e, OverflowRetryAbandoned):
+                    raise
                 if "overflow" not in str(e):
                     raise
                 last_err = e

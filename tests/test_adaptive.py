@@ -124,7 +124,8 @@ def test_adaptive_coordinator_matches_single():
     coord = AdaptiveCoordinator(resolver=cluster, channels=cluster)
     got = coord.execute(dplan).to_pandas().sort_values("k").reset_index(drop=True)
     np.testing.assert_array_equal(got["k"], single["k"])
-    np.testing.assert_allclose(got["sv"], single["sv"], rtol=FLOAT_RTOL)
+    np.testing.assert_allclose(got["sv"], single["sv"], rtol=FLOAT_RTOL,
+                               atol=1e-4)
     np.testing.assert_array_equal(got["n"], single["n"])
 
 
@@ -210,8 +211,13 @@ def test_coshuffled_join_stage_adapts_shared_count():
         exp = df.to_pandas()
         np.testing.assert_array_equal(got["k"].to_numpy(),
                                       exp["k"].to_numpy())
-        np.testing.assert_allclose(got["sv"], exp["sv"], rtol=FLOAT_RTOL)
-        np.testing.assert_allclose(got["sw"], exp["sw"], rtol=FLOAT_RTOL)
+        # atol scaled to the data: group sums reach ~3e3, so 0.02 is
+        # ~7e-6 of the column magnitude — zero-mean sums near 0 are where
+        # rtol-only comparison of equally-f32-accurate layouts fails
+        np.testing.assert_allclose(got["sv"], exp["sv"], rtol=FLOAT_RTOL,
+                                   atol=2e-2)
+        np.testing.assert_allclose(got["sw"], exp["sw"], rtol=FLOAT_RTOL,
+                                   atol=2e-2)
         return coord.task_count_decisions
 
     small = run(200)

@@ -399,3 +399,40 @@ def test_shuffle_partition_streams_match_bulk():
     np.testing.assert_array_equal(out["k"], exp["k"])
     np.testing.assert_allclose(out["sv"], exp["sv"], rtol=FLOAT_RTOL)
     np.testing.assert_array_equal(out["n"], exp["n"])
+
+
+def test_overflow_retry_guard_budget(monkeypatch):
+    """Retry guard: attempt 0 never blocks; a widened retry whose plan
+    footprint exceeds DFTPU_RETRY_BYTES_BUDGET raises a DISTINCT error
+    type (so the retry loops' overflow filter re-raises it instead of
+    widening again) rather than letting dispatch hit an allocator
+    failure."""
+    import pytest
+
+    from datafusion_distributed_tpu.schema import DataType, Field, Schema
+    from datafusion_distributed_tpu.sql.context import (
+        OverflowRetryAbandoned,
+        _overflow_retry_guard,
+    )
+
+    monkeypatch.delenv("DFTPU_RETRY_BYTES_BUDGET", raising=False)
+
+    class Fat:
+        def schema(self):
+            return Schema([Field("x", DataType.INT64, False)] * 16)
+
+        def output_capacity(self):
+            return 1 << 30
+
+        def children(self):
+            return []
+
+        def collect(self, pred):
+            return [self] if pred(self) else []
+
+    _overflow_retry_guard(Fat(), 0, None)  # first attempt: no budget check
+    with pytest.raises(OverflowRetryAbandoned, match="overflow-retry abandoned"):
+        _overflow_retry_guard(Fat(), 1, RuntimeError("hash table overflow"))
+    monkeypatch.setenv("DFTPU_RETRY_BYTES_BUDGET", "not-a-number")
+    with pytest.raises(RuntimeError, match="DFTPU_RETRY_BYTES_BUDGET"):
+        _overflow_retry_guard(Fat(), 1, RuntimeError("hash table overflow"))
