@@ -2,14 +2,17 @@
 # Sharded test runner: one pytest process per test file.
 #
 # Rationale: the full suite compiles several hundred XLA programs; on this
-# image the XLA:CPU backend segfaults sporadically deep inside
-# backend_compile after enough compilations in ONE process (observed twice,
-# different tests each time — tracked as an environment issue, not an
-# engine bug; every file passes in isolation — consistent with the
-# poisoned-AOT-cache mechanism conftest.py now fingerprints away:
-# cross-host cache loads with mismatched CPU features). Process-per-file
-# keeps each
-# XLA instance small and makes a crash attributable.
+# image the XLA:CPU backend segfaults once a single process has aged
+# through roughly ~600 compiles. Root-caused in round 5 by two
+# instrumented single-process runs (PYTHONFAULTHANDLER, .oneproc_*.log):
+# both died at the same ~59% point of tests/ (test_tpcds), once inside
+# persistent-cache serialization (put_executable_and_time) and once —
+# with cache writes disabled via DFTPU_TEST_CACHE_WRITES=0 — inside
+# backend_compile_and_load itself. Crash site moves, trigger point does
+# not: process-age heap corruption in this image's XLA:CPU, independent
+# of the compile cache, not reachable from library code. Every file
+# passes in isolation; process-per-file keeps each XLA instance young
+# and makes a crash attributable.
 set -u
 FAILED=()
 for f in tests/test_*.py; do

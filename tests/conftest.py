@@ -85,6 +85,16 @@ if _test_cache != "0":
 
     def _single_device_only_put(cache_key, module_name, executable,
                                 backend, compile_time):
+        # DFTPU_TEST_CACHE_WRITES=0: reads still hit a pre-warmed cache but
+        # nothing is serialized. Needed for SINGLE-process full-suite runs:
+        # after several hundred in-process compiles even single-device
+        # serialization segfaults (observed at tests/ 59%, crash inside
+        # put_executable_and_time; the sharded runner never ages a process
+        # far enough to hit it). With writes off the full suite passes in
+        # one process — the crash is in the cache-write serializer, not
+        # compilation or execution.
+        if os.environ.get("DFTPU_TEST_CACHE_WRITES", "1") == "0":
+            return None
         try:
             multi = len(executable.local_devices()) > 1
         except Exception:
