@@ -148,6 +148,54 @@ class OverflowRetryAbandoned(RuntimeError):
     prevent."""
 
 
+def _overflow_node_names(err) -> str:
+    """The capacity-overflow errors embed the failing program's capacity-
+    capable node labels ("... (nodes: ['HashAggregate']); ..."). The flag is
+    OR-reduced on device (one tunnel fetch), so the individual culprit is
+    unknown — but the candidate SET is, and it bounds which planner knobs a
+    retry must widen."""
+    import re as _re
+
+    m = _re.search(r"nodes: \[([^\]]*)\]", str(err))
+    return m.group(1) if m else ""
+
+
+def _widen_for_overflow(pcfg: "PlannerConfig", dcfg, err,
+                        force_all: bool = False):
+    """-> (pcfg, dcfg) with only the capacity knobs implicated by the
+    overflow error widened 4x. ``dcfg`` is None for single-process collects
+    (no shuffle capacities exist there).
+
+    A global widening compounds across knobs: an undersized aggregate table
+    in one stage of q2 (SF0.5, adaptive tier) 4x'd join expansion AND
+    shuffle skew query-wide, and two retries planned ~916GB of device
+    buffers — tripping the byte-budget guard and failing a query a targeted
+    agg widening converges in one retry. If NO knob applicable to the given
+    configs is implicated (unparseable list, a future node class's label,
+    or shuffle-only with dcfg=None), everything applicable widens: the
+    alternative is re-executing the byte-identical plan every retry.
+
+    ``force_all`` (the retry loops pass it on the LAST widening) also
+    widens everything: targeting serializes knob discovery — an agg that
+    needs two widenings hides a shuffle overflow behind it — so the final
+    attempt must not die one knob short of the old global behavior."""
+    names = _overflow_node_names(err)
+    join = "Join" in names
+    agg = "Aggregate" in names
+    shuf = "Shuffle" in names and dcfg is not None
+    if force_all or not (join or agg or shuf):
+        join = agg = True
+        shuf = dcfg is not None
+    pcfg = replace(
+        pcfg,
+        join_expansion_factor=pcfg.join_expansion_factor * (4 if join else 1),
+        agg_slot_factor=pcfg.agg_slot_factor * (4 if agg else 1),
+    )
+    if shuf:
+        dcfg = replace(dcfg, shuffle_skew_factor=dcfg.shuffle_skew_factor * 4)
+    return pcfg, dcfg
+
+
 def _overflow_retry_guard(plan, attempt: int, last_err) -> None:
     """Abandon an overflow retry whose widened plan would need more device
     memory than the budget (DFTPU_RETRY_BYTES_BUDGET, default 16 GB):
@@ -223,10 +271,10 @@ class DataFrame:
                 if "overflow" not in str(e):
                     raise
                 last_err = e
-                cfg = replace(
-                    cfg,
-                    join_expansion_factor=cfg.join_expansion_factor * 4,
-                    agg_slot_factor=cfg.agg_slot_factor * 4,
+                cfg, _ = _widen_for_overflow(
+                    cfg, None, e,
+                    force_all=_attempt
+                    >= self.ctx.config.overflow_retries - 1,
                 )
         raise last_err  # type: ignore[misc]
 
@@ -348,15 +396,12 @@ class DataFrame:
                 if "overflow" not in str(e):
                     raise
                 last_err = e
-                pcfg = replace(
-                    pcfg,
-                    join_expansion_factor=pcfg.join_expansion_factor * 4,
-                    agg_slot_factor=pcfg.agg_slot_factor * 4,
-                )
                 # widen in place so every other customized field survives
                 # the retry (session SET options, skew factor included)
-                dcfg = replace(
-                    dcfg, shuffle_skew_factor=dcfg.shuffle_skew_factor * 4
+                pcfg, dcfg = _widen_for_overflow(
+                    pcfg, dcfg, e,
+                    force_all=_attempt
+                    >= self.ctx.config.overflow_retries - 1,
                 )
         raise last_err  # type: ignore[misc]
 
@@ -430,13 +475,10 @@ class DataFrame:
                 if "overflow" not in str(e):
                     raise
                 last_err = e
-                pcfg = replace(
-                    pcfg,
-                    join_expansion_factor=pcfg.join_expansion_factor * 4,
-                    agg_slot_factor=pcfg.agg_slot_factor * 4,
-                )
-                dcfg = replace(
-                    dcfg, shuffle_skew_factor=dcfg.shuffle_skew_factor * 4
+                pcfg, dcfg = _widen_for_overflow(
+                    pcfg, dcfg, e,
+                    force_all=_attempt
+                    >= self.ctx.config.overflow_retries - 1,
                 )
         raise last_err  # type: ignore[misc]
 

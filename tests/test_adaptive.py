@@ -285,3 +285,70 @@ def test_midstream_column_loadinfo():
     assert any(1 <= v <= 64 for v in info.ndv.values()), info.ndv
     assert info.null_frac, "no null fractions sampled"
     assert info.rows_per_s > 0 and info.bytes_per_s > 0
+
+
+def test_targeted_overflow_widening():
+    """An overflow names its program's capacity-capable nodes; the retry
+    must widen ONLY the implicated knobs. Global widening is how one
+    undersized aggregate table compounded into a ~916GB plan (q2 SF0.5
+    adaptive) that tripped the byte-budget guard instead of converging."""
+    from datafusion_distributed_tpu.planner.distributed import (
+        DistributedConfig,
+    )
+    from datafusion_distributed_tpu.sql.context import _widen_for_overflow
+    from datafusion_distributed_tpu.sql.planner import PlannerConfig
+
+    p = PlannerConfig()
+    d = DistributedConfig(num_tasks=4)
+
+    agg = RuntimeError(
+        "hash table overflow in plan (nodes: ['HashAggregate']); "
+        "re-plan with more slots"
+    )
+    p2, d2 = _widen_for_overflow(p, d, agg)
+    assert p2.agg_slot_factor == p.agg_slot_factor * 4
+    assert p2.join_expansion_factor == p.join_expansion_factor
+    assert d2.shuffle_skew_factor == d.shuffle_skew_factor
+
+    js = RuntimeError(
+        "exchange/hash capacity overflow on mesh (nodes: "
+        "['HashJoin', 'ShuffleExchange']); re-plan with more slots"
+    )
+    p3, d3 = _widen_for_overflow(p, d, js)
+    assert p3.join_expansion_factor == p.join_expansion_factor * 4
+    assert p3.agg_slot_factor == p.agg_slot_factor
+    assert d3.shuffle_skew_factor == d.shuffle_skew_factor * 4
+
+    # no parseable node list -> the pre-targeting widen-everything behavior
+    bare = RuntimeError("hash table overflow somewhere")
+    p4, d4 = _widen_for_overflow(p, d, bare)
+    assert p4.agg_slot_factor == p.agg_slot_factor * 4
+    assert p4.join_expansion_factor == p.join_expansion_factor * 4
+    assert d4.shuffle_skew_factor == d.shuffle_skew_factor * 4
+
+    # parsed list with NO recognized label (future node class): must widen
+    # everything, not nothing — else every retry re-runs the same plan
+    odd = RuntimeError(
+        "hash table overflow in plan (nodes: ['TopK']); re-plan"
+    )
+    p5, d5 = _widen_for_overflow(p, d, odd)
+    assert p5.agg_slot_factor == p.agg_slot_factor * 4
+    assert p5.join_expansion_factor == p.join_expansion_factor * 4
+    assert d5.shuffle_skew_factor == d.shuffle_skew_factor * 4
+
+    # single-process collect has no distributed config: a shuffle-only
+    # list must still widen the planner factors, not no-op every retry
+    shuf_only = RuntimeError(
+        "hash table overflow in plan (nodes: ['ShuffleExchange']); re-plan"
+    )
+    p6, d6 = _widen_for_overflow(p, None, shuf_only)
+    assert d6 is None
+    assert p6.agg_slot_factor == p.agg_slot_factor * 4
+    assert p6.join_expansion_factor == p.join_expansion_factor * 4
+
+    # force_all (the loops' LAST widening): targeting serializes knob
+    # discovery, so the final attempt widens everything applicable
+    p7, d7 = _widen_for_overflow(p, d, agg, force_all=True)
+    assert p7.agg_slot_factor == p.agg_slot_factor * 4
+    assert p7.join_expansion_factor == p.join_expansion_factor * 4
+    assert d7.shuffle_skew_factor == d.shuffle_skew_factor * 4
