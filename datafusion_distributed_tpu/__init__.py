@@ -39,6 +39,24 @@ if _cache_dir and _cache_dir != "0":
         _jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     except Exception:  # pragma: no cover - older jax config name guard
         pass
+    # jax's persistent cache hard-codes a platform allowlist
+    # (compilation_cache.py: supported_platforms = ["tpu","gpu","cpu","neuron"])
+    # and silently disables itself for the TPU-tunnel plugin's "axon"
+    # platform — which is why four rounds of TPU bench runs never populated
+    # the cache despite the plugin's executables serializing fine (verified:
+    # runtime_executable().serialize() returns bytes on axon). The allowlist
+    # is a local inside the once-per-process check, so the only seam is the
+    # check's memoization globals: pre-answer "yes" before any backend
+    # initializes. Opt-in only (DFTPU_COMPILE_CACHE set), and harmless for
+    # cpu/tpu backends which the allowlist already admits.
+    try:
+        from jax._src import compilation_cache as _cc
+
+        if hasattr(_cc, "_cache_checked") and hasattr(_cc, "_cache_used"):
+            _cc._cache_checked = True
+            _cc._cache_used = True
+    except Exception:  # pragma: no cover - private-API drift guard
+        pass
 
 # Honor JAX_PLATFORMS when a platform plugin force-selected itself at
 # registration time (the environment's TPU-tunnel plugin sets

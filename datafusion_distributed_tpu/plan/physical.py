@@ -24,6 +24,7 @@ makes XLA shapes static (SURVEY.md §7 hard part (c)).
 from __future__ import annotations
 
 import itertools
+import threading
 from dataclasses import dataclass, field as dc_field
 from typing import Any, Callable, Optional, Sequence
 
@@ -605,6 +606,8 @@ def execute_plan(
     metrics_store=None,
     task_label: Optional[str] = None,
     use_cache: bool = True,
+    shared_cache: Optional[dict] = None,
+    shared_key=None,
 ) -> Table:
     """Run a (single-task) plan: host-load leaves, trace+jit the rest once.
 
@@ -613,18 +616,34 @@ def execute_plan(
     executable (the analogue of the reference's task re-execution against the
     cached plan in `TaskData`). When ``metrics_store`` is given, the traced
     per-node metrics are returned as program outputs and inserted under
-    ``task_label`` (runtime/metrics.py MetricsStore protocol)."""
+    ``task_label`` (runtime/metrics.py MetricsStore protocol).
+
+    ``shared_cache``/``shared_key`` let a caller share ONE traced program
+    across *distinct plan objects of the same stage* (the worker runtime:
+    every task of a stage decodes its own plan copy, but the padded-capacity
+    lattice makes the traced computation task-invariant — only the leaf
+    *data* differs, and that enters as a program input). The caller is
+    responsible for only passing plans whose trace does not branch on
+    ``task_index`` (see Worker.execute_task: IsolatedArmExec disables it);
+    the input pytree structure + shapes/dtypes are appended to the key here,
+    so same-stage tasks with divergent leaf shapes simply miss."""
     task = task or DistributedTaskContext()
     leaves = collect_leaves(plan)
-    inputs = {}
-    for leaf in leaves:
-        if hasattr(leaf, "load"):
-            inputs[leaf.node_id] = leaf.load(task)
+    # positional inputs, rebound to node ids INSIDE run via the closure
+    # plan's own leaf order: node ids are minted per decode, so a shared
+    # program traced from one task's plan copy must not see another copy's
+    # ids in its input pytree — leaf traversal order is the cross-copy
+    # stable identity (identical stage trees traverse identically)
+    leaf_ids = [leaf.node_id for leaf in leaves if hasattr(leaf, "load")]
+    input_list = [
+        leaf.load(task) for leaf in leaves if hasattr(leaf, "load")
+    ]
 
     overflow_box: list = []
     metric_names: list = []
 
-    def run(inp):
+    def run(inp_list):
+        inp = dict(zip(leaf_ids, inp_list))
         ctx = ExecContext(task=task, inputs=inp, config=config or {})
         out = plan.execute(ctx)
         overflow_box.clear()
@@ -664,6 +683,44 @@ def execute_plan(
     # and would never hit) keeps one-shot programs out of the global cache so
     # their closures don't pin shipped task tables.
     cached = _COMPILE_CACHE.get(cache_key) if use_cache else None
+    first_call_gate = None
+    if cached is None and shared_cache is not None:
+        # stage-shared program: key on the caller's stage identity plus the
+        # input pytree structure and leaf shapes/dtypes (the only thing that
+        # can legitimately differ between same-stage tasks)
+        flat, treedef = jax.tree_util.tree_flatten(input_list)
+        sig = tuple(
+            (getattr(l, "shape", None), str(getattr(l, "dtype", type(l))))
+            for l in flat
+        )
+        skey = (shared_key, treedef, sig)
+        # get-or-create under a lock: same-stage tasks fan out on coordinator
+        # threads, and an unsynchronized check-then-act would have the first
+        # wave all miss and compile duplicates — the exact cost this cache
+        # removes. The creator also takes the entry's first-call gate so
+        # concurrent siblings wait for its trace+compile instead of racing
+        # jax's own dispatch into duplicate compiles.
+        with _SHARED_LOCK:
+            cached = shared_cache.get(skey)
+            if cached is None:
+                _SHARED_STATS["miss"] += 1
+                # entry cap: each entry's closure pins its creator task's
+                # decoded plan (incl. device tables) until the query slot's
+                # TTL/LRU turnover — a wide stage whose keys fragment
+                # (per-task dictionary identity, remainder shapes) must not
+                # retain one plan per task. Insertion-order eviction; an
+                # evicted program just recompiles on next use.
+                while len(shared_cache) >= _SHARED_ENTRY_CAP:
+                    shared_cache.pop(next(iter(shared_cache)))
+                cached = (
+                    jax.jit(run), overflow_box, metric_names,
+                    {"lock": threading.Lock(), "warmed": False},
+                )
+                shared_cache[skey] = cached
+            else:
+                _SHARED_STATS["hit"] += 1
+        first_call_gate = cached[3]
+        cached = cached[:3]
     if cached is None:
         if use_cache and len(_COMPILE_CACHE) >= _COMPILE_CACHE_MAX:
             _COMPILE_CACHE.clear()
@@ -671,7 +728,19 @@ def execute_plan(
         if use_cache:
             _COMPILE_CACHE[cache_key] = cached
     fn, overflow_box, metric_names = cached
-    out, flags, metric_vals = fn(inputs)
+    result = None
+    if first_call_gate is not None and not first_call_gate["warmed"]:
+        with first_call_gate["lock"]:
+            # double-check: threads that queued behind the creator must
+            # NOT execute under the gate (that would serialize the whole
+            # task wave) — only the creator's trace+compile+first-run is
+            # serialized; everyone else re-checks and runs concurrently
+            if not first_call_gate["warmed"]:
+                result = fn(input_list)
+                first_call_gate["warmed"] = True
+    if result is None:
+        result = fn(input_list)
+    out, flags, metric_vals = result
     flags = np.asarray(flags)  # one fetch for both sentinel checks
     any_overflow, any_precision = bool(flags[0]), bool(flags[1])
     if check_overflow and any_overflow:
@@ -698,6 +767,11 @@ def execute_plan(
 
 
 _COMPILE_CACHE: dict = {}
+# stage-shared program cache observability: hits = task executions that
+# reused another task's traced program (each hit ~= one XLA compile avoided)
+_SHARED_STATS = {"hit": 0, "miss": 0}
+_SHARED_LOCK = threading.Lock()
+_SHARED_ENTRY_CAP = 32  # per-query distinct (stage, shape-class) programs
 _COMPILE_CACHE_MAX = 512
 
 

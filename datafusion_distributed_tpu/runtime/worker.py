@@ -182,9 +182,7 @@ class Worker:
         self.version = version
         self.registry = TaskRegistry(
             ttl_seconds,
-            on_evict=lambda data: self.table_store.remove(
-                data.shipped_table_ids
-            ),
+            on_evict=self._on_task_evict,
         )
         self.on_plan = on_plan
         self.table_store = TableStore()
@@ -196,6 +194,98 @@ class Worker:
         # drop-driven invalidation (consumed once by task_progress)
         self._final_progress: dict[TaskKey, Optional[dict]] = {}
 
+    # stage-shared compiled programs (query_id -> (last_touch, execute_plan
+    # shared cache)): every task of a stage decodes its own plan copy, but
+    # the traced program is task-invariant (padded capacities make shapes
+    # uniform; task identity only selects host-side leaf data), so one
+    # compile serves all tasks — the single biggest host-tier cost at
+    # scale was N_tasks identical XLA compiles per stage. CLASS-level on
+    # purpose: co-hosted workers (InMemoryCluster, one process) then pay
+    # one compile per stage instead of one per worker; separate worker
+    # processes are unaffected. Retention is time/count-based, NOT
+    # registry-driven: the coordinator invalidates each task entry right
+    # after it executes, so "no registry entries for this query" happens
+    # transiently MID-query and must not destroy the cache (review r5).
+    # A query slot is dropped when untouched for _STAGE_COMPILE_TTL_S
+    # (compiled programs pin the first task's decoded plan incl. shipped
+    # tables — the TTL bounds that retention in time) or when the LRU cap
+    # pushes it out (bounds it in count on busy workers).
+    _stage_compiles: dict[str, tuple[float, dict]] = {}
+    _stage_compiles_lock = threading.Lock()
+    _STAGE_COMPILE_QUERY_CAP = 8
+    _STAGE_COMPILE_TTL_S = 600.0
+
+    def _on_task_evict(self, data: TaskData) -> None:
+        """Registry-exit hook (invalidate, TTL expiry, sweep): release the
+        task's shipped table slices."""
+        self.table_store.remove(data.shipped_table_ids)
+
+    @classmethod
+    def _sweep_stage_compiles_locked(cls, now: float) -> None:
+        """Drop query slots untouched for the TTL. Caller holds
+        `_stage_compiles_lock`."""
+        dead = [
+            q for q, (ts, _) in cls._stage_compiles.items()
+            if now - ts > cls._STAGE_COMPILE_TTL_S
+        ]
+        for q in dead:
+            del cls._stage_compiles[q]
+
+    def _stage_compile_cache(self, key: TaskKey, data: TaskData):
+        """(shared_cache, shared_key) for execute_plan, or (None, None) when
+        stage-sharing is unsafe: IsolatedArmExec bakes `task_index` into the
+        traced program (plan/exchanges.py assigned_task branch), a user
+        `on_plan` hook may rewrite plans per-task, and a CUSTOM plan node
+        (register_codec extension path) may read ``ctx.task.task_index``
+        inside ``_execute`` — undetectable from here, so any node class
+        outside this package disables sharing unless it declares
+        ``stage_shareable = True`` (meaning: its trace does not depend on
+        task identity).
+
+        Known limitation, not a safety issue: over the gRPC transport each
+        task's decode mints fresh ``Dictionary`` objects (pytree aux,
+        identity by dict_id), so string-bearing stages fragment the key and
+        miss; the in-process transport resolves shipped table ids to the
+        SAME store-held tables, where sharing fully engages."""
+        import os
+
+        if os.environ.get("DFTPU_STAGE_SHARE", "1") == "0":
+            return None, None
+        if self.on_plan is not None:
+            return None, None
+
+        def _unshareable(n) -> bool:
+            if getattr(n, "assigned_task", None) is not None:
+                return True
+            mod = type(n).__module__
+            return not (
+                mod == "datafusion_distributed_tpu"
+                or mod.startswith("datafusion_distributed_tpu.")
+            ) and not getattr(n, "stage_shareable", False)
+
+        if data.plan.collect(_unshareable):
+            return None, None
+        now = time.time()
+        with self._stage_compiles_lock:
+            self._sweep_stage_compiles_locked(now)
+            hit = self._stage_compiles.pop(key.query_id, None)
+            cache = hit[1] if hit is not None else None
+            if cache is None:
+                while len(self._stage_compiles) >= self._STAGE_COMPILE_QUERY_CAP:
+                    self._stage_compiles.pop(
+                        next(iter(self._stage_compiles))
+                    )
+                cache = {}
+            # re-insert at the end: pop+insert keeps dict order = LRU order
+            self._stage_compiles[key.query_id] = (now, cache)
+        shared_key = (
+            key.query_id,
+            key.stage_id,
+            data.task_count,
+            tuple(sorted((data.config or {}).items())),
+        )
+        return cache, shared_key
+
     # -- control plane ------------------------------------------------------
     def set_plan(self, key: TaskKey, plan_obj: dict, task_count: int,
                  config: Optional[dict] = None,
@@ -203,6 +293,12 @@ class Worker:
                  ttl: Optional[float] = None) -> None:
         if headers:
             validate_passthrough_headers(headers)
+        # idle-worker retention bound: stage-compile slots pin decoded
+        # plans (incl. store-held device tables); access-driven TTL alone
+        # never fires on a worker that stops executing, so sweep on the
+        # control-plane entry too
+        with self._stage_compiles_lock:
+            self._sweep_stage_compiles_locked(time.time())
         try:
             plan = decode_plan(plan_obj, self.table_store)
             if self.on_plan is not None:
@@ -237,6 +333,7 @@ class Worker:
             from datafusion_distributed_tpu.runtime.metrics import MetricsStore
 
             store = MetricsStore()
+            shared_cache, shared_key = self._stage_compile_cache(key, data)
             out = execute_plan(
                 data.plan,
                 DistributedTaskContext(key.task_number, data.task_count),
@@ -244,6 +341,8 @@ class Worker:
                 metrics_store=store,
                 task_label=f"task{key.task_number}",
                 use_cache=False,  # freshly decoded plans never hit the cache
+                shared_cache=shared_cache,
+                shared_key=shared_key,
             )
             data.metrics["nodes"] = store.per_task.get(
                 f"task{key.task_number}", {}

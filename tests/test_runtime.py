@@ -436,3 +436,69 @@ def test_overflow_retry_guard_budget(monkeypatch):
     monkeypatch.setenv("DFTPU_RETRY_BYTES_BUDGET", "not-a-number")
     with pytest.raises(RuntimeError, match="DFTPU_RETRY_BYTES_BUDGET"):
         _overflow_retry_guard(Fat(), 1, RuntimeError("hash table overflow"))
+
+
+def test_stage_shared_compiles_across_tasks():
+    """Tasks of one stage reuse ONE traced program (plan/physical.py
+    shared_cache): correctness is identical to per-task compiles and the
+    hit counter shows every task after the first per (stage, shape) class
+    skipped its XLA compile."""
+    from datafusion_distributed_tpu.plan import physical as phys
+
+    before = dict(phys._SHARED_STATS)
+    qids_before = set(Worker._stage_compiles)
+    try:
+        plan, arrow = sample_plan(n=4096, seed=3)
+        dplan = distribute_plan(plan, DistributedConfig(num_tasks=NT))
+        coord = _cluster(2)
+        out = coord.execute(dplan).to_pandas()
+        exp = (
+            arrow.to_pandas().groupby("k")
+            .agg(sv=("v", "sum"), n=("v", "size")).reset_index()
+            .sort_values("k").reset_index(drop=True)
+        )
+        # atol: a near-zero group sum (cancellation) has unbounded relative
+        # error at f32 accumulation precision
+        np.testing.assert_allclose(out["sv"], exp["sv"], rtol=FLOAT_RTOL,
+                                   atol=1e-3)
+        hits = phys._SHARED_STATS["hit"] - before["hit"]
+        misses = phys._SHARED_STATS["miss"] - before["miss"]
+        assert hits > 0, f"no shared-program hits (misses={misses})"
+        # co-hosted workers share the class-level cache: one compile per
+        # (stage, shape) class. Shape classes fragment (remainder-task leaf
+        # shapes, single-task stages), so demand only that a meaningful
+        # fraction of the multi-task stages' executions were compile-free.
+        assert hits >= NT - 1, f"hits={hits} misses={misses}"
+    finally:
+        # class-level cache: don't leave this query's pinned programs
+        # behind for the rest of the pytest process
+        with Worker._stage_compiles_lock:
+            for q in set(Worker._stage_compiles) - qids_before:
+                Worker._stage_compiles.pop(q, None)
+
+
+def test_stage_share_skipped_for_isolated_arms():
+    """IsolatedArmExec bakes task_index into the traced program
+    (plan/exchanges.py assigned_task branch) — such plans must bypass the
+    shared cache."""
+    from datafusion_distributed_tpu.plan.exchanges import IsolatedArmExec
+
+    import uuid
+
+    plan, arrow = sample_plan(n=512, seed=4)
+    t = arrow_to_table(arrow)
+    scan = MemoryScanExec([t], t.schema())
+    arm = IsolatedArmExec(scan, assigned_task=0)
+    w = Worker()
+    qid = uuid.uuid4().hex  # unique: _stage_compiles is class-level
+    try:
+        data = TaskData(key=TaskKey(qid, 0, 0), plan=arm, task_count=2)
+        cache, key = w._stage_compile_cache(data.key, data)
+        assert cache is None and key is None
+        # and a vanilla plan on the same worker does share
+        data2 = TaskData(key=TaskKey(qid, 1, 0), plan=scan, task_count=2)
+        cache2, key2 = w._stage_compile_cache(data2.key, data2)
+        assert cache2 is not None and key2 == (qid, 1, 2, ())
+    finally:
+        with Worker._stage_compiles_lock:
+            Worker._stage_compiles.pop(qid, None)
