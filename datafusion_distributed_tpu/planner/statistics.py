@@ -137,7 +137,11 @@ def estimate_rows(plan: ExecutionPlan, stats: Optional[PlanStatistics] = None) -
         p = estimate_rows(plan.probe, stats)
         if plan.join_type in ("semi", "anti"):
             return p / 2.0
-        return p
+        # expanding joins (many-to-many keys) emit more than probe rows;
+        # the planner's expansion_factor is the sizing hint for exactly
+        # that fanout — ignoring it here would systematically undercut
+        # row-estimate-capped hash sizing above such joins
+        return p * max(float(getattr(plan, "expansion_factor", 1.0)), 1.0)
     if isinstance(plan, CrossJoinExec):
         return estimate_rows(plan.left, stats) * estimate_rows(plan.right, stats)
     if isinstance(plan, UnionExec):

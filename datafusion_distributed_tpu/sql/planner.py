@@ -210,7 +210,8 @@ class PhysicalPlanner:
             groups_ndv = self._exprs_ndv(node.child,
                                          [e for e, _ in node.groups],
                                          loose=True)
-            slots = self._agg_slots(proj.output_capacity(), groups_ndv)
+            slots = self._agg_slots(proj.output_capacity(), groups_ndv,
+                                    child=proj)
             base_slots = 16 if not group_names else slots
             combined = HashAggregateExec(
                 "single", group_names, plain_specs, proj, base_slots
@@ -225,7 +226,7 @@ class PhysicalPlanner:
                 )
                 dedup = HashAggregateExec(
                     "single", group_names + [s.input_name], [], proj,
-                    self._agg_slots(proj.output_capacity(), dedup_ndv),
+                    self._agg_slots(proj.output_capacity(), dedup_ndv, child=proj),
                 )
                 cnt = HashAggregateExec(
                     "single", group_names,
@@ -261,15 +262,21 @@ class PhysicalPlanner:
                 [e for e, _ in node.groups] + [a.arg for a in node.aggs],
                 loose=True,
             )
-            slots = self._agg_slots(proj.output_capacity(), inner_ndv)
+            slots = self._agg_slots(proj.output_capacity(), inner_ndv,
+                                    child=proj)
             dedup = HashAggregateExec("single", inner_groups, [], proj, slots)
+            if inner_ndv:
+                # estimate_rows(dedup) would otherwise fall back to
+                # sqrt(n) and undersize the outer aggregate's by_est cap
+                dedup.est_rows = float(inner_ndv)
             outer_specs = [
                 AggSpec("count", s.input_name, s.output_name) for s in specs
             ]
             groups_ndv = self._exprs_ndv(node.child,
                                          [e for e, _ in node.groups],
                                          loose=True)
-            slots2 = self._agg_slots(dedup.output_capacity(), groups_ndv)
+            slots2 = self._agg_slots(dedup.output_capacity(), groups_ndv,
+                                     child=dedup)
             out = HashAggregateExec(
                 "single", group_names, outer_specs, dedup, slots2
             )
@@ -279,7 +286,8 @@ class PhysicalPlanner:
 
         groups_ndv = self._exprs_ndv(node.child, [e for e, _ in node.groups],
                                      loose=True)
-        slots = self._agg_slots(proj.output_capacity(), groups_ndv)
+        slots = self._agg_slots(proj.output_capacity(), groups_ndv,
+                                child=proj)
         out = HashAggregateExec("single", group_names, specs, proj, slots)
         if groups_ndv:
             # catalog NDV as the group-count estimate (replaces the cost
@@ -319,8 +327,10 @@ class PhysicalPlanner:
                 return max(1.0 - s, 1e-6) if pred.negated else s
         return None
 
-    def _agg_slots(self, cap: int, ndv: Optional[int] = None) -> int:
-        """Hash-table slots for a group-by: capacity-bounded, NDV-driven.
+    def _agg_slots(self, cap: int, ndv: Optional[int] = None,
+                   child=None) -> int:
+        """Hash-table slots for a group-by: capacity-bounded, NDV-driven,
+        row-estimate-capped.
 
         The reference sizes aggregation hash tables dynamically as groups
         arrive; with static shapes the table must be pre-sized, and sizing by
@@ -331,17 +341,36 @@ class PhysicalPlanner:
         short, and the session's overflow-retry loop (collect_table) widens
         by 4x if the estimate was low — the same optimistic-plan /
         revise-on-overflow posture as join capacities.
+
+        ``child`` (the agg's physical input) adds a third bound: groups
+        can never exceed input ROWS, and after selective filters/joins the
+        cardinality estimate is far below both the padded capacity and the
+        multi-key NDV product (q3's (orderkey, orderdate, shippriority)
+        NDV-product saturates while the filtered join feeds ~29k rows).
+        Row estimates are coarser than sampled NDV, so this bound gets 4x
+        headroom instead of 2x; an underestimate costs one overflow-retry.
         """
         by_cap = min(
             round_up_pow2(max(int(cap * self.config.agg_slot_factor), 16)),
             self.config.max_slots,
         )
+        best = by_cap
         if ndv:
             by_ndv = round_up_pow2(
                 max(int(ndv * self.config.agg_slot_factor * 2), 16)
             )
-            return min(by_cap, by_ndv)
-        return by_cap
+            best = min(best, by_ndv)
+        if child is not None:
+            from datafusion_distributed_tpu.planner.statistics import (
+                estimate_rows,
+            )
+
+            est = estimate_rows(child)
+            by_est = round_up_pow2(
+                max(int(est * self.config.agg_slot_factor * 4), 16)
+            )
+            best = min(best, by_est)
+        return best
 
     def _exprs_ndv(self, child: lg.LogicalPlan,
                    exprs: Sequence[pe.PhysicalExpr],
@@ -498,7 +527,8 @@ class PhysicalPlanner:
     def _distinct(self, child: ExecutionPlan) -> ExecutionPlan:
         names = child.schema().names
         return HashAggregateExec(
-            "single", names, [], child, self._agg_slots(child.output_capacity())
+            "single", names, [], child,
+            self._agg_slots(child.output_capacity(), child=child),
         )
 
     # -- join -----------------------------------------------------------------------
