@@ -1,0 +1,29 @@
+"""Host-environment helpers shared by the test suite and benchmark runners.
+
+XLA:CPU's persistent compile cache stores AOT executables whose code paths
+assume the COMPILING host's CPU features, while jax's cache key does not
+include them — loading an entry compiled on a different physical CPU warns
+"could lead to execution errors such as SIGILL" and sporadically delivers
+exactly that. Environments that land on heterogeneous machines (this VM
+does) must therefore fingerprint the cache directory per CPU so a migration
+misses the cache instead of executing foreign machine code.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+
+def cpu_fingerprint() -> str:
+    """Short stable id of the host CPU's feature set (x86: the
+    /proc/cpuinfo flags line; elsewhere the platform processor string)."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            flags = next(
+                (line for line in f if line.startswith("flags")), ""
+            )
+    except OSError:
+        import platform
+
+        flags = platform.processor()
+    return hashlib.sha1(flags.encode()).hexdigest()[:12]

@@ -34,22 +34,18 @@ from datafusion_distributed_tpu.plan.physical import (
 
 AXIS = "tasks"
 
-# Context manager that toggles the compilation cache around one invocation
-# (see the workaround at the call site). Private jax API — guarded so a jax
-# upgrade that moves it degrades to "no workaround" loudly here, once, instead
-# of breaking all distributed execution at call time.
-try:
-    from jax._src.config import enable_compilation_cache as _disable_compile_cache
-except ImportError:  # pragma: no cover - depends on jax version
-    _disable_compile_cache = None
-    import warnings
-
-    warnings.warn(
-        "jax._src.config.enable_compilation_cache unavailable; multi-device "
-        "executables will hit the persistent compile cache (fine if this jax "
-        "version serializes them without aborting)",
-        stacklevel=1,
-    )
+# History: an earlier round wrapped the invocation below in
+# `enable_compilation_cache(False)` against an observed XLA CHECK abort
+# serializing multi-device executables. Re-verified on this image (jax
+# 0.9, 8-device virtual mesh, real TPC-H mesh programs): serialization,
+# cache write, AND fresh-process reload all work (q1 mesh 21 s -> 4.4 s
+# on reload), and the toggle never actually suppressed writes on this
+# jax version anyway (is_cache_used is memoized per process). The abort
+# matches the process-age XLA:CPU heap corruption root-caused in
+# run_tests.sh — aged processes crash in the cache-write serializer among
+# other places — so tests/conftest.py still skips multi-device cache
+# WRITES in suite processes; normal (young) processes cache freely,
+# which is what lets a persistent-cache sweep skip mesh recompiles.
 
 # Re-executing the SAME plan object on the same mesh reuses the compiled
 # SPMD program (the reference's cached TaskData plan re-execution analogue).
@@ -170,18 +166,7 @@ def execute_on_mesh(
         cached = (fn, overflow_names, metric_names)
         _MESH_COMPILE_CACHE[cache_key] = cached
     fn, overflow_names, metric_names = cached
-    # The persistent compilation cache aborts the process trying to
-    # serialize multi-device executables on the CPU backend (XLA CHECK
-    # failure in put_executable_and_time, observed jax 0.9 / 8-device
-    # virtual mesh); single-device programs serialize fine. EVERY call may
-    # recompile (jax.jit retraces on new input shapes), so the cache is
-    # disabled around the invocation itself, not just the first call.
-    if _disable_compile_cache is not None:
-        with _disable_compile_cache(False):
-            out, any_overflow, any_precision, mvec = fn(stacked_inputs)
-    else:  # private API moved: run uncached-workaround-less (cache may
-        # simply be off globally, or a newer jax fixed the serialization)
-        out, any_overflow, any_precision, mvec = fn(stacked_inputs)
+    out, any_overflow, any_precision, mvec = fn(stacked_inputs)
     if check_overflow and bool(any_overflow):
         raise RuntimeError(
             f"exchange/hash capacity overflow on mesh (nodes: "

@@ -199,17 +199,10 @@ def execute_stage_span_on_mesh(
         run, mesh=mesh, in_specs=(in_specs,),
         out_specs=(P(AXIS), P(AXIS)), check_rep=False,
     )
-    # same workaround as execute_on_mesh: the persistent compile cache
-    # aborts serializing multi-device CPU executables
-    from datafusion_distributed_tpu.runtime.mesh_executor import (
-        _disable_compile_cache,
-    )
-
-    if _disable_compile_cache is not None:
-        with _disable_compile_cache(False):
-            out_stacked, flags = jax.jit(fn)(stacked)
-    else:  # pragma: no cover - jax moved the private API
-        out_stacked, flags = jax.jit(fn)(stacked)
+    # multi-device executables cache fine (see the serialization note in
+    # mesh_executor.py — the old disable-around-invocation workaround was
+    # removed after re-verification)
+    out_stacked, flags = jax.jit(fn)(stacked)
     flags = np.asarray(flags)  # [W, F]
     if flags.size:
         cap = [

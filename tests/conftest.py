@@ -48,18 +48,14 @@ jax.config.update("jax_platforms", "cpu")
 
 
 def _cpu_fingerprint() -> str:
-    import hashlib
+    # package import is safe at this point: jax_platforms is already pinned
+    # to cpu above, and DFTPU_COMPILE_CACHE is unset under tests, so the
+    # package __init__'s env-sensitive blocks are no-ops here (sweep_sf.py
+    # must spec-load instead — it sets the cache env var AFTER needing the
+    # fingerprint, and __init__ reads that var exactly once)
+    from datafusion_distributed_tpu.hostenv import cpu_fingerprint
 
-    try:
-        with open("/proc/cpuinfo") as f:
-            flags = next(
-                (line for line in f if line.startswith("flags")), ""
-            )
-    except OSError:
-        import platform
-
-        flags = platform.processor()
-    return hashlib.sha1(flags.encode()).hexdigest()[:12]
+    return cpu_fingerprint()
 
 
 _test_cache = os.environ.get(
