@@ -31,23 +31,27 @@ if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "")
         os.environ.get("XLA_FLAGS", "")
         + " --xla_force_host_platform_device_count=8"
     ).strip()
+# spec-load the shared host-env helper: a package import HERE would run
+# __init__ before DFTPU_COMPILE_CACHE below exists, and __init__ reads
+# that env var exactly once
+import importlib.util as _ilu
+
+_spec = _ilu.spec_from_file_location(
+    "_dftpu_hostenv",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "datafusion_distributed_tpu", "hostenv.py"),
+)
+_hostenv = _ilu.module_from_spec(_spec)
+_spec.loader.exec_module(_hostenv)
+
+# single-core box: give mesh collectives starvation headroom (see helper)
+_hostenv.ensure_collective_timeout_flags()
 
 # Persistent compile cache so a resumed/restarted sweep skips recompiling
 # the same 66+ stage/mesh programs (mesh q1 reload: 21 s -> 4.4 s).
 # Fingerprinted per CPU like tests/conftest.py: XLA:CPU AOT entries embed
 # host machine features, and loading them on a different host risks SIGILL.
 if "DFTPU_COMPILE_CACHE" not in os.environ:
-    # spec-load: a package import HERE would run __init__ before the env
-    # var below exists, and __init__ reads it exactly once
-    import importlib.util as _ilu
-
-    _spec = _ilu.spec_from_file_location(
-        "_dftpu_hostenv",
-        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                     "datafusion_distributed_tpu", "hostenv.py"),
-    )
-    _hostenv = _ilu.module_from_spec(_spec)
-    _spec.loader.exec_module(_hostenv)
     os.environ["DFTPU_COMPILE_CACHE"] = os.path.join(
         os.path.expanduser("~"), ".cache",
         f"dftpu_sweep_xla_{_hostenv.cpu_fingerprint()}",

@@ -46,6 +46,23 @@ _COMMENT_WORDS = (
     "platelets pinto beans sleep haggle nag use wake cajole detect integrate"
 ).split()
 
+# P_NAME is a concatenation of color words in the TPC-H spec; queries
+# FILTER on them (q9 `like '%green%'`, q20 `like 'forest%'`), so a name
+# pool without colors makes those queries vacuously return 0 rows — a
+# parity check that can never fail. Subset of the spec's color list.
+_COLOR_WORDS = (
+    "almond antique aquamarine azure beige bisque black blanched blue "
+    "blush brown burlywood burnished chartreuse chiffon chocolate coral "
+    "cornflower cornsilk cream cyan dark deep dim dodger drab firebrick "
+    "floral forest frosted gainsboro ghost goldenrod green grey honeydew "
+    "hot indian ivory khaki lace lavender lawn lemon light lime linen "
+    "magenta maroon medium metallic midnight mint misty moccasin navajo "
+    "navy olive orange orchid pale papaya peach peru pink plum powder "
+    "puff purple red rose rosy royal saddle salmon sandy seashell sienna "
+    "sky slate smoke snow spring steel tan thistle tomato turquoise "
+    "violet wheat white yellow"
+).split()
+
 _EPOCH_1992 = 8035  # days 1970-01-01 -> 1992-01-01
 _EPOCH_1998_AUG2 = 10440  # last possible o_orderdate (1998-08-02)
 
@@ -163,7 +180,17 @@ def gen_tpch(sf: float = 0.01, seed: int = 0) -> dict:
     part = pa.table(
         {
             "p_partkey": np.arange(1, n_part + 1, dtype=np.int64),
-            "p_name": _comments(rng, n_part, 5),
+            # spec shape: five space-joined color words (q9/q20 filter on
+            # these; see _COLOR_WORDS)
+            "p_name": np.array(
+                [
+                    " ".join(row)
+                    for row in np.array(_COLOR_WORDS, dtype=object)[
+                        rng.integers(0, len(_COLOR_WORDS), (n_part, 5))
+                    ]
+                ],
+                dtype=object,
+            ),
             "p_mfgr": np.array(
                 [f"Manufacturer#{m}" for m in brand_m], dtype=object
             ),

@@ -12,6 +12,31 @@ misses the cache instead of executing foreign machine code.
 from __future__ import annotations
 
 import hashlib
+import os
+
+
+def ensure_collective_timeout_flags(warn_stuck_s: int = 120,
+                                    terminate_s: int = 1200) -> None:
+    """Append XLA:CPU collective-timeout flags to XLA_FLAGS unless the
+    caller already set them (each flag guarded by its own name, so a
+    user-supplied value for one is never clobbered by the other's
+    default). Must run before the first jax backend init.
+
+    Why: 8 virtual devices time-share this box's single core; inside a
+    large mesh program one participant thread can legitimately be starved
+    past XLA:CPU's default 40 s collective rendezvous termination
+    timeout, which F-aborts the whole process mid-collective (observed:
+    all_gather rendezvous abort in the SF0.5 sweep's mesh tier)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    for flag, val in (
+        ("--xla_cpu_collective_call_warn_stuck_timeout_seconds",
+         warn_stuck_s),
+        ("--xla_cpu_collective_call_terminate_timeout_seconds",
+         terminate_s),
+    ):
+        if flag not in flags:
+            flags = f"{flags} {flag}={val}"
+    os.environ["XLA_FLAGS"] = flags.strip()
 
 
 def cpu_fingerprint() -> str:

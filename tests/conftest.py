@@ -17,8 +17,18 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# single-core box: give mesh collectives starvation headroom (shared
+# helper; package import is safe here — JAX_PLATFORMS=cpu is already
+# exported above, and the package __init__'s env-sensitive blocks are
+# no-ops without DFTPU_COMPILE_CACHE; flags must land before the first
+# backend init, which no package module triggers at import time)
+from datafusion_distributed_tpu.hostenv import (  # noqa: E402
+    ensure_collective_timeout_flags,
+)
+
+ensure_collective_timeout_flags()
 
 import jax  # noqa: E402
 

@@ -108,3 +108,14 @@ def test_bucket_counts_match_numpy():
     offsets, indices, counts = native.shuffle_buckets(h, None, 5)
     exp = np.bincount(h % 5, minlength=5)
     np.testing.assert_array_equal(counts, exp)
+
+
+def test_cpu_fingerprint_stable():
+    """hostenv.cpu_fingerprint: stable within a host, short, hex (cache
+    directories derive from it — drift would orphan caches)."""
+    from datafusion_distributed_tpu.hostenv import cpu_fingerprint
+
+    a, b = cpu_fingerprint(), cpu_fingerprint()
+    assert a == b
+    assert len(a) == 12
+    int(a, 16)  # hex
