@@ -896,10 +896,33 @@ class AdaptiveCoordinator(Coordinator):
     #: overflowing capacity
     resize_headroom: float = 2.0
 
+    #: multiplier applied to resize_headroom per overflow (and per pinned
+    #: retry attempt — both schedules MUST share this constant)
+    OVERFLOW_WIDEN_FACTOR = 4.0
+
     def __post_init__(self):
         # remember the CONSTRUCTED value: the post-query reset must restore
         # a caller-configured headroom, not clobber it with the class default
         self._base_resize_headroom = self.resize_headroom
+        self._headroom_pinned = False
+
+    def pin_overflow_headroom(self, attempt: int) -> None:
+        """Widen the resize headroom for retry ``attempt`` of one query and
+        PIN it: scalar subqueries execute through this same coordinator and
+        their success must not reset the outer query's widened headroom to
+        base mid-attempt (q11's HAVING subquery did exactly that, so the
+        overflowing group-by re-ran at base headroom on every retry).
+        Callers release with release_overflow_headroom() when the query's
+        retry loop ends."""
+        self.resize_headroom = (
+            self._base_resize_headroom
+            * (self.OVERFLOW_WIDEN_FACTOR ** attempt)
+        )
+        self._headroom_pinned = True
+
+    def release_overflow_headroom(self) -> None:
+        self._headroom_pinned = False
+        self.resize_headroom = self._base_resize_headroom
 
     def execute(self, plan: ExecutionPlan) -> Table:
         self._load_info: dict[int, object] = {}
@@ -937,11 +960,13 @@ class AdaptiveCoordinator(Coordinator):
             out = super().execute(plan)
         except RuntimeError as e:
             if "overflow" in str(e):
-                self.resize_headroom *= 4
+                self.resize_headroom *= self.OVERFLOW_WIDEN_FACTOR
             raise
         # success: back to the constructed value so one query's widening does
-        # not permanently inflate every later query on this coordinator
-        self.resize_headroom = self._base_resize_headroom
+        # not permanently inflate every later query on this coordinator —
+        # UNLESS a retry loop pinned the headroom (pin_overflow_headroom)
+        if not self._headroom_pinned:
+            self.resize_headroom = self._base_resize_headroom
         return out
 
     def _partition_streams_enabled(self, exchange) -> bool:
@@ -984,10 +1009,13 @@ class AdaptiveCoordinator(Coordinator):
         pred_rows = int(rows * total / done * self.extrapolation_headroom)
         sampler = getattr(self, "_col_samplers", {}).get(stage_id)
         if sampler is not None and sampler.sampled > 0:
-            # freeze WITH the mid-stream column statistics: observed NDV
-            # is a lower bound (resize headroom + overflow-retry absorb
-            # the undercount), null fractions and velocity ride along
-            info = sampler.load_info(pred_rows, width)
+            # freeze WITH the mid-stream column statistics; NDV is
+            # extrapolated by producer coverage (hash-partitioned outputs
+            # carry disjoint key values, so done/total of the producers
+            # have seen ~done/total of the distinct values), null
+            # fractions and velocity ride along
+            info = sampler.load_info(pred_rows, width,
+                                     ndv_scale=total / done)
         else:
             info = LoadInfo(rows=pred_rows, bytes=pred_rows * width)
         self._predicted[stage_id] = info

@@ -460,27 +460,38 @@ class DataFrame:
         pcfg = self.ctx.config.planner
         dcfg = self._seeded_host_config(num_tasks)
         last_err: Optional[Exception] = None
-        for _attempt in range(self.ctx.config.overflow_retries + 1):
-            try:
-                plan = self.distributed_plan(
-                    num_tasks, dcfg, pcfg, coordinator=coordinator
-                )
-                _overflow_retry_guard(plan, _attempt, last_err)
-                out = coordinator.execute(plan)
-                self.last_retry_count = _attempt
-                return out
-            except RuntimeError as e:
-                if isinstance(e, OverflowRetryAbandoned):
-                    raise
-                if "overflow" not in str(e):
-                    raise
-                last_err = e
-                pcfg, dcfg = _widen_for_overflow(
-                    pcfg, dcfg, e,
-                    force_all=_attempt
-                    >= self.ctx.config.overflow_retries - 1,
-                )
-        raise last_err  # type: ignore[misc]
+        adaptive_coord = hasattr(coordinator, "pin_overflow_headroom")
+        try:
+            for _attempt in range(self.ctx.config.overflow_retries + 1):
+                if adaptive_coord and _attempt:
+                    # widen-and-pin for the retry (see
+                    # AdaptiveCoordinator.pin_overflow_headroom: subquery
+                    # successes through the same coordinator must not reset
+                    # the widened headroom mid-attempt)
+                    coordinator.pin_overflow_headroom(_attempt)
+                try:
+                    plan = self.distributed_plan(
+                        num_tasks, dcfg, pcfg, coordinator=coordinator
+                    )
+                    _overflow_retry_guard(plan, _attempt, last_err)
+                    out = coordinator.execute(plan)
+                    self.last_retry_count = _attempt
+                    return out
+                except RuntimeError as e:
+                    if isinstance(e, OverflowRetryAbandoned):
+                        raise
+                    if "overflow" not in str(e):
+                        raise
+                    last_err = e
+                    pcfg, dcfg = _widen_for_overflow(
+                        pcfg, dcfg, e,
+                        force_all=_attempt
+                        >= self.ctx.config.overflow_retries - 1,
+                    )
+            raise last_err  # type: ignore[misc]
+        finally:
+            if adaptive_coord:
+                coordinator.release_overflow_headroom()
 
     def collect_coordinated(self, **kw):
         return table_to_arrow(

@@ -280,9 +280,12 @@ def test_midstream_column_loadinfo():
     infos = [i for i in coord._predicted.values() if i.ndv]
     assert infos, "predicted LoadInfo carried no per-column statistics"
     info = infos[0]
-    # the partial-agg producer's group column (__g0 internally) has the
-    # 64 distinct keys; accumulator NDVs ride along
-    assert any(1 <= v <= 64 for v in info.ndv.values()), info.ndv
+    # frozen NDVs are coverage-EXTRAPOLATED upper bounds (observed x
+    # total/done, clamped by predicted rows): the 64-distinct-key group
+    # column must estimate >= what was observed and never exceed rows
+    assert any(v >= 1 for v in info.ndv.values()), info.ndv
+    assert all(v <= max(info.rows, 1) for v in info.ndv.values()), (
+        info.ndv, info.rows)
     assert info.null_frac, "no null fractions sampled"
     assert info.rows_per_s > 0 and info.bytes_per_s > 0
 
@@ -352,3 +355,37 @@ def test_targeted_overflow_widening():
     assert p7.agg_slot_factor == p.agg_slot_factor * 4
     assert p7.join_expansion_factor == p.join_expansion_factor * 4
     assert d7.shuffle_skew_factor == d.shuffle_skew_factor * 4
+
+
+def test_pinned_headroom_survives_inner_success():
+    """Scalar subqueries execute through the SAME coordinator as the outer
+    query; a successful inner execute must NOT reset a session-pinned
+    (overflow-retry-widened) resize headroom back to base — that reset made
+    q11's overflowing group-by re-run at base headroom on every retry."""
+    import pyarrow as pa
+
+    from datafusion_distributed_tpu.sql.context import SessionContext
+
+    rng = np.random.default_rng(3)
+    ctx = SessionContext()
+    ctx.register_arrow("t", pa.table({
+        "k": rng.integers(0, 8, 2000), "v": rng.normal(size=2000),
+    }))
+    ctx.config.distributed_options["bytes_per_task"] = 1
+    df = ctx.sql("select k, sum(v) s from t group by k")
+    cluster = InMemoryCluster(2)
+    coord = AdaptiveCoordinator(resolver=cluster, channels=cluster)
+    plan = df.distributed_plan(4, coordinator=coord)
+
+    coord.pin_overflow_headroom(attempt=2)
+    pinned = coord.resize_headroom
+    assert pinned == coord._base_resize_headroom * (
+        coord.OVERFLOW_WIDEN_FACTOR ** 2
+    )
+    out = coord.execute(plan)
+    assert out.num_rows == 8
+    assert coord.resize_headroom == pinned, "pin was reset by a success"
+
+    coord.release_overflow_headroom()
+    coord.execute(plan)
+    assert coord.resize_headroom == coord._base_resize_headroom
