@@ -1,0 +1,48 @@
+"""One-shot TPU tunnel probe (round 5). Named .tpu_probe* so bench.py's
+stale-holder cleanup terminates it if it is somehow still alive when the
+driver's bench starts. Writes status lines to .tpu_probe.r5.json."""
+import json
+import os
+import time
+
+OUT = "/root/repo/.tpu_probe.r5.json"
+
+
+def log(**kw):
+    kw["ts"] = round(time.time(), 1)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(kw) + "\n")
+
+
+os.environ["JAX_PLATFORMS"] = "axon"
+log(event="init_start")
+t0 = time.time()
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "axon")
+    devs = jax.devices()
+    log(event="init_ok", seconds=round(time.time() - t0, 1),
+        devices=[str(d) for d in devs])
+    # tiny smoke op + serialization capability check
+    import jax.numpy as jnp
+    import numpy as np
+
+    f = jax.jit(lambda x: (jnp.sin(x) @ jnp.ones((256, 256))).sum())
+    t1 = time.time()
+    v = float(f(np.ones((8, 256), np.float32)))
+    log(event="smoke_ok", seconds=round(time.time() - t1, 1), value=v)
+    # can the compiled executable be serialized? (decides whether a
+    # persistent compile cache can ever help the driver's bench)
+    try:
+        lowered = jax.jit(lambda x: jnp.cos(x).sum()).lower(
+            np.ones((4, 4), np.float32))
+        compiled = lowered.compile()
+        from jax._src.compilation_cache import compress_executable  # noqa
+        ser = compiled.runtime_executable().serialize()
+        log(event="serialize_ok", nbytes=len(ser))
+    except Exception as e:  # noqa: BLE001
+        log(event="serialize_fail", error=f"{type(e).__name__}: {e}"[:300])
+except Exception as e:  # noqa: BLE001
+    log(event="init_fail", seconds=round(time.time() - t0, 1),
+        error=f"{type(e).__name__}: {e}"[:300])

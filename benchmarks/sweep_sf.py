@@ -264,6 +264,23 @@ def main() -> int:
                 log(tier=tier, query=q, ok=False,
                     error=f"{type(e).__name__}: {e}"[:300],
                     seconds=round(time.perf_counter() - t, 2))
+        # Aged-process guard #2: compiled executables accumulate per
+        # process (jax's jit caches plus this repo's program caches) and
+        # after ~2 h of SF0.5 queries the address space exhausts — observed
+        # as 32-128 MiB allocation failures on late queries. Dropping every
+        # compiled-program cache between queries bounds the growth;
+        # recompiles for later queries reload from the persistent cache.
+        from datafusion_distributed_tpu.plan import physical as _phys
+        from datafusion_distributed_tpu.runtime import (
+            mesh_executor as _me,
+            worker as _w,
+        )
+
+        _phys._COMPILE_CACHE.clear()
+        with _w.Worker._stage_compiles_lock:
+            _w.Worker._stage_compiles.clear()
+        _me._MESH_COMPILE_CACHE.clear()
+        jax.clear_caches()
     log(stage="done")
     return 0
 

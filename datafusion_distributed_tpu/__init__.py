@@ -83,6 +83,28 @@ from datafusion_distributed_tpu.ops.table import (  # noqa: E402
     Table,
 )
 
+def clear_compile_caches() -> None:
+    """Drop every compiled-program cache this package (and jax) holds.
+
+    Long multi-query processes accumulate compiled executables — jax's jit
+    caches plus this package's program caches — until the address space
+    exhausts (observed: 32-128 MiB allocation failures after ~2 h of SF0.5
+    queries). Call between queries in long-lived batch processes; later
+    queries recompile, reloading from the persistent compile cache when one
+    is configured."""
+    from datafusion_distributed_tpu.plan import physical as _phys
+    from datafusion_distributed_tpu.runtime import (
+        mesh_executor as _me,
+        worker as _w,
+    )
+
+    _phys._COMPILE_CACHE.clear()
+    with _w.Worker._stage_compiles_lock:
+        _w.Worker._stage_compiles.clear()
+    _me._MESH_COMPILE_CACHE.clear()
+    _jax.clear_caches()
+
+
 __version__ = "0.1.0"
 
 __all__ = [
