@@ -62,29 +62,14 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
-# Aged-process guard: this image's XLA:CPU corrupts its heap after a few
-# hundred in-process compiles, and the cache-WRITE serializer is a known
-# crash site (root-caused in run_tests.sh; tests/conftest.py guards suite
-# processes the same way). The sweep is one long-lived process, so stop
-# persisting new entries after a write budget — early entries still land,
-# and each restart caches the next slice of NEW programs (already-cached
-# ones load without aging the writer), converging over a few resumes.
-_WRITE_BUDGET = int(os.environ.get("DFTPU_SWEEP_CACHE_WRITES", "150"))
-try:
-    from jax._src import compilation_cache as _cc
-
-    _orig_put = _cc.put_executable_and_time
-    _writes = [0]
-
-    def _budgeted_put(*a, **kw):
-        _writes[0] += 1
-        if _writes[0] > _WRITE_BUDGET:
-            return None
-        return _orig_put(*a, **kw)
-
-    _cc.put_executable_and_time = _budgeted_put
-except Exception:  # pragma: no cover - private API drift: run unguarded
-    pass
+# Aged-process guard: the cache-WRITE budget now lives in the package
+# (__init__.py, behind DFTPU_COMPILE_CACHE_WRITES) so every long-lived
+# process is protected; the sweep just opts in before the package import
+# below. DFTPU_SWEEP_CACHE_WRITES kept as the sweep-specific alias.
+os.environ.setdefault(
+    "DFTPU_COMPILE_CACHE_WRITES",
+    os.environ.get("DFTPU_SWEEP_CACHE_WRITES", "150"),
+)
 
 QUERIES_DIR = "/root/reference/testdata/tpch/queries"
 
@@ -270,17 +255,9 @@ def main() -> int:
         # as 32-128 MiB allocation failures on late queries. Dropping every
         # compiled-program cache between queries bounds the growth;
         # recompiles for later queries reload from the persistent cache.
-        from datafusion_distributed_tpu.plan import physical as _phys
-        from datafusion_distributed_tpu.runtime import (
-            mesh_executor as _me,
-            worker as _w,
-        )
+        import datafusion_distributed_tpu as _dftpu
 
-        _phys._COMPILE_CACHE.clear()
-        with _w.Worker._stage_compiles_lock:
-            _w.Worker._stage_compiles.clear()
-        _me._MESH_COMPILE_CACHE.clear()
-        jax.clear_caches()
+        _dftpu.clear_compile_caches()
     log(stage="done")
     return 0
 

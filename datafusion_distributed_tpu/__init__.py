@@ -58,6 +58,42 @@ if _cache_dir and _cache_dir != "0":
     except Exception:  # pragma: no cover - private-API drift guard
         pass
 
+    # Cache-WRITE budget (DFTPU_COMPILE_CACHE_WRITES=<n>, opt-in): this
+    # image's XLA:CPU corrupts its heap after a few hundred in-process
+    # compiles and the persistent-cache WRITE serializer is a known crash
+    # site (root-caused in run_tests.sh round 5). Long-lived processes that
+    # opt into the persistent cache can therefore stop persisting NEW
+    # entries after a budget: early entries still land, already-cached
+    # programs load without aging the writer, and each restart caches the
+    # next slice — converging over a few runs. Lives here (next to the
+    # DFTPU_COMPILE_CACHE handling) so EVERY long-lived process is
+    # protected, not just benchmarks/sweep_sf.py.
+    _write_budget_raw = _os.environ.get("DFTPU_COMPILE_CACHE_WRITES")
+    if _write_budget_raw is not None and _write_budget_raw != "":
+        try:
+            _write_budget = int(_write_budget_raw)
+        except ValueError:
+            _write_budget = None  # malformed: leave the writer unguarded
+        # 0 means "persist NOTHING" (full protection from the crash-prone
+        # write serializer), not "no guard" — reads still hit a pre-warmed
+        # cache either way
+        if _write_budget is not None and _write_budget >= 0:
+            try:
+                from jax._src import compilation_cache as _cc_wb
+
+                _orig_put = _cc_wb.put_executable_and_time
+                _writes = [0]
+
+                def _budgeted_put(*a, **kw):
+                    _writes[0] += 1
+                    if _writes[0] > _write_budget:
+                        return None
+                    return _orig_put(*a, **kw)
+
+                _cc_wb.put_executable_and_time = _budgeted_put
+            except Exception:  # pragma: no cover - private API drift
+                pass
+
 # Honor JAX_PLATFORMS when a platform plugin force-selected itself at
 # registration time (the environment's TPU-tunnel plugin sets
 # jax_platforms="axon,cpu", shadowing the env var). Only correct the

@@ -98,9 +98,11 @@ def window_compute(
     )
     new_order = new_order | new_part
 
-    seg_start = jnp.maximum.accumulate(jnp.where(new_part, idx, 0))
+    # lax.cummax, not jnp.maximum.accumulate: ufunc methods are jax 0.5+
+    # and this must run on 0.4.x jaxlibs too
+    seg_start = jax.lax.cummax(jnp.where(new_part, idx, 0), axis=0)
     rn = idx - seg_start  # 0-based row_number within partition
-    rank0 = jnp.maximum.accumulate(jnp.where(new_order, idx, 0)) - seg_start
+    rank0 = jax.lax.cummax(jnp.where(new_order, idx, 0), axis=0) - seg_start
     dense_cum = jnp.cumsum(new_order.astype(DataType.INT64.np_dtype))
     dense0 = dense_cum - dense_cum[seg_start]
 

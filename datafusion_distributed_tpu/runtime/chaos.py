@@ -1,0 +1,254 @@
+"""Deterministic fault injection for the host-runtime tier.
+
+A seeded `FaultPlan` wraps a cluster's workers (`wrap_cluster` /
+`ChaosWorker`) and injects faults at the coordinator-visible call sites:
+
+  set_plan   crash-on-ship (dispatch failures)
+  execute    crash-mid-execute / transient transport errors / slow-worker
+             delays, applied uniformly to execute_task,
+             execute_task_stream and execute_task_partitions
+
+PER-CALL decisions are DETERMINISTIC and thread-order independent: each
+(site, stage, task, nth-call) tuple hashes with the seed to a unit float
+compared against the spec's rate, so an uncapped schedule replays
+identically under the same seed regardless of how the stage fan-out's
+threads interleave. Per-stage / total caps (`max_per_stage`, `max_total`)
+bound how many faults fire — `FaultSpec(site="execute", rate=1.0,
+max_per_stage=1)` is the canonical "one worker crash per stage" schedule
+of tests/test_fault_tolerance.py. Caveat: a cap slot is consumed in call
+ARRIVAL order, so capped schedules keep their fire COUNT deterministic at
+rate=1.0 but may attribute a fault to a different (task, worker) across
+runs when sibling tasks race for the slot; assertions on a capped
+schedule should target results/counters, not which task was hit (the
+suite's determinism test uses uncapped specs for exactly this reason).
+
+This mirrors what Zerrow (arXiv:2504.06151) treats as part of pipeline
+correctness: failure paths — including buffer cleanup after a failed
+attempt — are exercised on purpose, not discovered in production.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from datafusion_distributed_tpu.runtime.errors import (
+    TransportError,
+    WorkerError,
+    WorkerUnavailableError,
+)
+
+#: injection sites a FaultSpec may name
+SITES = ("set_plan", "execute")
+
+
+@dataclass
+class FaultSpec:
+    """One fault family: where, what, how often, and bounds."""
+
+    site: str  # "set_plan" | "execute"
+    kind: str = "crash"  # "crash" | "transport" | "delay"
+    rate: float = 1.0  # per-call probability (seed-hashed, deterministic)
+    delay_s: float = 0.0  # for kind="delay": injected latency
+    #: restrict to these worker urls (substring match); None = any worker
+    workers: Optional[Sequence[str]] = None
+    #: restrict to these stage ids; None = any stage
+    stages: Optional[Sequence[int]] = None
+    #: restrict to these task numbers; None = any task
+    tasks: Optional[Sequence[int]] = None
+    #: at most this many fires per stage (None = unbounded)
+    max_per_stage: Optional[int] = None
+    #: at most this many fires total (None = unbounded)
+    max_total: Optional[int] = None
+
+    def __post_init__(self):
+        if self.site not in SITES:
+            raise ValueError(
+                f"unknown fault site {self.site!r} (expected one of {SITES})"
+            )
+
+    def _matches(self, site: str, url: str, stage_id: int,
+                 task_number: int) -> bool:
+        if site != self.site:
+            return False
+        if self.workers is not None and not any(
+            w in url for w in self.workers
+        ):
+            return False
+        if self.stages is not None and stage_id not in self.stages:
+            return False
+        if self.tasks is not None and task_number not in self.tasks:
+            return False
+        return True
+
+
+class FaultPlan:
+    """Seeded, thread-safe fault schedule shared by a cluster's
+    ChaosWorkers. `fired` records every injected fault (site, url, stage,
+    task, kind) — tests assert against it, and a failure report quoting it
+    plus the seed reproduces the schedule."""
+
+    def __init__(self, seed: int, specs: Sequence[FaultSpec]):
+        self.seed = int(seed)
+        self.specs = list(specs)
+        self.fired: list[dict] = []
+        self._lock = threading.Lock()
+        #: (spec_idx, site, stage, task) -> call count (the nth-call input
+        #: of the hash, so repeated attempts of one task re-roll)
+        self._calls: dict[tuple, int] = {}
+        self._per_stage: dict[tuple, int] = {}
+        self._totals: dict[int, int] = {}
+
+    def _unit(self, spec_idx: int, site: str, stage_id: int,
+              task_number: int, nth: int) -> float:
+        h = hashlib.sha256(
+            f"{self.seed}:{spec_idx}:{site}:{stage_id}:"
+            f"{task_number}:{nth}".encode()
+        ).digest()
+        return int.from_bytes(h[:8], "big") / float(1 << 64)
+
+    def decide(self, site: str, url: str, key) -> Optional[FaultSpec]:
+        """The fault (if any) to inject for this call. At most one spec
+        fires per call (first declared wins)."""
+        stage_id = getattr(key, "stage_id", -1)
+        task_number = getattr(key, "task_number", 0)
+        with self._lock:
+            for i, spec in enumerate(self.specs):
+                if not spec._matches(site, url, stage_id, task_number):
+                    continue
+                ck = (i, site, stage_id, task_number)
+                nth = self._calls.get(ck, 0)
+                self._calls[ck] = nth + 1
+                if spec.max_total is not None and (
+                    self._totals.get(i, 0) >= spec.max_total
+                ):
+                    continue
+                sk = (i, stage_id)
+                if spec.max_per_stage is not None and (
+                    self._per_stage.get(sk, 0) >= spec.max_per_stage
+                ):
+                    continue
+                if self._unit(i, site, stage_id, task_number,
+                              nth) >= spec.rate:
+                    continue
+                self._totals[i] = self._totals.get(i, 0) + 1
+                self._per_stage[sk] = self._per_stage.get(sk, 0) + 1
+                self.fired.append({
+                    "site": site, "url": url, "stage_id": stage_id,
+                    "task_number": task_number, "kind": spec.kind,
+                    "nth_call": nth,
+                })
+                return spec
+        return None
+
+
+def _raise_for(spec: FaultSpec, site: str, url: str, key) -> None:
+    if spec.kind == "crash":
+        raise WorkerUnavailableError(
+            f"[chaos] injected worker crash at {site}",
+            worker_url=url, task=key,
+        )
+    if spec.kind == "transport":
+        raise TransportError(
+            f"[chaos] injected transient transport error at {site}",
+            worker_url=url, task=key,
+        )
+    raise WorkerError(
+        f"[chaos] unknown fault kind {spec.kind!r}",
+        worker_url=url, task=key,
+    )
+
+
+class ChaosWorker:
+    """Fault-injecting proxy around a Worker (or any duck-typed worker
+    client): intercepts the coordinator-visible call sites, delegates
+    everything else untouched. `kind="delay"` sleeps then delegates —
+    paired with `SET distributed.task_timeout_s` it exercises the
+    hung-worker -> TaskTimeoutError conversion."""
+
+    def __init__(self, inner, plan: FaultPlan):
+        self._inner = inner
+        self._plan = plan
+
+    # -- intercepted control plane ------------------------------------------
+    def set_plan(self, key, plan_obj, task_count, **kw):
+        spec = self._plan.decide("set_plan", self.url, key)
+        if spec is not None:
+            if spec.kind == "delay":
+                time.sleep(spec.delay_s)
+            else:
+                _raise_for(spec, "set_plan", self.url, key)
+        return self._inner.set_plan(key, plan_obj, task_count, **kw)
+
+    # -- intercepted data plane ---------------------------------------------
+    def _execute_fault(self, key):
+        spec = self._plan.decide("execute", self.url, key)
+        if spec is not None:
+            if spec.kind == "delay":
+                time.sleep(spec.delay_s)
+            else:
+                _raise_for(spec, "execute", self.url, key)
+
+    def execute_task(self, key):
+        # deliberately NO timeout= parameter: advertising one would make
+        # the coordinator delegate deadline enforcement to the inner
+        # worker, which cannot see this proxy's injected delay — the
+        # coordinator's thread deadline must cover the whole (faulty) call
+        self._execute_fault(key)
+        return self._inner.execute_task(key)
+
+    def execute_task_stream(self, key, **kw):
+        # inject at CALL time, not first-iteration: the coordinator's
+        # retry-while-nothing-yielded window must see the fault before
+        # any chunk is out
+        self._execute_fault(key)
+        return self._inner.execute_task_stream(key, **kw)
+
+    def execute_task_partitions(self, key, *a, **kw):
+        self._execute_fault(key)
+        return self._inner.execute_task_partitions(key, *a, **kw)
+
+    # -- transparent delegation ---------------------------------------------
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+@dataclass
+class ChaosCluster:
+    """Resolver+channels facade over a real cluster, handing out
+    ChaosWorker proxies. The inner workers' PEER channels stay unwrapped
+    (peer pulls model worker<->worker links; this harness injects at the
+    coordinator<->worker boundary)."""
+
+    inner: "object"
+    plan: FaultPlan
+    _proxies: dict = field(default_factory=dict)
+
+    def get_urls(self) -> list[str]:
+        return self.inner.get_urls()
+
+    def get_worker(self, url: str) -> ChaosWorker:
+        if url not in self._proxies:
+            self._proxies[url] = ChaosWorker(
+                self.inner.get_worker(url), self.plan
+            )
+        return self._proxies[url]
+
+
+def wrap_cluster(cluster, plan: FaultPlan) -> ChaosCluster:
+    """Wrap any resolver+channels cluster (InMemoryCluster, GrpcCluster)
+    in the fault-injection harness."""
+    return ChaosCluster(cluster, plan)
+
+
+def one_crash_per_stage(seed: int, kind: str = "crash",
+                        max_total: Optional[int] = None) -> FaultPlan:
+    """The canonical acceptance schedule: the first task dispatch of every
+    stage hits one injected fault, forcing a retry+reroute per stage."""
+    return FaultPlan(seed, [
+        FaultSpec(site="execute", kind=kind, rate=1.0, max_per_stage=1,
+                  max_total=max_total),
+    ])

@@ -20,6 +20,7 @@ of `Stage::Local -> Stage::Remote`.
 
 from __future__ import annotations
 
+import threading
 import uuid
 from dataclasses import dataclass, field
 from typing import Callable, Optional, Sequence
@@ -43,7 +44,61 @@ from datafusion_distributed_tpu.plan.physical import (
     MemoryScanExec,
 )
 from datafusion_distributed_tpu.runtime.codec import TableStore, encode_plan
-from datafusion_distributed_tpu.runtime.worker import TaskKey, Worker
+from datafusion_distributed_tpu.runtime.errors import (
+    TaskTimeoutError,
+    WorkerError,
+    WorkerUnavailableError,
+    is_retryable,
+)
+from datafusion_distributed_tpu.runtime.metrics import FaultCounters
+from datafusion_distributed_tpu.runtime.worker import (
+    TaskKey,
+    Worker,
+    call_with_deadline,
+)
+
+
+#: fault-tolerance knobs and their defaults, settable per session via
+#: `SET distributed.<knob> = <value>` (sql/context.py plumbs
+#: distributed_options into Coordinator.config_options). Timeouts of 0
+#: mean "no deadline". task_timeout_s bounds one ATTEMPT: on the bulk
+#: plane that is execution + result transfer (gRPC wire deadlines span
+#: the whole call), on the streaming planes it is the wait for the FIRST
+#: chunk (which contains the execution; later chunks slice an already-
+#: materialized output) — size it for the slowest legitimate task
+#: including its result, not just its compute.
+FAULT_TOLERANCE_DEFAULTS = {
+    "max_task_retries": 2,
+    "task_retry_backoff_s": 0.05,
+    "task_timeout_s": 0.0,
+    "dispatch_timeout_s": 0.0,
+    "quarantine_threshold": 3,
+    "quarantine_seconds": 30.0,
+}
+
+
+def _terminal(exc: WorkerError) -> WorkerError:
+    """Mark an instance of a retryable class as NOT retryable (cluster-wide
+    conditions like 'no healthy workers' that no re-dispatch can fix)."""
+    exc.retryable = False
+    return exc
+
+
+#: serializes lazy HealthTracker creation: stage fan-out threads may record
+#: their first failures concurrently, and a lost race would drop a failure
+#: on an orphan tracker (threshold-1 quarantines silently missed)
+_HEALTH_INIT_LOCK = threading.Lock()
+
+
+class _RetryState:
+    """Per-task retry bookkeeping: attempt count + the urls of workers
+    whose attempts already failed (the re-dispatch routes around them)."""
+
+    __slots__ = ("attempt", "excluded")
+
+    def __init__(self) -> None:
+        self.attempt = 0
+        self.excluded: set[str] = set()
 
 
 class WorkerResolver:
@@ -100,6 +155,12 @@ class Coordinator:
     expected_version: Optional[str] = None
     # per-task execute-latency sketch, mergeable across queries
     latency: "object" = None
+    # worker circuit breakers (runtime/health.py), created on first failure
+    # and persistent across queries on this coordinator — a worker
+    # quarantined by one query stays routed-around for the next
+    health: "object" = None
+    # retry/quarantine/timeout counters (runtime/metrics.py FaultCounters)
+    faults: FaultCounters = field(default_factory=FaultCounters)
 
     def execute(self, plan: ExecutionPlan) -> Table:
         """Run a distributed plan (exchange-staged) across the workers and
@@ -354,7 +415,7 @@ class Coordinator:
         peer_ttl = float(self.config_options.get("peer_task_ttl", 3600.0))
         producers = []  # (key_obj, url)
         for i in range(t_prod):
-            worker, key, plan_obj, _store = self._dispatch_task(
+            worker, key, plan_obj, _store = self._dispatch_task_with_retry(
                 prepared, query_id, stage_id, i, t_prod, ttl=peer_ttl
             )
             self._peer_shipped.append((worker, key))
@@ -436,20 +497,19 @@ class Coordinator:
         prepared = self._prepare_stage_plan(producer)
 
         def make_puller(task_number: int):
+            def body(worker, key, cancel):
+                for p, piece, est in worker.execute_task_partitions(
+                    key, exchange.key_names, t_cons, 0, t_cons,
+                    per_dest_capacity=exchange.per_dest_capacity,
+                    chunk_rows=chunk_rows, cancel=cancel,
+                ):
+                    yield (p, piece), est
+
             def pull(cancel):
-                worker, key, plan_obj, store = self._dispatch_task(
-                    prepared, query_id, stage_id, task_number, t_prod
+                yield from self._pull_task_with_retry(
+                    prepared, query_id, stage_id, task_number, t_prod,
+                    body, cancel,
                 )
-                try:
-                    for p, piece, est in worker.execute_task_partitions(
-                        key, exchange.key_names, t_cons, 0, t_cons,
-                        per_dest_capacity=exchange.per_dest_capacity,
-                        chunk_rows=chunk_rows, cancel=cancel,
-                    ):
-                        yield (p, piece), est
-                    self._record_task_progress(worker, key)
-                finally:
-                    self._cleanup_task(worker, key, plan_obj, store)
 
             return pull
 
@@ -584,31 +644,30 @@ class Coordinator:
         prepared = self._prepare_stage_plan(producer)
 
         def make_puller(task_number: int):
-            def pull(cancel):
-                worker, key, plan_obj, store = self._dispatch_task(
-                    prepared, query_id, stage_id, task_number, t_prod
-                )
-                try:
-                    if hasattr(worker, "execute_task_stream"):
-                        yield from worker.execute_task_stream(
-                            key, chunk_rows=chunk_rows, cancel=cancel
-                        )
-                    else:  # transport without a streaming surface
-                        from datafusion_distributed_tpu.planner.statistics import (  # noqa: E501
-                            row_width,
-                        )
+            def body(worker, key, cancel):
+                if hasattr(worker, "execute_task_stream"):
+                    yield from worker.execute_task_stream(
+                        key, chunk_rows=chunk_rows, cancel=cancel
+                    )
+                else:  # transport without a streaming surface
+                    from datafusion_distributed_tpu.planner.statistics import (  # noqa: E501
+                        row_width,
+                    )
 
-                        out = worker.execute_task(key)
-                        width = row_width(out.schema())
-                        n = int(out.num_rows)
-                        for lo in range(0, max(n, 1), chunk_rows):
-                            if cancel.is_set():
-                                return
-                            c = min(chunk_rows, n - lo)
-                            yield out.slice_rows(lo, c), c * width
-                    self._record_task_progress(worker, key)
-                finally:
-                    self._cleanup_task(worker, key, plan_obj, store)
+                    out = worker.execute_task(key)
+                    width = row_width(out.schema())
+                    n = int(out.num_rows)
+                    for lo in range(0, max(n, 1), chunk_rows):
+                        if cancel.is_set():
+                            return
+                        c = min(chunk_rows, n - lo)
+                        yield out.slice_rows(lo, c), c * width
+
+            def pull(cancel):
+                yield from self._pull_task_with_retry(
+                    prepared, query_id, stage_id, task_number, t_prod,
+                    body, cancel,
+                )
 
             return pull
 
@@ -709,15 +768,281 @@ class Coordinator:
         task_count: int,
     ) -> Table:
         stage_plan = self._prepare_stage_plan(stage_plan)
-        worker, key, plan_obj, store = self._dispatch_task(
-            stage_plan, query_id, stage_id, task_number, task_count
+        state = _RetryState()
+        kt = (query_id, stage_id, task_number)
+        while True:
+            worker, key, plan_obj, store = self._dispatch_task_with_retry(
+                stage_plan, query_id, stage_id, task_number, task_count,
+                state=state,
+            )
+            try:
+                try:
+                    out = self._execute_with_deadline(worker, key)
+                    # metrics are best-effort: a flaky progress RPC after
+                    # a SUCCESSFUL execute must not discard the result,
+                    # re-run the task, or count against the worker
+                    try:
+                        self._record_task_progress(worker, key)
+                    except Exception:
+                        pass
+                finally:
+                    # best-effort: with the result in hand a cleanup
+                    # hiccup must not discard it (or re-execute the
+                    # task), and on the failure path it must not MASK
+                    # the execute error; cleanup is local-only ops
+                    try:
+                        self._cleanup_task(worker, key, plan_obj, store)
+                    except Exception:
+                        pass
+            except BaseException as e:
+                # attribute the failure to the worker the ERROR names when
+                # it names one (a dead peer PRODUCER failing a consumer's
+                # pull must not quarantine the healthy consumer)
+                if self._handle_task_failure(
+                    e, getattr(e, "worker_url", "") or worker.url, kt, state
+                ):
+                    continue
+                raise
+            self._record_worker_success(worker.url)
+            return out
+
+    # -- fault tolerance -----------------------------------------------------
+    def _execute_with_deadline(self, worker, key) -> Table:
+        """Bulk-plane execute under the per-task deadline (`SET
+        distributed.task_timeout_s`). Workers whose execute_task accepts a
+        ``timeout`` get NATIVE enforcement — the gRPC client turns it into
+        a wire deadline that cancels the stream server-side instead of
+        leaking an open RPC per abandoned attempt. Workers without the
+        parameter (MeshWorker, user duck-types) fall back to the
+        coordinator-side thread deadline, which works against any
+        transport but can only abandon, not cancel."""
+        timeout = self._opt_float("task_timeout_s")
+        if not timeout:
+            return worker.execute_task(key)
+        if self._worker_accepts_timeout(worker):
+            return worker.execute_task(key, timeout=timeout)
+        return call_with_deadline(
+            lambda: worker.execute_task(key), timeout, worker.url, key
         )
+
+    def _worker_accepts_timeout(self, worker,
+                                method: str = "execute_task") -> bool:
+        """Whether this worker type's ``method`` takes an EXPLICIT
+        ``timeout=`` (cached per (type, method) — signature inspection is
+        not free per task). A bare ``**kwargs`` deliberately does NOT
+        count: a forwarding wrapper could swallow the kwarg without
+        enforcing anything, silently disabling the deadline — such
+        workers get the coordinator-side thread deadline (execute) or no
+        deadline (dispatch) instead of a TypeError."""
+        cache = getattr(self, "_timeout_sig_cache", None)
+        if cache is None:
+            cache = self._timeout_sig_cache = {}
+        ck = (type(worker), method)
+        hit = cache.get(ck)
+        if hit is None:
+            import inspect
+
+            try:
+                params = inspect.signature(
+                    getattr(worker, method)
+                ).parameters
+                hit = "timeout" in params
+            except (TypeError, ValueError, AttributeError):
+                hit = False
+            cache[ck] = hit
+        return hit
+
+    def _opt_float(self, name: str) -> float:
+        default = FAULT_TOLERANCE_DEFAULTS.get(name, 0.0)
         try:
-            out = worker.execute_task(key)
-            self._record_task_progress(worker, key)
-        finally:
-            self._cleanup_task(worker, key, plan_obj, store)
-        return out
+            return float(self.config_options.get(name, default) or 0.0)
+        except (TypeError, ValueError):
+            return float(default)
+
+    def _opt_int(self, name: str) -> int:
+        default = FAULT_TOLERANCE_DEFAULTS.get(name, 0)
+        try:
+            return int(self.config_options.get(name, default))
+        except (TypeError, ValueError):
+            return int(default)
+
+    def _health_tracker(self):
+        if self.health is None:
+            from datafusion_distributed_tpu.runtime.health import (
+                HealthPolicy,
+                HealthTracker,
+            )
+
+            with _HEALTH_INIT_LOCK:
+                if self.health is None:  # double-checked: fan-out threads
+                    self.health = HealthTracker(HealthPolicy(
+                        failure_threshold=self._opt_int(
+                            "quarantine_threshold"
+                        ),
+                        quarantine_seconds=self._opt_float(
+                            "quarantine_seconds"
+                        ),
+                    ))
+        return self.health
+
+    def _record_worker_failure(self, url: str) -> None:
+        if url and self._health_tracker().record_failure(url):
+            self.faults.bump("workers_quarantined")
+
+    def _record_worker_success(self, url: str) -> None:
+        if self.health is not None and url:
+            self.health.record_success(url)
+
+    def _handle_task_failure(self, exc, url, key_tuple, state) -> bool:
+        """Record + classify a failed task attempt; True -> caller retries.
+
+        Retry only the retryable taxonomy (TransportError /
+        WorkerUnavailableError / TaskTimeoutError — runtime/errors.py):
+        query-semantic failures are deterministic and re-executing them
+        N more times would just burn the cluster before surfacing the
+        SAME error. Each retried attempt excludes the workers that
+        already failed this task, so the re-dispatch reroutes (the
+        excluded-runner idea); exclusion falls away when it would leave
+        no candidate (single-worker clusters retry in place).
+
+        Only RETRYABLE (infrastructure) errors count toward quarantine:
+        a query-semantic failure would raise identically on any worker,
+        and tripping breakers on it would punish healthy endpoints."""
+        if not is_retryable(exc):
+            if isinstance(exc, WorkerError):
+                self.faults.bump("fatal_failures")
+            return False
+        if url:
+            self._record_worker_failure(url)
+        if getattr(self, "_mesh_span_width", 0):
+            # span (mesh) dispatch shares one shipped plan across sibling
+            # tasks; re-dispatching a lone task elsewhere is undefined
+            return False
+        if state.attempt >= self._opt_int("max_task_retries"):
+            self.faults.bump("retries_exhausted")
+            return False
+        if isinstance(exc, TaskTimeoutError):
+            self.faults.bump("task_timeouts")
+        self.faults.bump("task_retries")
+        if url:
+            state.excluded.add(url)
+        self._retry_backoff(key_tuple, state.attempt)
+        state.attempt += 1
+        return True
+
+    def _retry_backoff(self, key_tuple, attempt: int) -> None:
+        """Exponential backoff with DETERMINISTIC jitter: the jitter is a
+        hash of (task identity, attempt), so a replayed failure schedule
+        sleeps identically — fault-injection runs stay reproducible while
+        concurrent retries still de-synchronize."""
+        base = self._opt_float("task_retry_backoff_s")
+        if base <= 0:
+            return
+        import time as _time
+        import zlib as _zlib
+
+        jitter = _zlib.crc32(
+            repr((key_tuple, attempt)).encode()
+        ) / 0xFFFFFFFF
+        _time.sleep(base * (2.0 ** attempt) + base * jitter)
+
+    def _dispatch_task_with_retry(self, stage_plan, query_id, stage_id,
+                                  task_number, task_count, ttl=None,
+                                  state=None):
+        """Dispatch with retry + reroute. Standalone (peer-plane producers:
+        ship now, execute at first pull) or as the shared dispatch phase of
+        the execute/pull retry loops — ``state`` threads ONE attempt budget
+        across both phases of a task."""
+        state = state if state is not None else _RetryState()
+        kt = (query_id, stage_id, task_number)
+        while True:
+            try:
+                disp = self._dispatch_task(
+                    stage_plan, query_id, stage_id, task_number, task_count,
+                    ttl=ttl, exclude=state.excluded,
+                )
+            except BaseException as e:
+                if self._handle_task_failure(
+                    e, getattr(e, "worker_url", "") or "", kt, state
+                ):
+                    continue
+                raise
+            if state.attempt and disp[0].url not in state.excluded:
+                self.faults.bump("tasks_rerouted")
+            return disp
+
+    def _pull_task_with_retry(self, stage_plan, query_id, stage_id,
+                              task_number, task_count, body, cancel,
+                              ttl=None):
+        """Streaming-plane analogue of `_run_stage_task`'s retry loop:
+        dispatch + run ``body(worker, key, cancel)`` (a chunk iterator),
+        re-dispatching on retryable failures for as long as NOTHING has
+        been yielded yet. Once a chunk is out, a replayed stream could
+        double rows downstream, so mid-stream failures stay fatal.
+
+        The execution deadline (`task_timeout_s`) covers the wait for the
+        FIRST chunk — that wait contains the task's actual execution (the
+        output materializes before any chunk can stream), so a hung worker
+        converts into the retryable TaskTimeoutError here too; later
+        chunks slice an already-materialized output and stream without
+        per-chunk deadline overhead."""
+        timeout = self._opt_float("task_timeout_s")
+        state = _RetryState()
+        kt = (query_id, stage_id, task_number)
+        done = object()  # first-chunk sentinel: body produced nothing
+        while True:
+            worker, key, plan_obj, store = self._dispatch_task_with_retry(
+                stage_plan, query_id, stage_id, task_number, task_count,
+                ttl=ttl, state=state,
+            )
+            yielded = False
+            try:
+                try:
+                    it = iter(body(worker, key, cancel))
+                    if timeout:
+                        first = call_with_deadline(
+                            lambda: next(it, done), timeout, worker.url, key
+                        )
+                    else:
+                        first = next(it, done)
+                    if first is not done:
+                        yielded = True
+                        yield first
+                        for item in it:
+                            yield item
+                    # best-effort, as in _run_stage_task: a flaky metrics
+                    # read must not fail a fully-streamed task
+                    try:
+                        self._record_task_progress(worker, key)
+                    except Exception:
+                        pass
+                finally:
+                    # best-effort for the same reason as _run_stage_task:
+                    # never discard streamed chunks or mask the real error
+                    try:
+                        self._cleanup_task(worker, key, plan_obj, store)
+                    except Exception:
+                        pass
+            except GeneratorExit:
+                # the consumer abandoned the stream (satisfied LIMIT /
+                # sibling failure cancellation) — not a worker fault:
+                # cleanup already ran in the finally; just unwind
+                raise
+            except BaseException as e:
+                if cancel is not None and cancel.is_set():
+                    # the stream was cancelled (satisfied LIMIT or a
+                    # sibling's fatal error): teardown-induced failures
+                    # are not worker faults and the output is already
+                    # being discarded — no backoff, no health record,
+                    # no re-dispatch
+                    return
+                if not yielded and self._handle_task_failure(
+                    e, getattr(e, "worker_url", "") or worker.url, kt, state
+                ):
+                    continue
+                raise
+            self._record_worker_success(worker.url)
+            return
 
     # -- shared task dispatch (bulk + streaming planes) ----------------------
     def _prepare_stage_plan(self, stage_plan: ExecutionPlan) -> ExecutionPlan:
@@ -725,16 +1050,44 @@ class Coordinator:
         AdaptiveCoordinator resizes capacities from exact input stats)."""
         return stage_plan
 
+    def _routable_urls(self, exclude=None) -> list[str]:
+        """Candidate worker urls for a dispatch: quarantined workers (open
+        circuit, runtime/health.py) are routed around, and a retry's
+        ``exclude`` set steers the re-dispatch away from workers that
+        already failed this task. Exclusion is best-effort — when it would
+        leave no candidate (single-worker cluster), the excluded workers
+        come back; quarantine is not — with every circuit open the query
+        fails rather than hammer known-bad endpoints."""
+        urls = self.resolver.get_urls()
+        if not urls:
+            raise _terminal(WorkerUnavailableError("cluster has no workers"))
+        if self.health is not None:
+            healthy = self.health.route_filter(urls)
+            if not healthy:
+                # terminal (instance-level retryable=False): retrying
+                # cannot conjure a healthy worker — the query fails NOW
+                # instead of spinning through the whole retry budget
+                raise _terminal(WorkerUnavailableError(
+                    f"no healthy workers remain ({len(urls)} quarantined)"
+                ))
+            urls = healthy
+        if exclude:
+            candidates = [u for u in urls if u not in exclude]
+            if candidates:
+                urls = candidates
+        return urls
+
     def _dispatch_task(self, stage_plan, query_id, stage_id, task_number,
-                       task_count, ttl=None):
+                       task_count, ttl=None, exclude=None):
         """Route, task-specialize, ship: -> (worker, key, plan_obj, store).
         ``ttl`` overrides the worker registry's idle-TTL for this entry
-        (peer producers live until pulled or swept)."""
+        (peer producers live until pulled or swept). ``exclude``: urls a
+        retry must route around (the failed attempts' workers)."""
         disp = self._try_dispatch_span(stage_plan, query_id, stage_id,
                                        task_number, task_count, ttl=ttl)
         if disp is not None:
             return disp
-        urls = self.resolver.get_urls()
+        urls = self._routable_urls(exclude)
         if self.route_tasks is not None:
             url = self.route_tasks(query_id, stage_id, task_number, urls)
         else:
@@ -745,11 +1098,20 @@ class Coordinator:
         plan_obj = encode_plan(
             _task_specialized(stage_plan, task_number), store
         )
+        ship_kw = {}
+        dispatch_timeout = self._opt_float("dispatch_timeout_s")
+        if dispatch_timeout and self._worker_accepts_timeout(
+            worker, "set_plan"
+        ):
+            # pass only when configured AND the surface declares it:
+            # custom duck-typed workers predating the deadline parameter
+            # keep working (no deadline) instead of dying on a TypeError
+            ship_kw["timeout"] = dispatch_timeout
         try:
             worker.set_plan(key, plan_obj, task_count,
                             config=self.config_options,
                             headers=self.passthrough_headers,
-                            ttl=ttl)
+                            ttl=ttl, **ship_kw)
         except BaseException:
             # a failed ship leaves no registry entry to own the staged
             # slices — release them here or they leak until process exit

@@ -6,6 +6,16 @@ a worker's failure surfaces verbatim at the coordinator. The host-runtime
 analogue: every worker exception is wrapped in a WorkerError carrying the
 worker url, task key, original type and traceback; `to_dict`/`from_dict`
 round-trip it over any transport.
+
+Retryable/fatal taxonomy: the coordinator's fault-tolerant execution layer
+(retry + reroute + quarantine, `runtime/coordinator.py`) acts on the ERROR
+CLASS, so the class must survive the wire. Infrastructure failures —
+transport faults, unreachable/crashed workers, blown deadlines — are
+``retryable = True`` subclasses: re-running the same deterministic task on
+another worker can succeed. Query-semantic failures (planning errors, an
+operator raising on the data itself) stay plain `WorkerError`/`QueryError`
+and fail fast: re-executing them burns cluster time to hit the identical
+exception N more times.
 """
 
 from __future__ import annotations
@@ -23,7 +33,14 @@ class PlanningError(QueryError):
 
 
 class WorkerError(QueryError):
-    """An error that happened on (or is attributed to) a worker."""
+    """An error that happened on (or is attributed to) a worker.
+
+    ``retryable`` is a CLASS property: subclasses representing transient
+    infrastructure faults override it to True; query-semantic errors keep
+    False so a deterministic failure surfaces on the first attempt.
+    """
+
+    retryable = False
 
     def __init__(
         self,
@@ -54,6 +71,9 @@ class WorkerError(QueryError):
             "task": [t.query_id, t.stage_id, t.task_number] if t else None,
             "original_type": self.original_type,
             "original_traceback": self.original_traceback,
+            # the retry/quarantine decision is taken coordinator-side from
+            # the CLASS, so it must cross the wire with the error
+            "error_class": type(self).__name__,
         }
 
     @staticmethod
@@ -61,7 +81,8 @@ class WorkerError(QueryError):
         from datafusion_distributed_tpu.runtime.worker import TaskKey
 
         task = TaskKey(*o["task"]) if o.get("task") else None
-        return WorkerError(
+        cls = _WIRE_CLASSES.get(o.get("error_class", ""), WorkerError)
+        return cls(
             o["message"],
             worker_url=o.get("worker_url", ""),
             task=task,
@@ -70,7 +91,54 @@ class WorkerError(QueryError):
         )
 
 
+class TransportError(WorkerError):
+    """A transient wire/transport failure (connection reset, stream broken,
+    frame decode): the task itself may be fine — re-dispatching it is safe
+    and usually succeeds."""
+
+    retryable = True
+
+
+class WorkerUnavailableError(WorkerError):
+    """The worker cannot be reached or has crashed/restarted (the gRPC
+    UNAVAILABLE status; a dead in-memory worker in tests). Retry on a
+    DIFFERENT worker; repeated occurrences quarantine the endpoint."""
+
+    retryable = True
+
+
+class TaskTimeoutError(WorkerError):
+    """A dispatch or execution deadline elapsed: a hung worker converts into
+    this instead of wedging the whole pool. Retryable — the task reroutes
+    while the stuck attempt is abandoned."""
+
+    retryable = True
+
+
+#: wire-name -> class, for from_dict reconstruction. Unknown names (an older
+#: peer, a user subclass) degrade to plain WorkerError — fail-fast, never
+#: spuriously retryable.
+_WIRE_CLASSES: dict[str, type] = {
+    c.__name__: c
+    for c in (WorkerError, TransportError, WorkerUnavailableError,
+              TaskTimeoutError)
+}
+
+
+def is_retryable(exc: BaseException) -> bool:
+    """Whether the fault-tolerant executor may re-dispatch after ``exc``."""
+    return bool(getattr(exc, "retryable", False))
+
+
 def wrap_worker_exception(e: Exception, worker_url: str, task) -> WorkerError:
+    if isinstance(e, WorkerError):
+        # already structured: preserve the (possibly retryable) class and
+        # its attribution instead of laundering it into a fatal wrapper
+        if not e.worker_url:
+            e.worker_url = worker_url
+        if e.task is None:
+            e.task = task
+        return e
     return WorkerError(
         str(e),
         worker_url=worker_url,

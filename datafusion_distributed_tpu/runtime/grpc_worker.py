@@ -36,12 +36,41 @@ from datafusion_distributed_tpu.runtime.codec import (
     encode_table,
 )
 from datafusion_distributed_tpu.runtime.errors import (
+    TaskTimeoutError,
+    TransportError,
     WorkerError,
+    WorkerUnavailableError,
     wrap_worker_exception,
 )
 from datafusion_distributed_tpu.runtime.worker import TaskKey, Worker
 
 _SERVICE = "dftpu.Worker"
+
+
+def _map_rpc_error(e, url: str, key=None) -> WorkerError:
+    """gRPC status -> the retryable/fatal taxonomy (runtime/errors.py):
+    DEADLINE_EXCEEDED is a blown deadline, UNAVAILABLE an unreachable or
+    crashed endpoint, everything else a transport fault — all retryable, so
+    the coordinator reroutes instead of failing the query on a flaky link.
+    Errors the SERVER classified ride the E-frame payload, not gRPC status,
+    and never reach this mapping."""
+    import grpc
+
+    code = e.code() if isinstance(e, grpc.RpcError) else None
+    detail = None
+    try:
+        detail = e.details()
+    except Exception:
+        pass
+    msg = f"rpc {code.name if code else type(e).__name__}: {detail or e}"
+    if code == grpc.StatusCode.DEADLINE_EXCEEDED:
+        cls = TaskTimeoutError
+    elif code == grpc.StatusCode.UNAVAILABLE:
+        cls = WorkerUnavailableError
+    else:
+        cls = TransportError
+    return cls(msg, worker_url=url, task=key,
+               original_type=type(e).__name__)
 
 
 def _key_to_obj(key: TaskKey) -> list:
@@ -259,13 +288,19 @@ class GrpcWorkerClient:
         self._shipped_ids: dict[TaskKey, list] = {}
         self._progress_cache: dict[TaskKey, Optional[dict]] = {}
 
-    def _call(self, method: str, payload: dict) -> dict:
+    def _call(self, method: str, payload: dict,
+              timeout: Optional[float] = None) -> dict:
+        import grpc
+
         rpc = self._channel.unary_unary(
             f"/{_SERVICE}/{method}",
             request_serializer=None,
             response_deserializer=None,
         )
-        resp = rpc(json.dumps(payload).encode())
+        try:
+            resp = rpc(json.dumps(payload).encode(), timeout=timeout)
+        except grpc.RpcError as e:
+            raise _map_rpc_error(e, self.url) from e
         msg = json.loads(resp.decode())
         if "error" in msg:
             raise WorkerError.from_dict(msg["error"])
@@ -274,7 +309,12 @@ class GrpcWorkerClient:
     def set_plan(self, key: TaskKey, plan_obj: dict, task_count: int,
                  config: Optional[dict] = None,
                  headers: Optional[dict] = None,
-                 ttl: Optional[float] = None) -> None:
+                 ttl: Optional[float] = None,
+                 timeout: Optional[float] = None) -> None:
+        """``timeout``: dispatch deadline, enforced by gRPC itself;
+        DEADLINE_EXCEEDED surfaces as the retryable TaskTimeoutError."""
+        import grpc
+
         tids = collect_table_ids(plan_obj)
         blobs = {
             tid: encode_table(self.table_store.get(tid)) for tid in tids
@@ -296,13 +336,35 @@ class GrpcWorkerClient:
             f"/{_SERVICE}/SetPlan",
             request_serializer=None, response_deserializer=None,
         )
-        msg = json.loads(rpc(frame).decode())
+        try:
+            msg = json.loads(rpc(frame, timeout=timeout).decode())
+        except grpc.RpcError as e:
+            # the ship may or may not have landed server-side; drop the
+            # local copies either way (a retry re-encodes) and let the
+            # retryable mapped error drive rerouting. Best-effort
+            # Invalidate: a deadline-abandoned server handler may still
+            # register the entry, pinning decoded slices on the struggling
+            # worker until the TTL sweep — narrow the window (the sweep
+            # remains the backstop for registrations landing after this)
+            self._shipped_ids.pop(key, None)
+            self.table_store.remove(tids)
+            try:
+                self._call("Invalidate", {"key": _key_to_obj(key)},
+                           timeout=5.0)
+            except Exception:
+                pass
+            raise _map_rpc_error(e, self.url, key) from e
         if "error" in msg:
+            self._shipped_ids.pop(key, None)
+            self.table_store.remove(tids)
             raise WorkerError.from_dict(msg["error"])
         # local copies served their purpose once serialized
         self.table_store.remove(tids)
 
-    def execute_task(self, key: TaskKey) -> Table:
+    def execute_task(self, key: TaskKey,
+                     timeout: Optional[float] = None) -> Table:
+        import grpc
+
         rpc = self._channel.unary_stream(
             f"/{_SERVICE}/ExecuteTask",
             request_serializer=None, response_deserializer=None,
@@ -313,7 +375,7 @@ class GrpcWorkerClient:
             "compression": self.compression,
             "chunk_bytes": self.chunk_bytes,
         }).encode()
-        stream = rpc(req)
+        stream = rpc(req, timeout=timeout)
 
         def chunks():
             try:
@@ -322,6 +384,9 @@ class GrpcWorkerClient:
                     if tag == b"E":
                         raise WorkerError.from_dict(json.loads(body.decode()))
                     yield body
+            except grpc.RpcError as e:
+                stream.cancel()
+                raise _map_rpc_error(e, self.url, key) from e
             except BaseException:
                 stream.cancel()  # cancellation propagates to the producer
                 raise
@@ -353,19 +418,26 @@ class GrpcWorkerClient:
         }).encode()
         stream = rpc(req)
         try:
-            for piece in stream:
-                tag, body = piece[:1], piece[1:]
-                if tag == b"E":
-                    raise WorkerError.from_dict(json.loads(body.decode()))
-                if tag == b"H":
-                    self._progress_cache[key] = json.loads(
-                        body.decode()
-                    ).get("progress")
-                    continue
-                _, blobs = transport.unpack_frame(body)
-                yield decode_table(blobs["table"]), len(body)
-                if cancel is not None and cancel.is_set():
-                    return
+            import grpc
+
+            try:
+                for piece in stream:
+                    tag, body = piece[:1], piece[1:]
+                    if tag == b"E":
+                        raise WorkerError.from_dict(
+                            json.loads(body.decode())
+                        )
+                    if tag == b"H":
+                        self._progress_cache[key] = json.loads(
+                            body.decode()
+                        ).get("progress")
+                        continue
+                    _, blobs = transport.unpack_frame(body)
+                    yield decode_table(blobs["table"]), len(body)
+                    if cancel is not None and cancel.is_set():
+                        return
+            except grpc.RpcError as e:
+                raise _map_rpc_error(e, self.url, key) from e
         finally:
             stream.cancel()
 
@@ -394,20 +466,27 @@ class GrpcWorkerClient:
         }).encode()
         stream = rpc(req)
         try:
-            for piece in stream:
-                tag, body = piece[:1], piece[1:]
-                if tag == b"E":
-                    raise WorkerError.from_dict(json.loads(body.decode()))
-                if tag == b"H":
-                    self._progress_cache[key] = json.loads(
-                        body.decode()
-                    ).get("progress")
-                    continue
-                header, blobs = transport.unpack_frame(body)
-                yield (header["part"], decode_table(blobs["table"]),
-                       len(body))
-                if cancel is not None and cancel.is_set():
-                    return
+            import grpc
+
+            try:
+                for piece in stream:
+                    tag, body = piece[:1], piece[1:]
+                    if tag == b"E":
+                        raise WorkerError.from_dict(
+                            json.loads(body.decode())
+                        )
+                    if tag == b"H":
+                        self._progress_cache[key] = json.loads(
+                            body.decode()
+                        ).get("progress")
+                        continue
+                    header, blobs = transport.unpack_frame(body)
+                    yield (header["part"], decode_table(blobs["table"]),
+                           len(body))
+                    if cancel is not None and cancel.is_set():
+                        return
+            except grpc.RpcError as e:
+                raise _map_rpc_error(e, self.url, key) from e
         finally:
             stream.cancel()
 

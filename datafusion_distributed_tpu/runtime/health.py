@@ -1,0 +1,178 @@
+"""Worker health tracking: consecutive-failure circuit breaker with
+half-open recovery probes.
+
+The reference schedules around unreachable workers at the connection-pool
+layer (`worker_connection_pool.rs` marks broken channels); scheduling-aware
+systems treat tolerating slow/failing participants as a first-class
+scheduler concern (Chasing Similarity, arXiv:1810.00511). Here the
+coordinator's router consults this tracker on every dispatch: a worker that
+keeps failing is QUARANTINED (circuit open) so tasks flow to healthy peers;
+after a cool-down the circuit goes HALF-OPEN and the next dispatch acts as a
+recovery probe — success closes the circuit, another failure re-opens it
+with an escalated cool-down.
+
+Deliberately transport-agnostic and clock-injectable (deterministic tests).
+Thread-safe: stage fan-out records failures from concurrent task threads.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+
+@dataclass
+class HealthPolicy:
+    #: consecutive failures that trip the breaker (quarantine the worker)
+    failure_threshold: int = 3
+    #: first quarantine duration; escalates by ``backoff_factor`` per
+    #: consecutive trip (a worker that fails its recovery probe waits longer)
+    quarantine_seconds: float = 30.0
+    backoff_factor: float = 2.0
+    max_quarantine_seconds: float = 300.0
+
+
+@dataclass
+class _WorkerState:
+    state: str = CLOSED
+    consecutive_failures: int = 0
+    #: consecutive breaker trips (resets on a successful probe)
+    trips: int = 0
+    open_until: float = 0.0
+    #: half-open: when the outstanding probe's admission expires — until
+    #: then further dispatches are refused (ONE probe, not a stampede)
+    probe_until: float = 0.0
+    total_failures: int = 0
+    total_successes: int = 0
+
+
+class HealthTracker:
+    """Per-worker circuit breakers keyed by url."""
+
+    def __init__(self, policy: Optional[HealthPolicy] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.policy = policy or HealthPolicy()
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._workers: dict[str, _WorkerState] = {}
+
+    def _state(self, url: str) -> _WorkerState:
+        s = self._workers.get(url)
+        if s is None:
+            s = self._workers[url] = _WorkerState()
+        return s
+
+    def record_success(self, url: str) -> None:
+        with self._lock:
+            s = self._state(url)
+            s.total_successes += 1
+            s.consecutive_failures = 0
+            s.trips = 0
+            s.state = CLOSED
+
+    def record_failure(self, url: str) -> bool:
+        """-> True when this failure TRIPPED the breaker (closed/half-open ->
+        open); the caller counts quarantine events off that edge."""
+        with self._lock:
+            s = self._state(url)
+            s.total_failures += 1
+            s.consecutive_failures += 1
+            if s.state == HALF_OPEN:
+                # failed recovery probe: straight back to open, longer
+                tripped = self._open(s)
+                return tripped
+            if (
+                s.state == CLOSED
+                and s.consecutive_failures >= self.policy.failure_threshold
+            ):
+                return self._open(s)
+            return False
+
+    def _open(self, s: _WorkerState) -> bool:
+        s.trips += 1
+        dur = min(
+            self.policy.quarantine_seconds
+            * (self.policy.backoff_factor ** (s.trips - 1)),
+            self.policy.max_quarantine_seconds,
+        )
+        s.state = OPEN
+        s.open_until = self._clock() + dur
+        return True
+
+    def is_available(self, url: str) -> bool:
+        """Whether the router may send work to ``url`` now. An expired
+        quarantine flips the breaker to half-open and admits the dispatch
+        as the recovery probe — ONE probe at a time: while the probe is
+        outstanding further dispatches are refused, so a stage fan-out
+        landing right after expiry cannot stampede a still-dead worker.
+        A probe that never resolves (its task died without a recorded
+        outcome) re-admits after another quarantine period."""
+        with self._lock:
+            s = self._workers.get(url)
+            if s is None or s.state == CLOSED:
+                return True
+            now = self._clock()
+            if s.state == OPEN:
+                if now >= s.open_until:
+                    s.state = HALF_OPEN
+                    s.probe_until = now + self.policy.quarantine_seconds
+                    return True
+                return False
+            # HALF_OPEN: the admitted probe is still in flight
+            if now >= s.probe_until:
+                s.probe_until = now + self.policy.quarantine_seconds
+                return True
+            return False
+
+    def route_filter(self, urls) -> list[str]:
+        """Candidate urls for ONE dispatch. Unlike `healthy`, a probe
+        admission PINS the dispatch to the probing worker (returns only
+        it): admitting a probe from a candidate listing and then routing
+        the task elsewhere would consume the probe slot without ever
+        resolving it, leaving a recovered worker routed-around for extra
+        quarantine periods."""
+        with self._lock:
+            now = self._clock()
+            avail = []
+            for u in urls:
+                s = self._workers.get(u)
+                if s is None or s.state == CLOSED:
+                    avail.append(u)
+                    continue
+                if s.state == OPEN and now >= s.open_until:
+                    s.state = HALF_OPEN
+                    s.probe_until = now + self.policy.quarantine_seconds
+                    return [u]  # this dispatch IS the recovery probe
+                if s.state == HALF_OPEN and now >= s.probe_until:
+                    # the admitted probe never resolved: re-admit one
+                    s.probe_until = now + self.policy.quarantine_seconds
+                    return [u]
+            return avail
+
+    def state_of(self, url: str) -> str:
+        with self._lock:
+            s = self._workers.get(url)
+            return CLOSED if s is None else s.state
+
+    def snapshot(self) -> dict:
+        """url -> breaker state, for observability surfaces."""
+        with self._lock:
+            now = self._clock()
+            return {
+                url: {
+                    "state": s.state,
+                    "consecutive_failures": s.consecutive_failures,
+                    "trips": s.trips,
+                    "open_for_s": max(s.open_until - now, 0.0)
+                    if s.state == OPEN else 0.0,
+                    "total_failures": s.total_failures,
+                    "total_successes": s.total_successes,
+                }
+                for url, s in self._workers.items()
+            }

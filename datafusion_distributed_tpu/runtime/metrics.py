@@ -51,6 +51,36 @@ class MetricsStore:
         return out
 
 
+class FaultCounters:
+    """Thread-safe counters for the fault-tolerant execution layer
+    (retries, reroutes, timeouts, quarantine trips). Surfaced through
+    `Coordinator.faults` and `ObservabilityService.get_fault_counters`;
+    mergeable across coordinators like the latency sketch."""
+
+    def __init__(self) -> None:
+        import threading
+
+        self._lock = threading.Lock()
+        self._counts: dict[str, int] = {}
+
+    def bump(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + n
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def as_dict(self) -> dict:
+        with self._lock:
+            return dict(self._counts)
+
+    def merge(self, other: "FaultCounters") -> "FaultCounters":
+        for name, n in other.as_dict().items():
+            self.bump(name, n)
+        return self
+
+
 def explain_analyze(
     plan: ExecutionPlan,
     store: MetricsStore,

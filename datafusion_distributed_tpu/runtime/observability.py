@@ -66,25 +66,46 @@ class SystemMetricsSampler:
 
 
 class ObservabilityService:
-    """Ping / GetTaskProgress / GetClusterWorkers over a worker cluster."""
+    """Ping / GetTaskProgress / GetClusterWorkers over a worker cluster.
 
-    def __init__(self, resolver, channels, sample_system: bool = False):
+    ``health``/``fault_counters`` (optional): the coordinator's
+    `HealthTracker` and `FaultCounters` — wiring them in annotates cluster
+    listings with circuit-breaker state and exposes the retry/quarantine
+    counters next to the task-progress surface."""
+
+    def __init__(self, resolver, channels, sample_system: bool = False,
+                 health=None, fault_counters=None):
         self.resolver = resolver
         self.channels = channels
+        self.health = health
+        self.fault_counters = fault_counters
         self.sampler = SystemMetricsSampler().start() if sample_system else None
 
     def ping(self) -> dict:
         return {"ok": True, "ts": time.time()}
 
     def get_cluster_workers(self) -> list[dict]:
+        health = self.health.snapshot() if self.health is not None else {}
         out = []
         for url in self.resolver.get_urls():
             try:
                 info = self.channels.get_worker(url).get_info()
             except Exception as e:
                 info = {"url": url, "error": str(e)}
+            if url in health:
+                info["health"] = health[url]
             out.append(info)
         return out
+
+    def get_worker_health(self) -> dict:
+        """url -> circuit-breaker state (empty without a wired tracker)."""
+        return self.health.snapshot() if self.health is not None else {}
+
+    def get_fault_counters(self) -> dict:
+        """Retry/quarantine/timeout counters (empty without wiring)."""
+        if self.fault_counters is None:
+            return {}
+        return self.fault_counters.as_dict()
 
     def get_task_progress(self, keys) -> dict:
         """TaskKey list -> progress dicts from whichever worker holds each."""

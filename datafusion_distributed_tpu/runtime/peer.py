@@ -149,7 +149,21 @@ class PeerShuffleScanExec(ExecutionPlan):
                 "construct the Worker with peer_channels= (or use a cluster "
                 "fixture that wires it)"
             )
-        return self._channels.get_worker(url)
+        try:
+            return self._channels.get_worker(url)
+        except Exception as e:
+            # a producer that left the membership view mid-query: surface
+            # as the retryable taxonomy with the endpoint attributed, so
+            # the consumer-side failure reads as infrastructure, not data
+            from datafusion_distributed_tpu.runtime.errors import (
+                WorkerUnavailableError,
+            )
+
+            raise WorkerUnavailableError(
+                f"peer producer {url} is not resolvable: {e}",
+                worker_url=url,
+                original_type=type(e).__name__,
+            ) from e
 
     def load(self, task: DistributedTaskContext) -> Table:
         """Pull this task's partition range from every producer: one puller

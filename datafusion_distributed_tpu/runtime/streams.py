@@ -167,7 +167,19 @@ def stream_stage_chunks(
                             done_rows, done_bytes)
             continue
         if kind == "error":
-            error = error or payload
+            # first error wins, EXCEPT that a fatal (non-retryable) error
+            # displaces a retryable one: once the fault-tolerant pullers
+            # exhausted their retries, the query-semantic failure is the
+            # actionable diagnosis — a sibling's transport hiccup that
+            # happened to arrive first must not mask it
+            from datafusion_distributed_tpu.runtime.errors import (
+                is_retryable,
+            )
+
+            if error is None or (
+                is_retryable(error) and not is_retryable(payload)
+            ):
+                error = payload
             cancel.set()
             continue
         budget.release(nbytes)

@@ -28,9 +28,47 @@ from datafusion_distributed_tpu.plan.physical import (
 )
 from datafusion_distributed_tpu.runtime.codec import TableStore, decode_plan
 from datafusion_distributed_tpu.runtime.errors import (
+    TaskTimeoutError,
     WorkerError,
     wrap_worker_exception,
 )
+
+
+def call_with_deadline(fn, timeout: Optional[float], worker_url: str, task):
+    """Run ``fn()`` under a wall-clock deadline: on expiry raise the
+    retryable `TaskTimeoutError` and ABANDON the still-running call (a hung
+    execution cannot be interrupted from Python; the coordinator's retry
+    machinery reroutes the task meanwhile). A bare DAEMON thread, not a
+    ThreadPoolExecutor: pool workers are non-daemon and joined at
+    interpreter exit, so one truly hung task would wedge process shutdown —
+    the exact failure mode deadlines exist to convert. ``timeout``
+    None/<=0 calls inline."""
+    if not timeout or timeout <= 0:
+        return fn()
+    import threading
+
+    box: dict = {}
+    done = threading.Event()
+
+    def run() -> None:
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # re-raised in the caller below
+            box["error"] = e
+        finally:
+            done.set()
+
+    threading.Thread(target=run, daemon=True,
+                     name="dftpu-deadline").start()
+    if not done.wait(timeout):
+        raise TaskTimeoutError(
+            f"deadline of {timeout}s elapsed",
+            worker_url=worker_url,
+            task=task,
+        )
+    if "error" in box:
+        raise box["error"]
+    return box["value"]
 
 
 @dataclass(frozen=True)
@@ -193,6 +231,12 @@ class Worker:
         # final progress of partition-range tasks, retained past their
         # drop-driven invalidation (consumed once by task_progress)
         self._final_progress: dict[TaskKey, Optional[dict]] = {}
+        # keys whose set_plan attempt was abandoned by a dispatch deadline:
+        # the still-running decode thread must not register an orphan
+        # entry (pinning decoded tables until the TTL sweep) after the
+        # coordinator rerouted — see set_plan's timeout path
+        self._abandoned_lock = threading.Lock()
+        self._abandoned_plans: set = set()
 
     # stage-shared compiled programs (query_id -> (last_touch, execute_plan
     # shared cache)): every task of a stage decodes its own plan copy, but
@@ -290,7 +334,33 @@ class Worker:
     def set_plan(self, key: TaskKey, plan_obj: dict, task_count: int,
                  config: Optional[dict] = None,
                  headers: Optional[dict] = None,
-                 ttl: Optional[float] = None) -> None:
+                 ttl: Optional[float] = None,
+                 timeout: Optional[float] = None) -> None:
+        """``timeout``: dispatch deadline — a hung decode converts into a
+        retryable TaskTimeoutError instead of wedging the dispatcher. An
+        abandoned decode is tombstoned so it cannot register an orphan
+        entry after the coordinator rerouted (a residual race window
+        degrades to the registry's TTL sweep, never to a permanent leak)."""
+        if timeout:
+            with self._abandoned_lock:
+                # a NEW attempt for this key supersedes a stale tombstone
+                self._abandoned_plans.discard(key)
+            try:
+                return call_with_deadline(
+                    lambda: self.set_plan(key, plan_obj, task_count,
+                                          config=config, headers=headers,
+                                          ttl=ttl),
+                    timeout, self.url, key,
+                )
+            except TaskTimeoutError:
+                with self._abandoned_lock:
+                    self._abandoned_plans.add(key)
+                    while len(self._abandoned_plans) > 512:
+                        self._abandoned_plans.pop()
+                # the abandoned decode may have registered just before the
+                # tombstone landed; eviction releases its shipped slices
+                self.registry.invalidate(key)
+                raise
         if headers:
             validate_passthrough_headers(headers)
         # idle-worker retention bound: stage-compile slots pin decoded
@@ -311,6 +381,14 @@ class Worker:
         )
 
         attach_peer_channels(plan, self.peer_channels, self)
+        with self._abandoned_lock:
+            if key in self._abandoned_plans:
+                # this decode ran past its dispatch deadline; the
+                # coordinator already rerouted — registering now would
+                # orphan the entry until the TTL sweep
+                self._abandoned_plans.discard(key)
+                self.table_store.remove(collect_table_ids(plan_obj))
+                return
         self.registry.put(TaskData(
             key=key, plan=plan, task_count=task_count,
             config=dict(config or {}), headers=dict(headers or {}),
@@ -319,7 +397,18 @@ class Worker:
         ))
 
     # -- data plane ---------------------------------------------------------
-    def execute_task(self, key: TaskKey) -> Table:
+    def execute_task(self, key: TaskKey,
+                     timeout: Optional[float] = None) -> Table:
+        """``timeout``: execution deadline (seconds). On expiry the attempt
+        is abandoned and the retryable TaskTimeoutError surfaces — the
+        fault-tolerant coordinator reroutes the task to another worker."""
+        if timeout:
+            return call_with_deadline(
+                lambda: self._execute_task_body(key), timeout, self.url, key
+            )
+        return self._execute_task_body(key)
+
+    def _execute_task_body(self, key: TaskKey) -> Table:
         data = self.registry.get(key)
         if data is None:
             raise WorkerError(

@@ -112,7 +112,14 @@ class SessionConfig:
     overflow_retries: int = 3
     # `SET distributed.<key> = <value>` overrides, applied when building the
     # DistributedConfig (the reference's ConfigExtension with prefix
-    # "distributed"; coordinator->worker propagation rides the plan codec)
+    # "distributed"; coordinator->worker propagation rides the plan codec).
+    # Keys that are not DistributedConfig fields flow verbatim into
+    # Coordinator.config_options — that is how the runtime knobs travel:
+    # the data-plane ones (peer_shuffle, stream_chunk_rows,
+    # worker_connection_buffer_budget_bytes, ...) and the fault-tolerance
+    # layer's (max_task_retries, task_retry_backoff_s, task_timeout_s,
+    # dispatch_timeout_s, quarantine_threshold, quarantine_seconds — see
+    # runtime/coordinator.py FAULT_TOLERANCE_DEFAULTS).
     distributed_options: dict = None  # type: ignore[assignment]
     # user headers forwarded verbatim to workers (auth etc.; the
     # passthrough_headers analogue)

@@ -14,10 +14,22 @@
 # passes in isolation; process-per-file keeps each XLA instance young
 # and makes a crash attributable.
 set -u
+# Deterministic fault-injection seed (tests/test_fault_tolerance.py +
+# runtime/chaos.py): exported and echoed so a chaos-test failure is
+# reproducible by re-running with the printed seed.
+export DFTPU_CHAOS_SEED="${DFTPU_CHAOS_SEED:-20260803}"
+echo "DFTPU_CHAOS_SEED=$DFTPU_CHAOS_SEED"
+# Default to skipping @pytest.mark.slow (heavy multi-fault chaos sweeps):
+# their extra XLA compiles age a process toward the crash this script
+# exists to avoid. DFTPU_TEST_MARKERS="" runs everything.
+MARKERS="${DFTPU_TEST_MARKERS-not slow}"
+MARKER_ARGS=()
+[ -n "$MARKERS" ] && MARKER_ARGS=(-m "$MARKERS")
 FAILED=()
 for f in tests/test_*.py; do
     echo "=== $f"
-    if ! python -m pytest "$f" -q --no-header -p no:cacheprovider "$@"; then
+    if ! python -m pytest "$f" -q --no-header -p no:cacheprovider \
+            "${MARKER_ARGS[@]}" "$@"; then
         FAILED+=("$f")
     fi
 done

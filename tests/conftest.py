@@ -57,6 +57,16 @@ jax.config.update("jax_platforms", "cpu")
 # foreign machine code.
 
 
+def pytest_configure(config):
+    # tier-1 runs with `-m 'not slow'`; heavy multi-fault sweeps and other
+    # long-tail tests opt out of it via this marker
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy tests (multi-fault chaos sweeps) excluded from the "
+        "tier-1 `-m 'not slow'` run",
+    )
+
+
 def _cpu_fingerprint() -> str:
     # package import is safe at this point: jax_platforms is already pinned
     # to cpu above, and DFTPU_COMPILE_CACHE is unset under tests, so the

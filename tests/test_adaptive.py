@@ -2,6 +2,7 @@
 dynamic mode)."""
 
 import numpy as np
+import pytest
 
 from datafusion_distributed_tpu import precision as _precision
 
@@ -277,15 +278,22 @@ def test_midstream_column_loadinfo():
     assert coord.partial_decisions, "no mid-execution freeze happened"
     for done, total in coord.partial_decisions.values():
         assert done < total
-    infos = [i for i in coord._predicted.values() if i.ndv]
-    assert infos, "predicted LoadInfo carried no per-column statistics"
-    info = infos[0]
-    # frozen NDVs are coverage-EXTRAPOLATED upper bounds (observed x
-    # total/done, clamped by predicted rows): the 64-distinct-key group
-    # column must estimate >= what was observed and never exceed rows
-    assert any(v >= 1 for v in info.ndv.values()), info.ndv
-    assert all(v <= max(info.rows, 1) for v in info.ndv.values()), (
-        info.ndv, info.rows)
+    with_ndv = [
+        (sid, i) for sid, i in coord._predicted.items() if i.ndv
+    ]
+    assert with_ndv, "predicted LoadInfo carried no per-column statistics"
+    sid, info = with_ndv[0]
+    # frozen per-column NDVs stay RAW (what the partial sample observed);
+    # the producer-coverage factor lives SEPARATELY in info.ndv_scale
+    # (total/done) and is applied once to the group-key tuple product by
+    # resize_for_inputs — scaling each column here would compound the
+    # factor across multi-key groups. The 64-distinct-key group column
+    # bounds every raw observation.
+    assert any(1 <= v <= 64 for v in info.ndv.values()), info.ndv
+    done, total = coord.partial_decisions[sid]
+    assert info.ndv_scale == pytest.approx(total / done), (
+        info.ndv_scale, done, total)
+    assert info.ndv_scale > 1.0  # a partial freeze implies done < total
     assert info.null_frac, "no null fractions sampled"
     assert info.rows_per_s > 0 and info.bytes_per_s > 0
 

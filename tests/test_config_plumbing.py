@@ -114,6 +114,51 @@ def test_coordinator_records_latency():
     assert s["p50"] is not None and s["p50"] > 0
 
 
+def test_fault_tolerance_knobs_flow_via_set():
+    """`SET distributed.<knob>` -> SessionConfig.distributed_options ->
+    Coordinator.config_options -> the retry/deadline/quarantine readers
+    (the config-over-headers flow, extended to the fault-tolerance layer)."""
+    from datafusion_distributed_tpu.runtime.coordinator import (
+        FAULT_TOLERANCE_DEFAULTS,
+    )
+    from datafusion_distributed_tpu.sql.context import SessionContext
+
+    ctx = SessionContext()
+    ctx.sql(
+        "set distributed.max_task_retries = 5;"
+        "set distributed.task_timeout_s = 1.5;"
+        "set distributed.dispatch_timeout_s = 2.5;"
+        "set distributed.quarantine_threshold = 1;"
+        "set distributed.quarantine_seconds = 0.25;"
+        "set distributed.task_retry_backoff_s = 0.01"
+    )
+    opts = ctx.config.distributed_options
+    for knob in FAULT_TOLERANCE_DEFAULTS:
+        assert knob in opts, f"SET distributed.{knob} did not land"
+    coord = Coordinator(resolver=None, channels=None,
+                        config_options=dict(opts))
+    assert coord._opt_int("max_task_retries") == 5
+    assert coord._opt_float("task_timeout_s") == 1.5
+    assert coord._opt_float("dispatch_timeout_s") == 2.5
+    assert coord._opt_int("quarantine_threshold") == 1
+    assert coord._health_tracker().policy.failure_threshold == 1
+    assert coord._health_tracker().policy.quarantine_seconds == 0.25
+
+
+def test_fault_tolerance_defaults_apply_without_set():
+    coord = Coordinator(resolver=None, channels=None)
+    from datafusion_distributed_tpu.runtime.coordinator import (
+        FAULT_TOLERANCE_DEFAULTS as D,
+    )
+
+    assert coord._opt_int("max_task_retries") == D["max_task_retries"]
+    assert coord._opt_float("task_timeout_s") == D["task_timeout_s"]
+    # malformed values degrade to defaults instead of crashing dispatch
+    coord2 = Coordinator(resolver=None, channels=None,
+                         config_options={"max_task_retries": "many"})
+    assert coord2._opt_int("max_task_retries") == D["max_task_retries"]
+
+
 def test_graphviz_display():
     dot = display_staged_plan_graphviz(_plan())
     assert dot.startswith("digraph")

@@ -19,12 +19,17 @@ def _days(s: str) -> int:
 
 
 def _to_days(col):
-    """pandas date-ish column -> int days since epoch."""
+    """pandas date-ish column -> int days since epoch. Object columns that
+    are NOT date-like (plain strings) pass through unchanged — newer pandas
+    raises DateParseError on them instead of best-effort parsing."""
     if col.dtype == object or str(col.dtype).startswith("date"):
-        return pd.Series(
-            [(pd.Timestamp(v) - pd.Timestamp("1970-01-01")).days if v is not None
-             else None for v in col]
-        )
+        try:
+            return pd.Series(
+                [(pd.Timestamp(v) - pd.Timestamp("1970-01-01")).days
+                 if v is not None else None for v in col]
+            )
+        except (ValueError, TypeError):
+            return col
     return col
 
 
