@@ -47,14 +47,28 @@ if _cache_dir and _cache_dir != "0":
     # runtime_executable().serialize() returns bytes on axon). The allowlist
     # is a local inside the once-per-process check, so the only seam is the
     # check's memoization globals: pre-answer "yes" before any backend
-    # initializes. Opt-in only (DFTPU_COMPILE_CACHE set), and harmless for
-    # cpu/tpu backends which the allowlist already admits.
+    # initializes. Guarded three ways (advisor round 5): opt-in only
+    # (DFTPU_COMPILE_CACHE set), applied only when the axon plugin is the
+    # selected platform (cpu/tpu are already on the allowlist and need no
+    # override), and only when the memoization globals still have the
+    # known bool shape — a jax upgrade that reshapes them (the probe) or
+    # renames them (the hasattr-equivalent isinstance check) degrades to
+    # jax's stock behavior instead of corrupting private state.
     try:
-        from jax._src import compilation_cache as _cc
+        _effective_platforms = _os.environ.get("JAX_PLATFORMS") or ""
+        if not _effective_platforms:
+            try:
+                _effective_platforms = str(_jax.config.jax_platforms or "")
+            except AttributeError:
+                _effective_platforms = ""
+        if "axon" in _effective_platforms:
+            from jax._src import compilation_cache as _cc
 
-        if hasattr(_cc, "_cache_checked") and hasattr(_cc, "_cache_used"):
-            _cc._cache_checked = True
-            _cc._cache_used = True
+            if isinstance(getattr(_cc, "_cache_checked", None), bool) and (
+                isinstance(getattr(_cc, "_cache_used", None), bool)
+            ):
+                _cc._cache_checked = True
+                _cc._cache_used = True
     except Exception:  # pragma: no cover - private-API drift guard
         pass
 

@@ -611,10 +611,15 @@ def execute_plan(
 ) -> Table:
     """Run a (single-task) plan: host-load leaves, trace+jit the rest once.
 
-    The jit cache key is the plan object identity plus input shapes, so
-    repeated execution over same-capacity batches reuses the compiled
-    executable (the analogue of the reference's task re-execution against the
-    cached plan in `TaskData`). When ``metrics_store`` is given, the traced
+    The compile cache is keyed on the plan's STRUCTURAL FINGERPRINT
+    (plan/fingerprint.py) — node kinds, expressions, capacities, the task
+    lattice — not object identity, so a fresh submission of an identical
+    query (new ``ctx.sql()`` call) reuses the compiled executable, and a
+    literal-hoisted template variant reuses it with new parameter inputs
+    (the analogue of the reference's task re-execution against the cached
+    plan in `TaskData`, extended across queries). Plans containing nodes the
+    fingerprint cannot canonicalize fall back to object-identity keying.
+    When ``metrics_store`` is given, the traced
     per-node metrics are returned as program outputs and inserted under
     ``task_label`` (runtime/metrics.py MetricsStore protocol).
 
@@ -625,15 +630,29 @@ def execute_plan(
     *data* differs, and that enters as a program input). The caller is
     responsible for only passing plans whose trace does not branch on
     ``task_index`` (see Worker.execute_task: IsolatedArmExec disables it);
-    the input pytree structure + shapes/dtypes are appended to the key here,
-    so same-stage tasks with divergent leaf shapes simply miss."""
+    the structural fingerprint plus the input pytree structure +
+    shapes/dtypes are appended to the key here, so same-stage tasks with
+    divergent trees or leaf shapes simply miss (they can no longer silently
+    bind another stage's inputs)."""
+    from datafusion_distributed_tpu.plan.fingerprint import (
+        bound_params,
+        prepare_plan,
+    )
+
     task = task or DistributedTaskContext()
-    leaves = collect_leaves(plan)
+    # content-address the program: literal-hoisted plan + structural
+    # fingerprint (None -> legacy object-identity keying). The hoisted
+    # plan reuses the original's leaf objects, so leaf traversal order —
+    # the positional input binding — is unchanged.
+    prep = prepare_plan(plan)
+    exec_target = prep.plan
+    params = prep.param_arrays()
+    leaves = collect_leaves(exec_target)
     # positional inputs, rebound to node ids INSIDE run via the closure
     # plan's own leaf order: node ids are minted per decode, so a shared
     # program traced from one task's plan copy must not see another copy's
     # ids in its input pytree — leaf traversal order is the cross-copy
-    # stable identity (identical stage trees traverse identically)
+    # stable identity (fingerprint-equal trees traverse identically)
     leaf_ids = [leaf.node_id for leaf in leaves if hasattr(leaf, "load")]
     input_list = [
         leaf.load(task) for leaf in leaves if hasattr(leaf, "load")
@@ -642,14 +661,27 @@ def execute_plan(
     overflow_box: list = []
     metric_names: list = []
 
-    def run(inp_list):
+    def run(inp_list, param_vecs):
+        _TRACE_STATS["traces"] += 1
         inp = dict(zip(leaf_ids, inp_list))
         ctx = ExecContext(task=task, inputs=inp, config=config or {})
-        out = plan.execute(ctx)
+        with bound_params(param_vecs):
+            out = exec_target.execute(ctx)
         overflow_box.clear()
         overflow_box.extend(ctx.overflow_flags)
+        # metric names are POSITION-addressed (pre-order traversal index),
+        # not node-id-addressed: a fingerprint-shared program executes for
+        # plan copies whose node ids differ from the creator's, and
+        # fingerprint-equal trees traverse identically — the caller remaps
+        # positions to ITS plan's node ids at insert time
+        pos_of = {
+            n.node_id: i
+            for i, n in enumerate(exec_target.collect(lambda _n: True))
+        }
         metric_names.clear()
-        metric_names.extend((nid, name) for nid, name, _ in ctx.metrics)
+        metric_names.extend(
+            (pos_of.get(nid, -1), name) for nid, name, _ in ctx.metrics
+        )
         metric_vals = [v for _, _, v in ctx.metrics]
         cap_flags = [
             f for name, f in ctx.overflow_flags
@@ -671,29 +703,42 @@ def execute_plan(
         # ride a single transfer
         return out, jnp.stack([any_overflow, any_precision]), metric_vals
 
-    cache_key = (
-        plan.node_id,
-        task.task_index,
-        task.task_count,
-        tuple(sorted((config or {}).items())),
-    )
+    cfg_items = tuple(sorted((config or {}).items()))
+    # structural fingerprint -> content-addressed entry shared across plan
+    # objects (fresh ctx.sql() submissions, literal-hoisted template
+    # variants); no fingerprint -> legacy object-identity keying
+    if prep.fingerprint is not None:
+        cache_key = ("fp", prep.fingerprint, task.task_index,
+                     task.task_count, cfg_items)
+    else:
+        cache_key = ("id", plan.node_id, task.task_index,
+                     task.task_count, cfg_items)
     # the trace-time boxes (overflow names, metric names) must come from the
     # SAME closure as the cached executable, or cache hits would see them
-    # empty. use_cache=False (worker path: plans are freshly decoded per task
-    # and would never hit) keeps one-shot programs out of the global cache so
-    # their closures don't pin shipped task tables.
-    cached = _COMPILE_CACHE.get(cache_key) if use_cache else None
+    # empty. use_cache=False (worker path: per-task programs go through the
+    # TTL'd stage-share cache instead) keeps one-shot programs out of the
+    # global cache so their closures don't pin shipped task tables.
+    cached = None
+    if use_cache:
+        with _CACHE_LOCK:
+            cached = _COMPILE_CACHE.get(cache_key)
+            if cached is not None:
+                # move-to-end: LRU eviction must not take a live entry
+                _COMPILE_CACHE.pop(cache_key)
+                _COMPILE_CACHE[cache_key] = cached
     first_call_gate = None
     if cached is None and shared_cache is not None:
-        # stage-shared program: key on the caller's stage identity plus the
-        # input pytree structure and leaf shapes/dtypes (the only thing that
-        # can legitimately differ between same-stage tasks)
+        # stage-shared program: key on the caller's stage identity, the
+        # structural fingerprint (an order/identity mismatch between plan
+        # copies now misses instead of silently binding wrong inputs), and
+        # the input pytree structure + leaf shapes/dtypes (the only thing
+        # that can legitimately differ between same-stage tasks)
         flat, treedef = jax.tree_util.tree_flatten(input_list)
         sig = tuple(
             (getattr(l, "shape", None), str(getattr(l, "dtype", type(l))))
             for l in flat
         )
-        skey = (shared_key, treedef, sig)
+        skey = (shared_key, prep.fingerprint, treedef, sig)
         # get-or-create under a lock: same-stage tasks fan out on coordinator
         # threads, and an unsynchronized check-then-act would have the first
         # wave all miss and compile duplicates — the exact cost this cache
@@ -722,11 +767,14 @@ def execute_plan(
         first_call_gate = cached[3]
         cached = cached[:3]
     if cached is None:
-        if use_cache and len(_COMPILE_CACHE) >= _COMPILE_CACHE_MAX:
-            _COMPILE_CACHE.clear()
         cached = (jax.jit(run), overflow_box, metric_names)
         if use_cache:
-            _COMPILE_CACHE[cache_key] = cached
+            with _CACHE_LOCK:
+                # bounded LRU eviction (was: a full clear() at the cap — a
+                # cliff that recompiled EVERY live query at once)
+                while len(_COMPILE_CACHE) >= _COMPILE_CACHE_MAX:
+                    _COMPILE_CACHE.pop(next(iter(_COMPILE_CACHE)))
+                _COMPILE_CACHE[cache_key] = cached
     fn, overflow_box, metric_names = cached
     result = None
     if first_call_gate is not None and not first_call_gate["warmed"]:
@@ -736,10 +784,10 @@ def execute_plan(
             # task wave) — only the creator's trace+compile+first-run is
             # serialized; everyone else re-checks and runs concurrently
             if not first_call_gate["warmed"]:
-                result = fn(input_list)
+                result = fn(input_list, params)
                 first_call_gate["warmed"] = True
     if result is None:
-        result = fn(input_list)
+        result = fn(input_list, params)
     out, flags, metric_vals = result
     flags = np.asarray(flags)  # one fetch for both sentinel checks
     any_overflow, any_precision = bool(flags[0]), bool(flags[1])
@@ -759,20 +807,56 @@ def execute_plan(
             "run with DFTPU_PRECISION=x64 for 64-bit accumulation"
         )
     if metrics_store is not None:
+        # positions -> THIS submission's node ids (hoisting preserves the
+        # original ids, so callers can look metrics up on their own plan)
+        nodes = plan.collect(lambda _n: True)
         node_metrics: dict = {}
-        for (nid, name), v in zip(metric_names, metric_vals):
-            node_metrics.setdefault(nid, {})[name] = int(v)
+        for (pos, name), v in zip(metric_names, metric_vals):
+            if 0 <= pos < len(nodes):
+                node_metrics.setdefault(nodes[pos].node_id, {})[name] = int(v)
         metrics_store.insert(task_label or f"task{task.task_index}", node_metrics)
     return out
 
 
-_COMPILE_CACHE: dict = {}
+_COMPILE_CACHE: dict = {}  # insertion order == LRU order (move-to-end on hit)
+_CACHE_LOCK = threading.Lock()
 # stage-shared program cache observability: hits = task executions that
 # reused another task's traced program (each hit ~= one XLA compile avoided)
 _SHARED_STATS = {"hit": 0, "miss": 0}
 _SHARED_LOCK = threading.Lock()
 _SHARED_ENTRY_CAP = 32  # per-query distinct (stage, shape-class) programs
-_COMPILE_CACHE_MAX = 512
+
+
+def _plan_cache_default() -> int:
+    import os as _os
+
+    try:
+        return max(int(_os.environ.get("DFTPU_PLAN_CACHE", "512")), 1)
+    except ValueError:
+        return 512
+
+
+_COMPILE_CACHE_MAX = _plan_cache_default()
+
+
+def set_plan_cache_size(n) -> None:
+    """Resize the compiled-program LRU (SET distributed.plan_cache_size /
+    DFTPU_PLAN_CACHE). Shrinking evicts oldest entries immediately."""
+    global _COMPILE_CACHE_MAX
+    _COMPILE_CACHE_MAX = max(int(n), 1)
+    with _CACHE_LOCK:
+        while len(_COMPILE_CACHE) > _COMPILE_CACHE_MAX:
+            _COMPILE_CACHE.pop(next(iter(_COMPILE_CACHE)))
+
+
+# program-trace counter: incremented once per traced program body (the
+# 1:1 proxy for XLA compiles — cache hits never re-run the traced python).
+# The recompile-regression tests assert on deltas of this counter.
+_TRACE_STATS = {"traces": 0}
+
+
+def trace_count() -> int:
+    return _TRACE_STATS["traces"]
 
 
 def _dicts_of(table: Table) -> dict:

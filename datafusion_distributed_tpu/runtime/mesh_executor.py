@@ -80,11 +80,26 @@ def execute_on_mesh(
     With ``metrics_store`` (runtime/metrics.py protocol), traced per-node
     metrics come back per task via a P(axis)-stacked program output and are
     inserted under labels task0..taskN-1."""
-    num_tasks = mesh.shape[AXIS]
-    leaves = plan.collect(lambda n: not n.children())
+    from datafusion_distributed_tpu.plan.fingerprint import (
+        bound_params,
+        prepare_plan,
+    )
+    from datafusion_distributed_tpu.plan.physical import _TRACE_STATS
 
-    # host phase: load every task's slice of every leaf, stack to [T, ...]
-    stacked_inputs: dict[int, Table] = {}
+    num_tasks = mesh.shape[AXIS]
+    # content-address the SPMD program: fingerprint-equal plans (fresh
+    # submissions, literal-hoisted variants) reuse the compiled executable
+    prep = prepare_plan(plan)
+    exec_target = prep.plan
+    params = prep.param_arrays()
+    leaves = exec_target.collect(lambda n: not n.children())
+
+    # host phase: load every task's slice of every leaf, stack to [T, ...].
+    # POSITIONAL (leaf traversal order), not node-id keyed: node ids are
+    # minted per plan object, and a dict keyed on them would change the
+    # input pytree structure between fingerprint-equal plan copies.
+    leaf_ids = [leaf.node_id for leaf in leaves if hasattr(leaf, "load")]
+    stacked_inputs: list[Table] = []
     for leaf in leaves:
         if not hasattr(leaf, "load"):
             continue
@@ -92,29 +107,39 @@ def execute_on_mesh(
             leaf.load(DistributedTaskContext(i, num_tasks))
             for i in range(num_tasks)
         ]
-        stacked_inputs[leaf.node_id] = jax.tree.map(
-            lambda *xs: jnp.stack(xs), *per_task
+        stacked_inputs.append(
+            jax.tree.map(lambda *xs: jnp.stack(xs), *per_task)
         )
 
     overflow_names: list = []
     metric_names: list = []
 
-    def run(inputs_stacked):
+    def run(inputs_stacked, param_vecs):
+        _TRACE_STATS["traces"] += 1
         # local view: leading task axis of size 1 -> squeeze
         local_inputs = {
             nid: jax.tree.map(lambda x: x[0], t)
-            for nid, t in inputs_stacked.items()
+            for nid, t in zip(leaf_ids, inputs_stacked)
         }
         ctx = ExecContext(
             task=DistributedTaskContext(0, num_tasks),
             inputs=local_inputs,
             config={"mesh_axis": AXIS, "num_tasks": num_tasks},
         )
-        out = plan.execute(ctx)
+        with bound_params(param_vecs):
+            out = exec_target.execute(ctx)
         overflow_names.clear()
         overflow_names.extend(name for name, _ in ctx.overflow_flags)
+        # position-addressed metric names (see plan/physical.py run():
+        # fingerprint-shared programs must not leak creator node ids)
+        pos_of = {
+            n.node_id: i
+            for i, n in enumerate(exec_target.collect(lambda _n: True))
+        }
         metric_names.clear()
-        metric_names.extend((nid, name) for nid, name, _ in ctx.metrics)
+        metric_names.extend(
+            (pos_of.get(nid, -1), name) for nid, name, _ in ctx.metrics
+        )
         if ctx.metrics:
             mvec = jnp.stack(
                 [v.astype(_METRIC_DTYPE) for _, _, v in ctx.metrics]
@@ -144,8 +169,17 @@ def execute_on_mesh(
         )
         return out, any_overflow, any_precision, mvec
 
-    in_specs = jax.tree.map(lambda _: P(AXIS), stacked_inputs)
-    cache_key = (plan.node_id, tuple(d.id for d in mesh.devices.flat))
+    # pytree-PREFIX specs (one spec per leaf Table / param vector, applied
+    # to the whole subtree): a full spec tree would bake the creator's
+    # pytree aux (dictionary identities) into the cached executable and
+    # fail structure matching when a fingerprint-equal plan copy carries
+    # fresh Dictionary objects — prefix specs make that a plain retrace
+    in_specs = [P(AXIS)] * len(stacked_inputs)
+    param_specs = (P(), P())  # replicated
+    # fingerprint -> shared across fresh submissions / hoisted variants;
+    # unfingerprintable plans fall back to object identity as before
+    cache_key = (prep.fingerprint or ("id", plan.node_id),
+                 tuple(d.id for d in mesh.devices.flat))
     cached = _MESH_COMPILE_CACHE.get(cache_key)
     if cached is not None:
         # move-to-end: LRU eviction must not take the entry being reused
@@ -158,7 +192,7 @@ def execute_on_mesh(
             shard_map(
                 run,
                 mesh=mesh,
-                in_specs=(in_specs,),
+                in_specs=(in_specs, param_specs),
                 out_specs=(P(), P(), P(), P(AXIS)),
                 check_rep=False,
             )
@@ -166,7 +200,7 @@ def execute_on_mesh(
         cached = (fn, overflow_names, metric_names)
         _MESH_COMPILE_CACHE[cache_key] = cached
     fn, overflow_names, metric_names = cached
-    out, any_overflow, any_precision, mvec = fn(stacked_inputs)
+    out, any_overflow, any_precision, mvec = fn(stacked_inputs, params)
     if check_overflow and bool(any_overflow):
         raise RuntimeError(
             f"exchange/hash capacity overflow on mesh (nodes: "
@@ -182,10 +216,14 @@ def execute_on_mesh(
     if metrics_store is not None:
         import numpy as np_
 
+        nodes = plan.collect(lambda _n: True)
         m = np_.asarray(mvec)  # [T, M]
         for t in range(m.shape[0]):
             node_metrics: dict = {}
-            for (nid, name), v in zip(metric_names, m[t]):
-                node_metrics.setdefault(nid, {})[name] = int(v)
+            for (pos, name), v in zip(metric_names, m[t]):
+                if 0 <= pos < len(nodes):
+                    node_metrics.setdefault(
+                        nodes[pos].node_id, {}
+                    )[name] = int(v)
             metrics_store.insert(f"task{t}", node_metrics)
     return out

@@ -238,25 +238,34 @@ class Worker:
         self._abandoned_lock = threading.Lock()
         self._abandoned_plans: set = set()
 
-    # stage-shared compiled programs (query_id -> (last_touch, execute_plan
+    # stage-shared compiled programs (slot key -> (last_touch, execute_plan
     # shared cache)): every task of a stage decodes its own plan copy, but
     # the traced program is task-invariant (padded capacities make shapes
     # uniform; task identity only selects host-side leaf data), so one
     # compile serves all tasks — the single biggest host-tier cost at
-    # scale was N_tasks identical XLA compiles per stage. CLASS-level on
-    # purpose: co-hosted workers (InMemoryCluster, one process) then pay
-    # one compile per stage instead of one per worker; separate worker
-    # processes are unaffected. Retention is time/count-based, NOT
-    # registry-driven: the coordinator invalidates each task entry right
-    # after it executes, so "no registry entries for this query" happens
-    # transiently MID-query and must not destroy the cache (review r5).
-    # A query slot is dropped when untouched for _STAGE_COMPILE_TTL_S
-    # (compiled programs pin the first task's decoded plan incl. shipped
-    # tables — the TTL bounds that retention in time) or when the LRU cap
-    # pushes it out (bounds it in count on busy workers).
-    _stage_compiles: dict[str, tuple[float, dict]] = {}
+    # scale was N_tasks identical XLA compiles per stage. Slots are keyed
+    # by the stage plan's STRUCTURAL FINGERPRINT (plan/fingerprint.py), so
+    # repeated queries — and literal-hoisted template variants — reuse the
+    # stage program ACROSS queries; plans without a fingerprint fall back
+    # to a per-query slot. CLASS-level on purpose: co-hosted workers
+    # (InMemoryCluster, one process) then pay one compile per stage
+    # instead of one per worker; separate worker processes are unaffected.
+    # Retention is time/count-based, NOT registry-driven: the coordinator
+    # invalidates each task entry right after it executes, so "no registry
+    # entries for this query" happens transiently MID-query and must not
+    # destroy the cache (review r5). A slot is dropped _STAGE_COMPILE_TTL_S
+    # after CREATION — absolute age, not idle time: a compiled program's
+    # closure pins its creator task's decoded plan (incl. shipped tables),
+    # and a HOT template would otherwise refresh an idle-TTL forever and
+    # pin the very first submission's tables for the template's lifetime.
+    # Expiry of a hot slot just costs one recompile per TTL window. The
+    # LRU cap bounds retention in count on busy workers (it counts
+    # per-STAGE slots now, hence larger than the old per-query cap of 8);
+    # dict order still tracks recency-of-USE so eviction takes cold slots
+    # first.
+    _stage_compiles: dict = {}
     _stage_compiles_lock = threading.Lock()
-    _STAGE_COMPILE_QUERY_CAP = 8
+    _STAGE_COMPILE_SLOT_CAP = 64
     _STAGE_COMPILE_TTL_S = 600.0
 
     def _on_task_evict(self, data: TaskData) -> None:
@@ -266,8 +275,8 @@ class Worker:
 
     @classmethod
     def _sweep_stage_compiles_locked(cls, now: float) -> None:
-        """Drop query slots untouched for the TTL. Caller holds
-        `_stage_compiles_lock`."""
+        """Drop slots older than the TTL (absolute age since creation —
+        see the class comment). Caller holds `_stage_compiles_lock`."""
         dead = [
             q for q, (ts, _) in cls._stage_compiles.items()
             if now - ts > cls._STAGE_COMPILE_TTL_S
@@ -309,22 +318,41 @@ class Worker:
 
         if data.plan.collect(_unshareable):
             return None, None
+        from datafusion_distributed_tpu.plan.fingerprint import prepare_plan
+
+        # fingerprint-keyed slot: identical stage structures — re-submitted
+        # queries, literal-only template variants — share one compiled
+        # program across queries; an unfingerprintable plan degrades to the
+        # old per-query slot (sharing only among its own tasks). The
+        # fingerprint also rides the shared program key inside execute_plan,
+        # so two stages that merely COLLIDE on (query, stage id) — e.g. a
+        # coordinator reusing ids after a replan — miss instead of binding
+        # each other's inputs.
+        prep = prepare_plan(data.plan)
+        if prep.fingerprint is not None:
+            slot = ("fp", prep.fingerprint)
+            stage_identity = prep.fingerprint
+        else:
+            slot = ("q", key.query_id)
+            stage_identity = (key.query_id, key.stage_id)
         now = time.time()
         with self._stage_compiles_lock:
             self._sweep_stage_compiles_locked(now)
-            hit = self._stage_compiles.pop(key.query_id, None)
-            cache = hit[1] if hit is not None else None
-            if cache is None:
-                while len(self._stage_compiles) >= self._STAGE_COMPILE_QUERY_CAP:
+            hit = self._stage_compiles.pop(slot, None)
+            if hit is not None:
+                created, cache = hit
+            else:
+                while len(self._stage_compiles) >= self._STAGE_COMPILE_SLOT_CAP:
                     self._stage_compiles.pop(
                         next(iter(self._stage_compiles))
                     )
-                cache = {}
-            # re-insert at the end: pop+insert keeps dict order = LRU order
-            self._stage_compiles[key.query_id] = (now, cache)
+                created, cache = now, {}
+            # re-insert at the end: pop+insert keeps dict order = use
+            # recency (for LRU eviction) while the stored timestamp stays
+            # the CREATION time (for the absolute-age TTL)
+            self._stage_compiles[slot] = (created, cache)
         shared_key = (
-            key.query_id,
-            key.stage_id,
+            stage_identity,
             data.task_count,
             tuple(sorted((data.config or {}).items())),
         )

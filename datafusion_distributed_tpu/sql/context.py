@@ -49,9 +49,17 @@ class Catalog:
         self.tables: dict[str, Table] = {}
         self.views: dict[str, LogicalPlan] = {}
         self._ndv_cache: dict = {}
+        # bumped on every (re-)registration: physical plans embed scan
+        # Tables and plan-time scalar-subquery results, so the session's
+        # plan cache keys on this to drop plans built over replaced data
+        self.generation = 0
 
     def register_table(self, name: str, table: Table) -> None:
         self.tables[name.lower()] = table
+        self.generation += 1
+        self._ndv_cache = {
+            k: v for k, v in self._ndv_cache.items() if k[0] != name.lower()
+        }
 
     def has_table(self, name: str) -> bool:
         return name.lower() in self.tables
@@ -136,6 +144,21 @@ class SessionConfig:
     def set_option(self, name: str, value) -> None:
         scope, _, key = name.partition(".")
         if scope == "distributed":
+            # compiled-program cache knobs apply process-wide (the caches
+            # are module-level); they also stay in distributed_options so
+            # EXPLAIN-style introspection and workers see the setting
+            if key == "plan_cache_size":
+                from datafusion_distributed_tpu.plan.physical import (
+                    set_plan_cache_size,
+                )
+
+                set_plan_cache_size(int(value))
+            elif key == "literal_hoisting":
+                from datafusion_distributed_tpu.plan.fingerprint import (
+                    set_literal_hoisting,
+                )
+
+                set_literal_hoisting(value)
             self.distributed_options[key] = value
         elif scope == "planner":
             if not hasattr(self.planner, key):
@@ -241,19 +264,60 @@ class DataFrame:
         self.ctx = ctx
         self.logical = logical
         # plan memoization: repeated collect() of the same DataFrame reuses
-        # the plan object, which keys the executors' compile caches
+        # the plan object. Lookups go through the SESSION-level cache keyed
+        # by the logical plan's structural fingerprint, so a fresh
+        # ctx.sql(same_text) from a distinct submission reuses the planned
+        # physical tree too (plan/fingerprint.py); this dict is the
+        # fallback for logical plans without a fingerprint.
         self._plan_cache: dict = {}
+        self._logical_fp = -1  # lazily computed; None = unfingerprintable
+
+    def _logical_fingerprint(self):
+        if self._logical_fp == -1:
+            from datafusion_distributed_tpu.plan.fingerprint import (
+                logical_fingerprint,
+            )
+
+            self._logical_fp = logical_fingerprint(self.logical)
+        return self._logical_fp
+
+    def _plan_cache_get(self, key):
+        lfp = self._logical_fingerprint()
+        if lfp is None:
+            return self._plan_cache.get(key)
+        return self.ctx._plan_cache_get(
+            (lfp, self.ctx.catalog.generation) + key
+        )
+
+    def _plan_cache_put(self, key, plan) -> None:
+        lfp = self._logical_fingerprint()
+        if lfp is None:
+            self._plan_cache[key] = plan
+        else:
+            self.ctx._plan_cache_put(
+                (lfp, self.ctx.catalog.generation) + key, plan
+            )
+
+    @staticmethod
+    def _pcfg_key(cfg: PlannerConfig) -> tuple:
+        """EVERY PlannerConfig field keys the plan caches (same rule as the
+        DistributedConfig cfg_key below: a hand-picked subset silently
+        serves stale plans when e.g. max_slots changes via SET — at
+        session-cache scope a fresh ctx.sql() no longer re-plans, so the
+        key must carry the full config)."""
+        return tuple(
+            getattr(cfg, k) for k in type(cfg).__dataclass_fields__
+        )
 
     def physical_plan(self, config: Optional[PlannerConfig] = None,
                       subquery_executor=None) -> ExecutionPlan:
         cfg = config or self.ctx.config.planner
-        key = ("single", cfg.join_expansion_factor, cfg.agg_slot_factor,
-               subquery_executor is not None)
-        plan = self._plan_cache.get(key)
+        key = ("single", self._pcfg_key(cfg), subquery_executor is not None)
+        plan = self._plan_cache_get(key)
         if plan is None:
             planner = PhysicalPlanner(self.ctx.catalog, cfg, subquery_executor)
             plan = planner.plan(self.logical)
-            self._plan_cache[key] = plan
+            self._plan_cache_put(key, plan)
         return plan
 
     def collect_table(self) -> Table:
@@ -332,10 +396,9 @@ class DataFrame:
                 for k in type(cfg).__dataclass_fields__
             )
         )
-        key = ("dist", cfg_key, pcfg.join_expansion_factor,
-               pcfg.agg_slot_factor, mesh is not None, eager_subqueries,
-               coordinator is not None)
-        plan = self._plan_cache.get(key)
+        key = ("dist", cfg_key, self._pcfg_key(pcfg), mesh is not None,
+               eager_subqueries, coordinator is not None)
+        plan = self._plan_cache_get(key)
         if plan is not None:
             return plan
         subquery_executor = None
@@ -363,7 +426,7 @@ class DataFrame:
 
         planner = PhysicalPlanner(self.ctx.catalog, pcfg, subquery_executor)
         plan = distribute_plan(planner.plan(self.logical), cfg)
-        self._plan_cache[key] = plan
+        self._plan_cache_put(key, plan)
         return plan
 
     def collect_distributed_table(self, num_tasks: Optional[int] = None,
@@ -528,6 +591,27 @@ class SessionContext:
     def __init__(self, config: Optional[SessionConfig] = None):
         self.catalog = Catalog()
         self.config = config or SessionConfig()
+        # session-level physical-plan cache, keyed by (logical-plan
+        # fingerprint, catalog generation, planner knobs): distinct
+        # ctx.sql(text) submissions of the same query reuse the planned
+        # tree (and therefore every downstream compiled-program cache
+        # entry) instead of re-planning. Bounded LRU: entries pin scan
+        # Tables that may since have been de-registered.
+        self._plans: dict = {}
+
+    _PLAN_CACHE_ENTRIES = 128
+
+    def _plan_cache_get(self, key):
+        plan = self._plans.get(key)
+        if plan is not None:
+            self._plans.pop(key)
+            self._plans[key] = plan  # move-to-end: LRU
+        return plan
+
+    def _plan_cache_put(self, key, plan) -> None:
+        while len(self._plans) >= self._PLAN_CACHE_ENTRIES:
+            self._plans.pop(next(iter(self._plans)))
+        self._plans[key] = plan
 
     # -- registration ---------------------------------------------------------
     def register_parquet(self, name: str, paths, capacity: Optional[int] = None):

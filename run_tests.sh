@@ -26,7 +26,19 @@ MARKERS="${DFTPU_TEST_MARKERS-not slow}"
 MARKER_ARGS=()
 [ -n "$MARKERS" ] && MARKER_ARGS=(-m "$MARKERS")
 FAILED=()
+# Recompile-regression gate FIRST (tests/test_recompile_budget.py): three
+# TPC-H templates re-submitted with varied literals must perform zero new
+# XLA compiles (plan/fingerprint.py literal hoisting + fingerprint-keyed
+# program caches). Runs in its own young process like every other file;
+# ordering it first makes a serving-hot-path compile regression the first
+# failure an operator sees.
+echo "=== tests/test_recompile_budget.py (recompile-regression gate)"
+if ! python -m pytest tests/test_recompile_budget.py -q --no-header \
+        -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
+    FAILED+=("tests/test_recompile_budget.py[gate]")
+fi
 for f in tests/test_*.py; do
+    [ "$f" = "tests/test_recompile_budget.py" ] && continue  # ran above
     echo "=== $f"
     if ! python -m pytest "$f" -q --no-header -p no:cacheprovider \
             "${MARKER_ARGS[@]}" "$@"; then

@@ -1,0 +1,214 @@
+"""Structural plan fingerprints + literal hoisting (plan/fingerprint.py).
+
+Contract under test (ISSUE 3):
+- same SQL submitted twice (fresh ctx.sql calls) -> identical fingerprint,
+  the SAME memoized physical plan, and ZERO new XLA traces;
+- a literal-only variant of a hoistable template -> same fingerprint,
+  zero new traces, and the *variant's own* correct result (the literal
+  rides the runtime parameter vector);
+- changed string literal / changed capacity -> distinct fingerprint (those
+  are baked into the trace);
+- swapped same-shaped leaves -> shared or distinct exactly as the leaf
+  schemas dictate, never a wrong binding;
+- fingerprints stable across encode_plan/decode_plan round-trips.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from datafusion_distributed_tpu.plan import physical as phys
+from datafusion_distributed_tpu.plan.fingerprint import (
+    hoist_enabled,
+    logical_fingerprint,
+    plan_fingerprint,
+    prepare_plan,
+    set_literal_hoisting,
+)
+from datafusion_distributed_tpu.runtime.codec import (
+    TableStore,
+    decode_plan,
+    encode_plan,
+)
+from datafusion_distributed_tpu.sql.context import SessionContext
+
+
+def _arrow(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "a": rng.integers(0, 50, n).astype("int64"),
+        "b": (rng.random(n) * 10).astype("float64"),
+        "s": pa.array([["x", "y", "z"][i % 3] for i in range(n)]),
+    })
+
+
+@pytest.fixture()
+def ctx():
+    c = SessionContext()
+    c.register_arrow("t", _arrow())
+    return c
+
+
+Q = "select s, sum(b) as sb, count(*) as n from t where a > 10 group by s order by s"
+
+
+def test_identical_resubmission_zero_compiles(ctx):
+    df1 = ctx.sql(Q)
+    r1 = df1.to_pandas()
+    traces0 = phys.trace_count()
+    df2 = ctx.sql(Q)
+    r2 = df2.to_pandas()
+    assert phys.trace_count() == traces0, "identical resubmission recompiled"
+    # the session-level plan cache hands back the same physical tree
+    assert df2.physical_plan() is df1.physical_plan()
+    assert r1.equals(r2)
+
+
+def test_literal_variant_shares_via_hoisting(ctx):
+    assert hoist_enabled()
+    df1 = ctx.sql(Q)
+    df1.to_pandas()
+    traces0 = phys.trace_count()
+    q2 = Q.replace("a > 10", "a > 30")
+    df2 = ctx.sql(q2)
+    r2 = df2.to_pandas()
+    assert phys.trace_count() == traces0, "literal-only variant recompiled"
+    p1, p2 = df1.physical_plan(), df2.physical_plan()
+    assert p1 is not p2
+    assert prepare_plan(p1).fingerprint == prepare_plan(p2).fingerprint
+    # and the shared program computed the VARIANT's result, not the cached
+    # plan's: the hoisted literal entered as a runtime parameter
+    pdf = _arrow().to_pandas()
+    exp = pdf[pdf.a > 30].groupby("s").b.sum()
+    for s, v in zip(r2.s, r2.sb):
+        assert abs(exp[s] - v) < 1e-4
+
+
+def test_string_literal_change_distinct_fingerprint(ctx):
+    qx = "select s, sum(b) as sb, count(*) as n from t where a > 10 and s = 'x' group by s order by s"
+    qy = qx.replace("'x'", "'y'")
+    px = ctx.sql(qx).physical_plan()
+    py = ctx.sql(qy).physical_plan()
+    # string literals resolve against the dictionary at trace time -> baked
+    assert prepare_plan(px).fingerprint != prepare_plan(py).fingerprint
+    rx = ctx.sql(qx).to_pandas()
+    ry = ctx.sql(qy).to_pandas()
+    assert list(rx.s) == ["x"] and list(ry.s) == ["y"]
+
+
+def test_changed_capacity_distinct_fingerprint():
+    c1 = SessionContext()
+    c1.register_arrow("t", _arrow(), capacity=64)
+    c2 = SessionContext()
+    c2.register_arrow("t", _arrow(), capacity=256)
+    p1 = c1.sql(Q).physical_plan()
+    p2 = c2.sql(Q).physical_plan()
+    assert prepare_plan(p1).fingerprint != prepare_plan(p2).fingerprint
+
+
+def test_swapped_leaves_same_alias_shares_and_rebinds():
+    """Two same-shaped tables queried under the SAME alias produce equal
+    fingerprints; the shared program binds each submission's own leaf data
+    (the input pytree), so results differ correctly."""
+    ctx = SessionContext()
+    ctx.register_arrow("t1", _arrow(seed=1))
+    ctx.register_arrow("t2", _arrow(seed=2))
+    q = "select sum(b) as sb from {} as u where a > 10"
+    r1 = ctx.sql(q.format("t1")).to_pandas()
+    traces0 = phys.trace_count()
+    r2 = ctx.sql(q.format("t2")).to_pandas()
+    assert phys.trace_count() == traces0, "same-shaped leaf swap recompiled"
+    p1 = ctx.sql(q.format("t1")).physical_plan()
+    p2 = ctx.sql(q.format("t2")).physical_plan()
+    assert prepare_plan(p1).fingerprint == prepare_plan(p2).fingerprint
+    for df, seed in ((r1, 1), (r2, 2)):
+        pdf = _arrow(seed=seed).to_pandas()
+        exp = pdf[pdf.a > 10].b.sum()
+        assert abs(float(df.sb[0]) - exp) < 1e-4, (seed, float(df.sb[0]), exp)
+
+
+def test_swapped_leaves_different_alias_distinct():
+    """Different aliases qualify the leaf schemas differently -> distinct
+    fingerprints (a structural difference misses; it can never silently
+    bind the other plan's inputs)."""
+    ctx = SessionContext()
+    ctx.register_arrow("t1", _arrow(seed=1))
+    ctx.register_arrow("t2", _arrow(seed=2))
+    p1 = ctx.sql("select sum(b) as sb from t1 where a > 10").physical_plan()
+    p2 = ctx.sql("select sum(b) as sb from t2 where a > 10").physical_plan()
+    assert prepare_plan(p1).fingerprint != prepare_plan(p2).fingerprint
+
+
+def test_fingerprint_stable_across_codec_roundtrip(ctx):
+    p = ctx.sql(Q).physical_plan()
+    store = TableStore()
+    dec = decode_plan(encode_plan(p, store), store)
+    assert prepare_plan(p).fingerprint == prepare_plan(dec).fingerprint
+    # and on the raw (unhoisted) fingerprint too
+    assert plan_fingerprint(p) == plan_fingerprint(dec)
+
+
+def test_logical_fingerprint_keys_session_plan_cache(ctx):
+    df1 = ctx.sql(Q)
+    df2 = ctx.sql(Q)
+    lf1, lf2 = logical_fingerprint(df1.logical), logical_fingerprint(df2.logical)
+    assert lf1 is not None and lf1 == lf2
+    assert df1.physical_plan() is df2.physical_plan()
+    # re-registering the table bumps the catalog generation: cached plans
+    # embed the OLD device tables and must not be served
+    old = df1.physical_plan()
+    ctx.register_arrow("t", _arrow(seed=9))
+    df3 = ctx.sql(Q)
+    assert df3.physical_plan() is not old
+
+
+def test_hoisting_disabled_knob(ctx):
+    ctx.sql("set distributed.literal_hoisting = 0")
+    try:
+        assert not hoist_enabled()
+        p1 = ctx.sql(Q).physical_plan()
+        p2 = ctx.sql(Q.replace("a > 10", "a > 30")).physical_plan()
+        # without hoisting the literal is baked -> distinct fingerprints
+        assert prepare_plan(p1).fingerprint != prepare_plan(p2).fingerprint
+    finally:
+        set_literal_hoisting(True)
+
+
+def test_plan_cache_lru_bounded(ctx):
+    old_max = phys._COMPILE_CACHE_MAX
+    phys.set_plan_cache_size(2)
+    try:
+        for lim in (1, 2, 3):  # distinct LIMITs -> distinct fingerprints
+            ctx.sql(f"select a from t order by a limit {lim}").to_pandas()
+        assert len(phys._COMPILE_CACHE) <= 2
+    finally:
+        phys.set_plan_cache_size(old_max)
+
+
+def test_coordinated_resubmission_reuses_stage_programs(ctx):
+    """Worker-tier: a fresh submission of the same query through the
+    coordinator performs zero new traces (fingerprint-keyed stage-program
+    slots are shared ACROSS queries)."""
+    r1 = ctx.sql(Q).collect_coordinated(num_workers=2, num_tasks=2)
+    traces0 = phys.trace_count()
+    r2 = ctx.sql(Q).collect_coordinated(num_workers=2, num_tasks=2)
+    assert phys.trace_count() == traces0, "coordinated resubmission recompiled"
+    assert r1.to_pydict() == r2.to_pydict()
+
+
+def test_mesh_resubmission_and_variant_reuse(ctx):
+    """Mesh-tier: fresh submissions and literal variants reuse the compiled
+    SPMD program."""
+    r1 = ctx.sql(Q).collect_distributed(num_tasks=2)
+    traces0 = phys.trace_count()
+    r2 = ctx.sql(Q).collect_distributed(num_tasks=2)
+    assert phys.trace_count() == traces0, "mesh resubmission recompiled"
+    assert r1.to_pydict() == r2.to_pydict()
+    q3 = Q.replace("a > 10", "a > 30")
+    r3 = ctx.sql(q3).collect_distributed(num_tasks=2)
+    assert phys.trace_count() == traces0, "mesh literal variant recompiled"
+    pdf = _arrow().to_pandas()
+    exp = pdf[pdf.a > 30].groupby("s").b.sum()
+    got = dict(zip(r3["s"].to_pylist(), r3["sb"].to_pylist()))
+    for s, v in got.items():
+        assert abs(exp[s] - v) < 1e-4

@@ -495,10 +495,20 @@ def test_stage_share_skipped_for_isolated_arms():
         data = TaskData(key=TaskKey(qid, 0, 0), plan=arm, task_count=2)
         cache, key = w._stage_compile_cache(data.key, data)
         assert cache is None and key is None
-        # and a vanilla plan on the same worker does share
+        # and a vanilla plan on the same worker does share, keyed by the
+        # stage plan's structural fingerprint (plan/fingerprint.py)
+        from datafusion_distributed_tpu.plan.fingerprint import prepare_plan
+
         data2 = TaskData(key=TaskKey(qid, 1, 0), plan=scan, task_count=2)
         cache2, key2 = w._stage_compile_cache(data2.key, data2)
-        assert cache2 is not None and key2 == (qid, 1, 2, ())
+        fp = prepare_plan(scan).fingerprint
+        assert fp is not None
+        assert cache2 is not None and key2 == (fp, 2, ())
     finally:
         with Worker._stage_compiles_lock:
             Worker._stage_compiles.pop(qid, None)
+            from datafusion_distributed_tpu.plan.fingerprint import (
+                prepare_plan as _pp,
+            )
+
+            Worker._stage_compiles.pop(("fp", _pp(scan).fingerprint), None)
