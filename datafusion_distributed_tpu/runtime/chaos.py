@@ -3,7 +3,11 @@
 A seeded `FaultPlan` wraps a cluster's workers (`wrap_cluster` /
 `ChaosWorker`) and injects faults at the coordinator-visible call sites:
 
-  set_plan   crash-on-ship (dispatch failures)
+  set_plan   crash-on-ship (dispatch failures); kind="corrupt_plan"
+             mutates the encoded plan in transit — the worker's
+             post-decode fingerprint check (plan/verify.py DFTPU043 via
+             runtime/worker.py) must convert it into the classified fatal
+             PlanIntegrityError instead of wrong results
   execute    crash-mid-execute / transient transport errors / slow-worker
              delays, applied uniformly to execute_task,
              execute_task_stream and execute_task_partitions
@@ -50,7 +54,7 @@ class FaultSpec:
     """One fault family: where, what, how often, and bounds."""
 
     site: str  # "set_plan" | "execute"
-    kind: str = "crash"  # "crash" | "transport" | "delay"
+    kind: str = "crash"  # "crash" | "transport" | "delay" | "corrupt_plan"
     rate: float = 1.0  # per-call probability (seed-hashed, deterministic)
     delay_s: float = 0.0  # for kind="delay": injected latency
     #: restrict to these worker urls (substring match); None = any worker
@@ -162,6 +166,66 @@ def _raise_for(spec: FaultSpec, site: str, url: str, key) -> None:
     )
 
 
+#: encoded-plan int fields that are STRUCTURAL (they enter the plan
+#: fingerprint), so perturbing one yields a plan that decodes cleanly but
+#: fingerprints differently — the exact "silently different program"
+#: corruption the post-decode check exists to catch
+_CORRUPTIBLE_KEYS = ("slots", "per_dest", "capacity", "out_cap", "fetch")
+
+
+def _corrupt_plan_obj(plan_obj: dict) -> dict:
+    """Deep-copied ``plan_obj`` with the first structural int field
+    perturbed (deterministic walk: dict insertion order). The perturbed
+    value is DOUBLED, not incremented: every corruptible field is a
+    capacity-like count whose validity survives doubling (power-of-two
+    slots stay powers of two), so the corrupted plan decodes AND executes
+    cleanly — producing a silently different program, the exact hazard
+    the post-decode fingerprint check exists to catch. Falls back to
+    appending a bogus column to the first encoded schema when no numeric
+    field exists (pure-scan plans)."""
+    import copy
+
+    obj = copy.deepcopy(plan_obj)
+    done = []
+
+    def walk(o):
+        if done:
+            return
+        if isinstance(o, dict):
+            for k, v in o.items():
+                if k in _CORRUPTIBLE_KEYS and isinstance(v, int) and not (
+                    isinstance(v, bool)
+                ) and v > 0:
+                    o[k] = v * 2
+                    done.append(k)
+                    return
+            for v in o.values():
+                walk(v)
+        elif isinstance(o, list):
+            for v in o:
+                walk(v)
+
+    walk(obj)
+    if not done:
+
+        def walk_schema(o):
+            if done:
+                return
+            if isinstance(o, dict):
+                if isinstance(o.get("schema"), list):
+                    o["schema"] = o["schema"] + [["__chaos", "int32", True]]
+                    done.append("schema")
+                    return
+                for v in o.values():
+                    walk_schema(v)
+            elif isinstance(o, list):
+                for v in o:
+                    walk_schema(v)
+
+        walk_schema(obj)
+    return obj
+
+
 class ChaosWorker:
     """Fault-injecting proxy around a Worker (or any duck-typed worker
     client): intercepts the coordinator-visible call sites, delegates
@@ -179,6 +243,14 @@ class ChaosWorker:
         if spec is not None:
             if spec.kind == "delay":
                 time.sleep(spec.delay_s)
+            elif spec.kind == "corrupt_plan":
+                # in-transit corruption: a DEEP copy is mutated (the
+                # in-process transport shares the dict object with the
+                # coordinator, which must keep its pristine copy for
+                # retries/cleanup). The worker's post-decode fingerprint
+                # check must refuse this plan (PlanIntegrityError), not
+                # execute it.
+                plan_obj = _corrupt_plan_obj(plan_obj)
             else:
                 _raise_for(spec, "set_plan", self.url, key)
         return self._inner.set_plan(key, plan_obj, task_count, **kw)

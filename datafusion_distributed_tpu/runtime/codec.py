@@ -240,6 +240,45 @@ def decode_expr(o: dict) -> pe.PhysicalExpr:
 
 
 def encode_plan(p: ExecutionPlan, store: TableStore) -> dict:
+    """Encode ``p``, stamping its structural fingerprint (plan/fingerprint)
+    into the wire object under ``"_fp"``. Decoders ignore the key; workers
+    compare it against the DECODED plan's fingerprint (runtime/worker.py
+    post-decode check, diagnostic DFTPU043) so a miscoded/corrupted plan
+    becomes a classified fatal error instead of wrong results.
+
+    ``DFTPU_VERIFY_CODEC=1`` additionally round-trips the encoding through
+    decode_plan right here and fails fast (DFTPU044) on fingerprint drift —
+    the debug-mode assertion for codec changes."""
+    from datafusion_distributed_tpu.plan.fingerprint import prepare_plan
+
+    obj = _encode_plan_node(p, store)
+    fp = prepare_plan(p).fingerprint
+    if fp is not None:
+        obj["_fp"] = fp
+        import os
+
+        if os.environ.get("DFTPU_VERIFY_CODEC") == "1":
+            _verify_codec_roundtrip(p, obj, store, fp)
+    return obj
+
+
+def _verify_codec_roundtrip(p: ExecutionPlan, obj: dict, store: TableStore,
+                            fp: str) -> None:
+    from datafusion_distributed_tpu.plan.fingerprint import prepare_plan
+    from datafusion_distributed_tpu.runtime.errors import PlanIntegrityError
+
+    decoded = decode_plan(obj, store)
+    got = prepare_plan(decoded).fingerprint
+    if got != fp:
+        raise PlanIntegrityError(
+            f"DFTPU044: codec round-trip fingerprint drift for "
+            f"{type(p).__name__}: encoded plan fingerprints as {fp}, "
+            f"decode(encode(plan)) as {got} — the codec dropped or "
+            "reordered structural state (DFTPU_VERIFY_CODEC=1)"
+        )
+
+
+def _encode_plan_node(p: ExecutionPlan, store: TableStore) -> dict:
     if isinstance(p, MemoryScanExec):
         return {
             "t": "memscan",
@@ -264,12 +303,12 @@ def encode_plan(p: ExecutionPlan, store: TableStore) -> dict:
         }
     if isinstance(p, FilterExec):
         return {"t": "filter", "pred": encode_expr(p.predicate),
-                "c": encode_plan(p.child, store)}
+                "c": _encode_plan_node(p.child, store)}
     if isinstance(p, ProjectionExec):
         return {
             "t": "project",
             "exprs": [[encode_expr(e), n] for e, n in p.exprs],
-            "c": encode_plan(p.child, store),
+            "c": _encode_plan_node(p.child, store),
         }
     if isinstance(p, HashAggregateExec):
         return {
@@ -278,20 +317,20 @@ def encode_plan(p: ExecutionPlan, store: TableStore) -> dict:
             "groups": p.group_names,
             "aggs": [[a.func, a.input_name, a.output_name] for a in p.aggs],
             "slots": p.num_slots,
-            "c": encode_plan(p.child, store),
+            "c": _encode_plan_node(p.child, store),
         }
     if isinstance(p, SortExec):
         return {
             "t": "sort",
             "keys": [[k.name, k.ascending, k.nulls_first] for k in p.keys],
             "fetch": p.fetch,
-            "c": encode_plan(p.child, store),
+            "c": _encode_plan_node(p.child, store),
         }
     if isinstance(p, LimitExec):
         return {"t": "limit", "fetch": p.fetch, "skip": p.skip,
-                "c": encode_plan(p.child, store)}
+                "c": _encode_plan_node(p.child, store)}
     if isinstance(p, CoalescePartitionsExec):
-        return {"t": "coalesce_parts", "c": encode_plan(p.child, store)}
+        return {"t": "coalesce_parts", "c": _encode_plan_node(p.child, store)}
     if isinstance(p, HashJoinExec):
         return {
             "t": "hashjoin",
@@ -303,16 +342,16 @@ def encode_plan(p: ExecutionPlan, store: TableStore) -> dict:
             "slots": p.num_slots,
             "mark": p.mark_name,
             "null_aware": p.null_aware,
-            "probe": encode_plan(p.probe, store),
-            "build": encode_plan(p.build, store),
+            "probe": _encode_plan_node(p.probe, store),
+            "build": _encode_plan_node(p.build, store),
         }
     if isinstance(p, CrossJoinExec):
         return {"t": "crossjoin", "out_cap": p.out_capacity,
-                "l": encode_plan(p.left, store),
-                "r": encode_plan(p.right, store)}
+                "l": _encode_plan_node(p.left, store),
+                "r": _encode_plan_node(p.right, store)}
     if isinstance(p, UnionExec):
         return {"t": "union",
-                "cs": [encode_plan(c, store) for c in p.children()]}
+                "cs": [_encode_plan_node(c, store) for c in p.children()]}
     from datafusion_distributed_tpu.plan.window_exec import WindowExec
 
     if isinstance(p, WindowExec):
@@ -324,24 +363,47 @@ def encode_plan(p: ExecutionPlan, store: TableStore) -> dict:
             "orders": [[k.name, k.ascending, k.nulls_first]
                        for k in p.order_keys],
             "fields": encode_schema(Schema(p.out_fields)),
-            "c": encode_plan(p.child, store),
+            "c": _encode_plan_node(p.child, store),
+        }
+    from datafusion_distributed_tpu.plan.exchanges import (
+        RangeShuffleExchangeExec,
+    )
+
+    # exchange boundary state: producer_tasks and consumer_fetch are
+    # STRUCTURAL (they enter output_capacity and the plan fingerprint) —
+    # dropping them on the wire re-shaped decoded plans silently until the
+    # DFTPU043/044 integrity checks made the loss a hard error
+    if isinstance(p, RangeShuffleExchangeExec):
+        return {
+            "t": "range_shuffle",
+            "keys": [[k.name, k.ascending, k.nulls_first]
+                     for k in p.sort_keys],
+            "tasks": p.num_tasks, "per_dest": p.per_dest_capacity,
+            "stage": p.stage_id, "prod": p.producer_tasks,
+            "cfetch": p.consumer_fetch,
+            "c": _encode_plan_node(p.child, store),
         }
     if isinstance(p, ShuffleExchangeExec):
         return {"t": "shuffle", "keys": p.key_names, "tasks": p.num_tasks,
                 "per_dest": p.per_dest_capacity, "stage": p.stage_id,
-                "c": encode_plan(p.child, store)}
+                "prod": p.producer_tasks, "cfetch": p.consumer_fetch,
+                "c": _encode_plan_node(p.child, store)}
     if isinstance(p, CoalesceExchangeExec):
         return {"t": "coalesce_ex", "tasks": p.num_tasks, "stage": p.stage_id,
-                "c": encode_plan(p.child, store)}
+                "consumers": p.num_consumers,
+                "prod": p.producer_tasks, "cfetch": p.consumer_fetch,
+                "c": _encode_plan_node(p.child, store)}
     if isinstance(p, BroadcastExchangeExec):
         return {"t": "broadcast_ex", "tasks": p.num_tasks, "stage": p.stage_id,
-                "c": encode_plan(p.child, store)}
+                "prod": p.producer_tasks, "cfetch": p.consumer_fetch,
+                "c": _encode_plan_node(p.child, store)}
     if isinstance(p, PartitionReplicatedExec):
         return {"t": "partrep", "tasks": p.num_tasks, "stage": p.stage_id,
-                "c": encode_plan(p.child, store)}
+                "prod": p.producer_tasks, "cfetch": p.consumer_fetch,
+                "c": _encode_plan_node(p.child, store)}
     if isinstance(p, IsolatedArmExec):
         return {"t": "isoarm", "task": p.assigned_task,
-                "c": encode_plan(p.child, store)}
+                "c": _encode_plan_node(p.child, store)}
     from datafusion_distributed_tpu.runtime.peer import PeerShuffleScanExec
 
     if isinstance(p, PeerShuffleScanExec):
@@ -371,6 +433,13 @@ def encode_plan(p: ExecutionPlan, store: TableStore) -> dict:
         enc, _ = _USER_CODECS[kind]
         return {"t": f"user:{kind}", "body": enc(p, store)}
     raise CodecError(f"cannot encode plan node {type(p).__name__}")
+
+
+def _restore_exchange_state(n, o: dict):
+    n.stage_id = o["stage"]
+    n.producer_tasks = o.get("prod")
+    n.consumer_fetch = o.get("cfetch")
+    return n
 
 
 def decode_plan(o: dict, store: TableStore) -> ExecutionPlan:
@@ -440,23 +509,31 @@ def decode_plan(o: dict, store: TableStore) -> ExecutionPlan:
             [SortKey(n, a, nf) for n, a, nf in o["orders"]],
             list(decode_schema(o["fields"]).fields),
         )
+    if t == "range_shuffle":
+        from datafusion_distributed_tpu.plan.exchanges import (
+            RangeShuffleExchangeExec,
+        )
+
+        n = RangeShuffleExchangeExec(
+            decode_plan(o["c"], store),
+            [SortKey(nm, a, nf) for nm, a, nf in o["keys"]],
+            o["tasks"], o["per_dest"],
+        )
+        return _restore_exchange_state(n, o)
     if t == "shuffle":
         n = ShuffleExchangeExec(decode_plan(o["c"], store), o["keys"],
                                 o["tasks"], o["per_dest"])
-        n.stage_id = o["stage"]
-        return n
+        return _restore_exchange_state(n, o)
     if t == "coalesce_ex":
-        n = CoalesceExchangeExec(decode_plan(o["c"], store), o["tasks"])
-        n.stage_id = o["stage"]
-        return n
+        n = CoalesceExchangeExec(decode_plan(o["c"], store), o["tasks"],
+                                 o.get("consumers", 1))
+        return _restore_exchange_state(n, o)
     if t == "broadcast_ex":
         n = BroadcastExchangeExec(decode_plan(o["c"], store), o["tasks"])
-        n.stage_id = o["stage"]
-        return n
+        return _restore_exchange_state(n, o)
     if t == "partrep":
         n = PartitionReplicatedExec(decode_plan(o["c"], store), o["tasks"])
-        n.stage_id = o["stage"]
-        return n
+        return _restore_exchange_state(n, o)
     if t == "isoarm":
         return IsolatedArmExec(decode_plan(o["c"], store), o["task"])
     if t == "peerscan":

@@ -165,8 +165,17 @@ class Coordinator:
     def execute(self, plan: ExecutionPlan) -> Table:
         """Run a distributed plan (exchange-staged) across the workers and
         return the (replicated) root result."""
+        from datafusion_distributed_tpu.plan.verify import (
+            enforce_verification,
+        )
         from datafusion_distributed_tpu.runtime.metrics import LatencySketch
 
+        # static verification BEFORE any dispatch (plan/verify.py): a
+        # malformed staged plan is rejected here — the cheapest point — so
+        # no worker compiles/executes against it. Memoized on the plan
+        # object, so the retry loops' re-submissions verify once.
+        enforce_verification(plan, options=self.config_options,
+                             context="coordinator pre-dispatch")
         if self.latency is None:
             self.latency = LatencySketch()
         if self.expected_version is not None:

@@ -115,13 +115,26 @@ class TaskTimeoutError(WorkerError):
     retryable = True
 
 
+class PlanIntegrityError(WorkerError):
+    """A shipped plan failed its integrity check: the decoded plan's
+    structural fingerprint (plan/fingerprint.py) does not match the
+    fingerprint stamped at encode time, or a DFTPU_VERIFY_CODEC round-trip
+    drifted. Deliberately FATAL (retryable=False): the alternative to this
+    error is executing a silently-miscoded plan — wrong results with no
+    error — and re-shipping the same bytes would fail identically. Carries
+    diagnostic code DFTPU043 (worker post-decode) / DFTPU044 (codec
+    round-trip); see plan/verify.py's code registry."""
+
+    retryable = False
+
+
 #: wire-name -> class, for from_dict reconstruction. Unknown names (an older
 #: peer, a user subclass) degrade to plain WorkerError — fail-fast, never
 #: spuriously retryable.
 _WIRE_CLASSES: dict[str, type] = {
     c.__name__: c
     for c in (WorkerError, TransportError, WorkerUnavailableError,
-              TaskTimeoutError)
+              TaskTimeoutError, PlanIntegrityError)
 }
 
 

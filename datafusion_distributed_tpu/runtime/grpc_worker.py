@@ -92,10 +92,15 @@ def _handlers(worker: Worker):
     def set_plan(request: bytes, context) -> bytes:
         header, blobs = transport.unpack_frame(request)
         key = _key_from_obj(header["key"])
+        caps = header.get("table_caps") or {}
         try:
-            # materialize shipped table slices into the worker's store
+            # materialize shipped table slices into the worker's store at
+            # their ORIGINAL padded capacities (see the client-side comment
+            # on table_caps: re-padding would change the plan fingerprint)
             for tid, raw in blobs.items():
-                worker.table_store.tables[tid] = decode_table(raw)
+                worker.table_store.tables[tid] = decode_table(
+                    raw, capacity=caps.get(tid)
+                )
             worker.set_plan(key, header["plan"], header["task_count"],
                             config=header.get("config"),
                             headers=header.get("headers"),
@@ -328,6 +333,16 @@ class GrpcWorkerClient:
                 "config": config or {},
                 "headers": headers or {},
                 "ttl": ttl,
+                # padded capacities of the shipped tables: the wire payload
+                # only carries live rows, so without these the server would
+                # re-pad to pow2(rows) — changing leaf capacities, and with
+                # them the plan's structural fingerprint (breaking the
+                # post-decode DFTPU043 check AND fragmenting the
+                # stage-share compile cache by shape)
+                "table_caps": {
+                    tid: int(self.table_store.get(tid).capacity)
+                    for tid in tids
+                },
             },
             blobs,
             codec=self.compression,

@@ -327,6 +327,15 @@ class MeshWorker(Worker):
         key0 = TaskKey(query_id, stage_id, lo)
         try:
             plan = decode_plan(plan_obj, self.table_store)
+            # same post-decode integrity/verify gate as Worker.set_plan:
+            # span programs are stage-shared BY CONSTRUCTION, so a
+            # mis-decoded span plan is exactly the wrong-binding hazard
+            from datafusion_distributed_tpu.runtime.worker import (
+                _check_decoded_plan,
+            )
+
+            _check_decoded_plan(plan, plan_obj, self.url, key0,
+                                config=config)
             if self.on_plan is not None:
                 plan = self.on_plan(plan, key0)
         except Exception as e:

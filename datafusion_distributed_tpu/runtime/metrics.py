@@ -85,9 +85,29 @@ def explain_analyze(
     plan: ExecutionPlan,
     store: MetricsStore,
     per_task: bool = False,
+    diagnostics: "Optional[list]" = None,
 ) -> str:
-    """Render the plan tree with metrics stitched into each node line."""
+    """Render the plan tree with metrics stitched into each node line.
+
+    ``diagnostics``: verifier findings (plan/verify.py Diagnostic list, or
+    a VerifyResult) rendered per node id next to the runtime metrics —
+    e.g. a "literal not hoistable — plan will not share compiles" warning
+    lands on the exact Filter it applies to. None = run the verifier here
+    so explain_analyze always shows static findings alongside metrics."""
+    from datafusion_distributed_tpu.plan.verify import (
+        VerifyResult,
+        diag_suffix,
+        verify_physical_plan,
+    )
+
     node_metrics = store.per_task_view() if per_task else store.aggregated()
+    if diagnostics is None:
+        result = verify_physical_plan(plan)
+    elif isinstance(diagnostics, VerifyResult):
+        result = diagnostics
+    else:
+        result = VerifyResult(diagnostics)
+    diag_by_node = result.by_node()
     lines = []
 
     def walk(node: ExecutionPlan, indent: int) -> None:
@@ -96,6 +116,7 @@ def explain_analyze(
         if mm:
             inner = ", ".join(f"{k}={_fmt(v)}" for k, v in sorted(mm.items()))
             suffix = f"  [{inner}]"
+        suffix += diag_suffix(diag_by_node.get(node.node_id, ()))
         marker = ""
         if getattr(node, "is_exchange", False):
             marker = f" ── stage {node.stage_id}"

@@ -81,6 +81,55 @@ class TaskKey:
     task_number: int
 
 
+def _check_decoded_plan(plan: ExecutionPlan, plan_obj: dict,
+                        worker_url: str, key, config=None) -> None:
+    """Post-decode verification (plan/verify.py wiring, worker side).
+
+    1. Integrity: the decoded plan's structural fingerprint must match the
+       fingerprint stamped at encode time (``plan_obj["_fp"]``,
+       runtime/codec.py). The compiled-program caches key on this
+       fingerprint — stage-shared programs especially — so a silently
+       miscoded plan would bind another stage's compiled program to this
+       task's inputs (the physical.py wrong-binding hazard). A mismatch is
+       the classified fatal `PlanIntegrityError` (DFTPU043), never wrong
+       results. Runs before any `on_plan` hook (hooks legitimately rewrite
+       plans per task).
+    2. Static verification: under ``verify_plans=strict`` (propagated via
+       the coordinator's config options) the decoded stage plan re-runs the
+       schema/capacity passes — a defense against version-skewed decoders
+       reconstructing a structurally broken tree.
+    """
+    from datafusion_distributed_tpu.plan.verify import (
+        PlanVerificationError,
+        resolve_verify_mode,
+        verify_physical_plan,
+    )
+
+    mode = resolve_verify_mode(config)
+    if mode == "off":
+        return
+    wire_fp = plan_obj.get("_fp")
+    if wire_fp is not None:
+        from datafusion_distributed_tpu.plan.fingerprint import prepare_plan
+        from datafusion_distributed_tpu.runtime.errors import (
+            PlanIntegrityError,
+        )
+
+        got = prepare_plan(plan).fingerprint
+        if got is not None and got != wire_fp:
+            raise PlanIntegrityError(
+                f"DFTPU043: decoded plan fingerprint {got} does not match "
+                f"the wire fingerprint {wire_fp} — the plan was corrupted "
+                "in transit or mis-decoded; executing it could bind a "
+                "fingerprint-keyed compiled program to wrong inputs",
+                worker_url=worker_url, task=key,
+            )
+    if mode == "strict":
+        result = verify_physical_plan(plan, include_cache_audit=False)
+        if not result.ok:
+            raise PlanVerificationError(result, context=f"worker {worker_url} post-decode")
+
+
 @dataclass
 class TaskData:
     """Per-task state (the reference's `task_data.rs`): the decoded plan plus
@@ -399,6 +448,8 @@ class Worker:
             self._sweep_stage_compiles_locked(time.time())
         try:
             plan = decode_plan(plan_obj, self.table_store)
+            _check_decoded_plan(plan, plan_obj, self.url, key,
+                                config=config)
             if self.on_plan is not None:
                 plan = self.on_plan(plan, key)
         except Exception as e:  # structured propagation to the coordinator

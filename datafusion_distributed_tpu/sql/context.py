@@ -36,6 +36,7 @@ from datafusion_distributed_tpu.sql.logical import Binder, LogicalPlan
 from datafusion_distributed_tpu.sql.parser import (
     CreateView,
     DropView,
+    ExplainVerify,
     SetOption,
     parse_statements,
 )
@@ -159,6 +160,15 @@ class SessionConfig:
                 )
 
                 set_literal_hoisting(value)
+            elif key == "verify_plans":
+                from datafusion_distributed_tpu.plan.verify import MODES
+
+                value = str(value).strip().lower()
+                if value not in MODES:
+                    raise ValueError(
+                        f"invalid verify_plans mode {value!r} (expected "
+                        f"one of {MODES})"
+                    )
             self.distributed_options[key] = value
         elif scope == "planner":
             if not hasattr(self.planner, key):
@@ -311,6 +321,10 @@ class DataFrame:
 
     def physical_plan(self, config: Optional[PlannerConfig] = None,
                       subquery_executor=None) -> ExecutionPlan:
+        from datafusion_distributed_tpu.plan.verify import (
+            enforce_verification,
+        )
+
         cfg = config or self.ctx.config.planner
         key = ("single", self._pcfg_key(cfg), subquery_executor is not None)
         plan = self._plan_cache_get(key)
@@ -318,6 +332,13 @@ class DataFrame:
             planner = PhysicalPlanner(self.ctx.catalog, cfg, subquery_executor)
             plan = planner.plan(self.logical)
             self._plan_cache_put(key, plan)
+        # static verification at the cheapest point — before any trace/
+        # compile (plan/verify.py; memoized on the plan object, so cache
+        # hits and retry-loop re-submissions re-verify for free)
+        enforce_verification(
+            plan, options=self.ctx.config.distributed_options,
+            context="physical plan",
+        )
         return plan
 
     def collect_table(self) -> Table:
@@ -396,10 +417,21 @@ class DataFrame:
                 for k in type(cfg).__dataclass_fields__
             )
         )
+        from datafusion_distributed_tpu.plan.verify import (
+            enforce_verification,
+        )
+
+        verify_kw = dict(
+            options=self.ctx.config.distributed_options,
+            mesh_axis_size=(mesh.shape["tasks"] if mesh is not None
+                            else None),
+            context="distributed plan",
+        )
         key = ("dist", cfg_key, self._pcfg_key(pcfg), mesh is not None,
                eager_subqueries, coordinator is not None)
         plan = self._plan_cache_get(key)
         if plan is not None:
+            enforce_verification(plan, **verify_kw)
             return plan
         subquery_executor = None
         if mesh is not None:
@@ -427,6 +459,7 @@ class DataFrame:
         planner = PhysicalPlanner(self.ctx.catalog, pcfg, subquery_executor)
         plan = distribute_plan(planner.plan(self.logical), cfg)
         self._plan_cache_put(key, plan)
+        enforce_verification(plan, **verify_kw)
         return plan
 
     def collect_distributed_table(self, num_tasks: Optional[int] = None,
@@ -576,6 +609,43 @@ class DataFrame:
     def explain(self) -> str:
         return self.physical_plan().display_tree()
 
+    def explain_verify(self, num_tasks: Optional[int] = None,
+                       mesh_axis_size: Optional[int] = None
+                       ) -> "VerifyReport":
+        """The `EXPLAIN VERIFY` surface: the STAGED plan annotated with
+        every verifier diagnostic per node (plan/verify.py), plus the
+        single-node plan's diagnostics when they differ. Never raises on a
+        malformed plan — the whole point is to show what strict mode would
+        reject."""
+        from datafusion_distributed_tpu.plan.verify import (
+            render_verified_tree,
+            verify_physical_plan,
+        )
+
+        t = num_tasks or int(
+            self.ctx.config.distributed_options.get("num_tasks", 8)
+        )
+        plan = self._plan_without_enforce(t)
+        result = verify_physical_plan(plan, mesh_axis_size=mesh_axis_size)
+        return VerifyReport(render_verified_tree(plan, result), result)
+
+    def _plan_without_enforce(self, num_tasks: int):
+        """Build the staged plan with enforcement suppressed: EXPLAIN
+        VERIFY must render a strict-mode-rejected plan, not die on it."""
+        opts = self.ctx.config.distributed_options
+        saved = opts.get("verify_plans")
+        opts["verify_plans"] = "off"
+        try:
+            return self.distributed_plan(
+                num_tasks, self._seeded_distributed_config(num_tasks),
+                self.ctx.config.planner,
+            )
+        finally:
+            if saved is None:
+                opts.pop("verify_plans", None)
+            else:
+                opts["verify_plans"] = saved
+
     def explain_distributed(self, num_tasks: int = 8) -> str:
         from datafusion_distributed_tpu.planner.distributed import (
             display_staged_plan,
@@ -585,6 +655,18 @@ class DataFrame:
 
     def logical_display(self) -> str:
         return self.logical.display_tree()
+
+
+class VerifyReport(str):
+    """The result of `EXPLAIN VERIFY` / `DataFrame.explain_verify`: renders
+    as the annotated plan tree; `.result` carries the structured
+    VerifyResult and `.diagnostics` the raw Diagnostic list."""
+
+    def __new__(cls, text: str, result):
+        obj = super().__new__(cls, text)
+        obj.result = result
+        obj.diagnostics = result.diagnostics
+        return obj
 
 
 class SessionContext:
@@ -658,6 +740,12 @@ class SessionContext:
                 self.catalog.views.pop(stmt.name.lower(), None)
             elif isinstance(stmt, SetOption):
                 self.config.set_option(stmt.name, stmt.value)
+            elif isinstance(stmt, ExplainVerify):
+                binder = Binder(_ViewCatalog(self.catalog, views), views)
+                # keep looping: statements after EXPLAIN VERIFY in a
+                # multi-statement script still execute; the report is the
+                # script's result only when it is the last statement
+                result = DataFrame(self, binder.bind(stmt.query)).explain_verify()
             else:
                 binder = Binder(_ViewCatalog(self.catalog, views), views)
                 result = DataFrame(self, binder.bind(stmt))

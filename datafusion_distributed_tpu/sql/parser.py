@@ -974,6 +974,15 @@ class SetOption:
     value: Any
 
 
+@dataclass
+class ExplainVerify:
+    """EXPLAIN VERIFY <query>: plan the query, run the static verifier
+    (plan/verify.py) and return the annotated plan tree + diagnostics
+    instead of executing."""
+
+    query: Any  # Query | SetOp
+
+
 def parse_sql(sql: str):
     return Parser(sql).parse_query()
 
@@ -986,6 +995,10 @@ def parse_statements(sql: str) -> list:
     while p.peek().kind != "eof":
         if p.at_kw("with") or p.at_kw("select"):
             out.append(p._query())
+        elif p.peek().kind == "ident" and p.peek().value.lower() == "explain":
+            p.next()
+            _expect_word(p, "verify")
+            out.append(ExplainVerify(p._query()))
         elif p.peek().kind == "ident" and p.peek().value.lower() == "create":
             p.next()
             _expect_word(p, "view")
@@ -1017,6 +1030,13 @@ def parse_statements(sql: str) -> list:
                 v = t.value == "true"
             elif t.kind == "ident" and t.value.lower() in ("true", "false"):
                 v = t.value.lower() == "true"
+            elif t.kind == "ident" and parts[-1].lower() in _ENUM_SET_OPTIONS:
+                # bare-word enum values (SET distributed.verify_plans =
+                # strict); the scope handler validates the domain. Only
+                # enum-valued options accept a bare word — everywhere else
+                # a stray identifier stays a parse-time error instead of a
+                # far-away crash at the option's use site
+                v = t.value
             else:
                 p.error("expected literal value in SET")
             out.append(SetOption(".".join(parts), v))
@@ -1025,6 +1045,11 @@ def parse_statements(sql: str) -> list:
         while p.eat_sym(";"):
             pass
     return out
+
+
+#: SET options whose value is a bare-word enum rather than a literal
+#: (kept in sync with the scope handlers in sql/context.py)
+_ENUM_SET_OPTIONS = frozenset({"verify_plans"})
 
 
 def _expect_word(p: Parser, word: str) -> None:

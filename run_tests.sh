@@ -26,12 +26,35 @@ MARKERS="${DFTPU_TEST_MARKERS-not slow}"
 MARKER_ARGS=()
 [ -n "$MARKERS" ] && MARKER_ARGS=(-m "$MARKERS")
 FAILED=()
-# Recompile-regression gate FIRST (tests/test_recompile_budget.py): three
+# Tracer-safety lint gate FIRST (tools/check_tracer_safety.py): pure-AST,
+# no jax/device/network — fails in milliseconds on a tracer-coercion /
+# determinism violation not covered by tools/tracer_safety_allowlist.txt,
+# before any XLA compile is paid.
+echo "=== tools/check_tracer_safety.py (tracer-safety lint gate)"
+if ! python tools/check_tracer_safety.py; then
+    echo "LINT FAILED: tracer-safety violations (see above; intentional"
+    echo "exceptions go in tools/tracer_safety_allowlist.txt with a"
+    echo "justification)"
+    FAILED+=("tools/check_tracer_safety.py[lint-gate]")
+fi
+# Static-verifier gate SECOND (tests/test_plan_verify.py): the seeded
+# malformed-plan classes must each be rejected with their DFTPU0xx code,
+# and the snapshot-suite/inlined clean sweep must verify with zero errors
+# (the rest of the suite re-checks this implicitly: conftest exports
+# DFTPU_VERIFY_PLANS=strict, so every planned query is verified).
+echo "=== tests/test_plan_verify.py (static plan-verifier gate)"
+if ! python -m pytest tests/test_plan_verify.py -q --no-header \
+        -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
+    echo "VERIFY FAILED: static plan verifier gate (plan/verify.py)"
+    FAILED+=("tests/test_plan_verify.py[verify-gate]")
+fi
+# Recompile-regression gate (tests/test_recompile_budget.py): three
 # TPC-H templates re-submitted with varied literals must perform zero new
 # XLA compiles (plan/fingerprint.py literal hoisting + fingerprint-keyed
 # program caches). Runs in its own young process like every other file;
-# ordering it first makes a serving-hot-path compile regression the first
-# failure an operator sees.
+# ordering it ahead of the per-file loop makes a serving-hot-path compile
+# regression the first EXECUTION failure an operator sees (the two static
+# gates above it are sub-second).
 echo "=== tests/test_recompile_budget.py (recompile-regression gate)"
 if ! python -m pytest tests/test_recompile_budget.py -q --no-header \
         -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
@@ -39,6 +62,7 @@ if ! python -m pytest tests/test_recompile_budget.py -q --no-header \
 fi
 for f in tests/test_*.py; do
     [ "$f" = "tests/test_recompile_budget.py" ] && continue  # ran above
+    [ "$f" = "tests/test_plan_verify.py" ] && continue  # ran above (gate)
     echo "=== $f"
     if ! python -m pytest "$f" -q --no-header -p no:cacheprovider \
             "${MARKER_ARGS[@]}" "$@"; then

@@ -12,6 +12,11 @@ import sys
 # hard override: the harness may export JAX_PLATFORMS=axon (TPU tunnel);
 # tests always run on the virtual CPU mesh
 os.environ["JAX_PLATFORMS"] = "cpu"
+# static plan verification (plan/verify.py) runs STRICT by default under
+# tests: every planned/dispatched plan in the suite must verify clean, and
+# a verifier false-positive is itself a test failure. The library default
+# outside tests stays "warn".
+os.environ.setdefault("DFTPU_VERIFY_PLANS", "strict")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

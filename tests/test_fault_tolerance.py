@@ -284,6 +284,49 @@ def test_fatal_error_fails_fast_no_retries():
     _assert_no_leaks(cluster)
 
 
+def test_corrupted_plan_converts_to_classified_fatal_error():
+    """kind="corrupt_plan": an encoded plan mutated in transit must surface
+    as the classified, NON-retryable PlanIntegrityError (DFTPU043, the
+    worker's post-decode fingerprint check) — never as wrong results, and
+    never burning the retry budget re-shipping identical corrupt bytes."""
+    from datafusion_distributed_tpu.runtime.errors import PlanIntegrityError
+
+    cluster = InMemoryCluster(2)
+    fault = FaultPlan(CHAOS_SEED, [
+        FaultSpec(site="set_plan", kind="corrupt_plan", rate=1.0,
+                  max_total=1),
+    ])
+    coord = _coord(wrap_cluster(cluster, fault))
+    with pytest.raises(PlanIntegrityError) as ei:
+        coord.execute(_plan())
+    assert "DFTPU043" in str(ei.value)
+    assert not is_retryable(ei.value)
+    assert [f["kind"] for f in fault.fired] == ["corrupt_plan"]
+    assert coord.faults.get("task_retries") == 0
+    assert coord.faults.get("fatal_failures") == 1
+    # the error class survives the wire like the rest of the taxonomy
+    rt = WorkerError.from_dict(ei.value.to_dict())
+    assert isinstance(rt, PlanIntegrityError) and not is_retryable(rt)
+    _assert_no_leaks(cluster)
+
+
+def test_corrupt_plan_executes_fine_with_verification_off():
+    """The same corrupted-plan schedule with verify_plans=off demonstrates
+    the hazard the check closes: the plan decodes cleanly (only a capacity
+    differs) and executes — results may silently differ from the planned
+    program. The off switch exists for emergencies; this test pins that it
+    really does bypass the gate."""
+    cluster = InMemoryCluster(2)
+    fault = FaultPlan(CHAOS_SEED, [
+        FaultSpec(site="set_plan", kind="corrupt_plan", rate=1.0,
+                  max_total=1),
+    ])
+    coord = _coord(wrap_cluster(cluster, fault), verify_plans="off")
+    out = coord.execute(_plan())  # no integrity error
+    assert int(out.num_rows) > 0
+    assert [f["kind"] for f in fault.fired] == ["corrupt_plan"]
+
+
 def test_max_task_retries_zero_disables_retry():
     cluster = InMemoryCluster(2)
     plan = FaultPlan(CHAOS_SEED, [
