@@ -454,6 +454,12 @@ def main() -> None:
     sf = float(os.environ.get("BENCH_SF", "0.05"))
     budget = float(os.environ.get("BENCH_BUDGET_S", "1740"))
     cpu_reserve = float(os.environ.get("BENCH_CPU_S", "420"))
+    # Bound on how long an un-initialized TPU may gate the CPU fallback:
+    # if no successful init within this window, the CPU child spawns NOW
+    # (the TPU child keeps trying and its results still take precedence).
+    # BENCH_r05: a failed axon init burned 1508s before the reserve-point
+    # fallback ran the entire 5.7s CPU suite it was gating. 0 disables.
+    init_timeout = float(os.environ.get("DFTPU_TPU_INIT_TIMEOUT_S", "120"))
     started = time.time()
     deadline = started + budget
     cpu_start_at = deadline - cpu_reserve if cpu_reserve > 0 else None
@@ -557,6 +563,7 @@ def main() -> None:
     cpu_spawned = False
     tpu_pending = True   # False once the primary child exits or is done
     tpu_done = False     # primary child emitted its done event
+    tpu_init_seen = False  # primary child emitted a successful init event
 
     while time.time() < deadline - 5:
         events, offset = _read_events(_EVENTS, offset)
@@ -565,6 +572,8 @@ def main() -> None:
             kind = ev.get("event")
             plat = "tpu" if ev.get("platform", "axon") == primary else "cpu"
             if kind == "init":
+                if plat == "tpu":
+                    tpu_init_seen = True
                 state["meta"][f"{plat}_init"] = {
                     k: ev[k] for k in
                     ("init_s", "devices", "device_kind") if k in ev}
@@ -621,11 +630,17 @@ def main() -> None:
             if (tpu_done or state["tpu"] or cpu_spawned
                     or cpu_start_at is None or primary != "axon"):
                 break
-        # fallback trigger: no TPU init by the reserve point, or the TPU
-        # child conclusively failed without completing the suite
+        # fallback trigger: no successful TPU init within
+        # DFTPU_TPU_INIT_TIMEOUT_S (the bounded init window — the CPU
+        # suite must not sit behind a wedged/failing tunnel claim), no
+        # TPU init by the reserve point, or the TPU child conclusively
+        # failed without completing the suite
         if (cpu_start_at is not None and not cpu_spawned
                 and primary == "axon" and not tpu_done
-                and (time.time() >= cpu_start_at or not tpu_pending)):
+                and (time.time() >= cpu_start_at
+                     or not tpu_pending
+                     or (init_timeout > 0 and not tpu_init_seen
+                         and time.time() >= started + init_timeout))):
             cpu_child = _spawn_child(qlist, deadline, "cpu")
             cpu_spawned = True
         time.sleep(2.0)

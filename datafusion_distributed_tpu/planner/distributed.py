@@ -776,6 +776,109 @@ def _prepare(plan: ExecutionPlan) -> ExecutionPlan:
     return walk(plan)
 
 
+@dataclass
+class StageDagNode:
+    """One schedulable stage: an exchange boundary whose producer subtree
+    runs as a worker-task fan-out. ``deps`` are the stage ids of the
+    exchanges on the producer subtree's FRONTIER — the stages whose
+    materialized output this one consumes (node = stage, edge = data
+    dependency; the reference fans all stage work out as concurrent async
+    sends, `query_coordinator.rs:140-222`)."""
+
+    stage_id: int
+    exchange: ExecutionPlan
+    deps: tuple = ()
+
+
+@dataclass
+class StageDag:
+    """Dependency graph of a staged plan's exchange subtrees. Because each
+    exchange has exactly one consumer in the plan tree, the graph is a
+    tree of stages — what the concurrent scheduler exploits is SIBLING
+    independence: a hash join's build and probe feeds, the 2+ producer
+    stages of every co-shuffled group, union branches, independent scans
+    share no edges and may run concurrently."""
+
+    nodes: dict  # stage_id -> StageDagNode
+    root_deps: tuple  # frontier stage ids of the root consumer stage
+
+    def schedulable_order(self) -> list:
+        """Deterministic topological order (ascending stage_id within each
+        ready frontier) — with stage_parallelism=1 this reproduces the
+        depth-first recursion's post-order exactly, because `_prepare`
+        stamps stage ids in the same post-order walk."""
+        waiting = {sid: set(n.deps) for sid, n in self.nodes.items()}
+        order: list = []
+        while waiting:
+            ready = sorted(s for s, deps in waiting.items() if not deps)
+            if not ready:  # cycle — cannot happen for tree-shaped plans
+                raise ValueError("stage DAG has a cycle")
+            for s in ready:
+                order.append(s)
+                del waiting[s]
+            for deps in waiting.values():
+                deps.difference_update(ready)
+        return order
+
+
+def exchange_frontier(node: ExecutionPlan) -> list:
+    """The exchange nodes reachable from ``node`` without crossing another
+    exchange boundary — the stages whose output the stage headed at
+    ``node`` directly consumes."""
+    out: list = []
+    for c in node.children():
+        if getattr(c, "is_exchange", False):
+            out.append(c)
+        else:
+            out.extend(exchange_frontier(c))
+    return out
+
+
+def build_stage_dag(plan: ExecutionPlan) -> Optional[StageDag]:
+    """Extract the stage dependency DAG from a staged plan, or None when
+    the plan is not DAG-schedulable and the caller must fall back to the
+    sequential depth-first recursion: exchanges missing a stamped
+    stage_id (hand-built plans that never went through `_prepare`),
+    duplicate stage ids, or a shared exchange OBJECT appearing twice in
+    the tree (the recursion materializes it once per occurrence; the DAG
+    would silently dedupe, changing semantics)."""
+    exchanges: list = []
+    seen_objs: set = set()
+    dup = [False]
+
+    def walk(node: ExecutionPlan) -> None:
+        if dup[0]:
+            return
+        if getattr(node, "is_exchange", False):
+            if id(node) in seen_objs:
+                dup[0] = True
+                return
+            seen_objs.add(id(node))
+            exchanges.append(node)
+        for c in node.children():
+            walk(c)
+
+    walk(plan)
+    if dup[0]:
+        return None
+    sids = [e.stage_id for e in exchanges]
+    if any(s is None for s in sids) or len(set(sids)) != len(sids):
+        return None
+    nodes = {
+        e.stage_id: StageDagNode(
+            e.stage_id, e,
+            deps=tuple(f.stage_id
+                       for f in exchange_frontier(e.children()[0])),
+        )
+        for e in exchanges
+    }
+    if getattr(plan, "is_exchange", False):
+        root_deps = (plan.stage_id,)
+    else:
+        root_deps = tuple(f.stage_id for f in exchange_frontier(plan))
+    return StageDag(nodes=nodes, root_deps=root_deps)
+
+
 def collect_stages(plan: ExecutionPlan) -> list:
     """[(stage_id, exchange node)] in bottom-up order, for display/metrics."""
     out = []

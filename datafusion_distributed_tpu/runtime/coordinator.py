@@ -45,12 +45,16 @@ from datafusion_distributed_tpu.plan.physical import (
 )
 from datafusion_distributed_tpu.runtime.codec import TableStore, encode_plan
 from datafusion_distributed_tpu.runtime.errors import (
+    TaskCancelledError,
     TaskTimeoutError,
     WorkerError,
     WorkerUnavailableError,
     is_retryable,
 )
-from datafusion_distributed_tpu.runtime.metrics import FaultCounters
+from datafusion_distributed_tpu.runtime.metrics import (
+    FaultCounters,
+    MetricsStore,
+)
 from datafusion_distributed_tpu.runtime.worker import (
     TaskKey,
     Worker,
@@ -75,6 +79,19 @@ FAULT_TOLERANCE_DEFAULTS = {
     "quarantine_threshold": 3,
     "quarantine_seconds": 30.0,
 }
+
+#: stage-DAG scheduler knobs (`SET distributed.stage_parallelism`):
+#: bounded in-flight budget for CONCURRENT STAGES — how many independent
+#: exchange subtrees may materialize at once. 0 = auto (the worker
+#: count); 1 = the sequential depth-first order (pre-scheduler
+#: behavior, byte-identical results by design at any setting).
+SCHEDULER_DEFAULTS = {
+    "stage_parallelism": 0,
+}
+
+#: single lookup for every `SET distributed.*` knob default the
+#: coordinator reads through _opt_int/_opt_float
+_OPTION_DEFAULTS = {**FAULT_TOLERANCE_DEFAULTS, **SCHEDULER_DEFAULTS}
 
 
 def _terminal(exc: WorkerError) -> WorkerError:
@@ -161,6 +178,18 @@ class Coordinator:
     health: "object" = None
     # retry/quarantine/timeout counters (runtime/metrics.py FaultCounters)
     faults: FaultCounters = field(default_factory=FaultCounters)
+    # per-stage scheduler spans + query walls (runtime/metrics.py), the
+    # observability surface of the stage-DAG scheduler: explain_analyze
+    # renders them as a critical-path summary whose overlap factor
+    # (sum stage wall / query wall) proves inter-stage overlap
+    stage_metrics: MetricsStore = field(default_factory=MetricsStore)
+
+    def overlap_factor(self, query_id: Optional[str] = None):
+        """sum(stage wall) / query wall for ``query_id`` (default: most
+        recent). >1.0 means independent stages genuinely overlapped."""
+        return self.stage_metrics.stage_schedule_summary(query_id).get(
+            "overlap_factor"
+        )
 
     def execute(self, plan: ExecutionPlan) -> Table:
         """Run a distributed plan (exchange-staged) across the workers and
@@ -181,6 +210,10 @@ class Coordinator:
         if self.expected_version is not None:
             self._check_worker_versions()
         query_id = uuid.uuid4().hex
+        # stamp the submitted plan object with its query id so
+        # explain_analyze can bind the stage-schedule block to THIS
+        # query's spans (a long-lived coordinator holds spans for many)
+        plan._last_query_id = query_id
         # producer tasks shipped but never coordinator-executed (peer data
         # plane): released at query end — the reference's query-end EOS
         # notifier role (`query_coordinator.rs:188-192`)
@@ -192,16 +225,35 @@ class Coordinator:
         # leaked first shipment).
         self._span_shipped: dict = {}
         self._span_ok_cache: dict = {}
+        import time as _time
         import threading as _threading
 
         self._span_lock = _threading.Lock()
+        # per-query cancel event: the FIRST fatal error sets it, and every
+        # dispatch/execute path checks it before doing work — a failed
+        # sibling stage/task cancels in-flight and not-yet-submitted work
+        # instead of leaving orphaned tasks running (and their staged
+        # TableStore slices leaking until TTL)
+        self._cancel_event = _threading.Event()
+        q_t0 = _time.monotonic()
         try:
             resolved = self._materialize_exchanges(plan, query_id)
             # the root stage: a single consumer task
+            r_t0 = _time.monotonic()
             out = self._run_stage_task(
                 resolved, query_id, stage_id=-1, task_number=0, task_count=1
             )
+            r_t1 = _time.monotonic()
+            self.stage_metrics.record_stage_span(
+                query_id, -1, r_t0, r_t0, r_t1, plane="root"
+            )
+            self.stage_metrics.record_query_wall(
+                query_id, r_t1 - q_t0
+            )
             return out
+        except BaseException:
+            self._signal_cancel()
+            raise
         finally:
             for worker, key in self._peer_shipped:
                 try:
@@ -236,15 +288,194 @@ class Coordinator:
     def _materialize_exchanges(
         self, plan: ExecutionPlan, query_id: str
     ) -> ExecutionPlan:
+        """Materialize every exchange boundary, bottom-up.
+
+        Two schedulers produce byte-identical results:
+
+        - `stage_parallelism > 1` (default: the worker count): the stage-
+          DAG scheduler — one pass builds the dependency graph of
+          exchange subtrees (planner/distributed.py build_stage_dag),
+          then every dependency-free stage is submitted to a bounded pool
+          concurrently and consumers release as their feeds materialize.
+          Sibling subtrees — a hash join's build and probe sides, the
+          producer stages of every co-shuffled group, union branches —
+          overlap across the cluster instead of idling the worker pool
+          between them (the reference's concurrent async fan-out,
+          `query_coordinator.rs:140-222`).
+        - `stage_parallelism = 1`, or a plan build_stage_dag cannot
+          schedule: the sequential depth-first recursion (pre-scheduler
+          behavior).
+        """
+        par = self._stage_parallelism()
+        dag = None
+        if par > 1:
+            from datafusion_distributed_tpu.planner.distributed import (
+                build_stage_dag,
+            )
+
+            dag = build_stage_dag(plan)
+        if dag is None or len(dag.nodes) <= 1:
+            return self._materialize_exchanges_sequential(plan, query_id)
+        return self._materialize_exchanges_dag(plan, query_id, dag, par)
+
+    def _stage_parallelism(self) -> int:
+        """`SET distributed.stage_parallelism`: the in-flight stage budget
+        (memory control — every in-flight stage holds its producer outputs).
+        0/unset = auto: the worker count."""
+        n = self._opt_int("stage_parallelism")
+        if n <= 0:
+            try:
+                n = max(len(self.resolver.get_urls()), 1)
+            except Exception:
+                n = 1
+        return n
+
+    def _materialize_exchanges_sequential(
+        self, plan: ExecutionPlan, query_id: str
+    ) -> ExecutionPlan:
         children = [
-            self._materialize_exchanges(c, query_id) for c in plan.children()
+            self._materialize_exchanges_sequential(c, query_id)
+            for c in plan.children()
         ]
         if children:
             plan = plan.with_new_children(children)
         if not getattr(plan, "is_exchange", False):
             return plan
+        import time as _time
 
-        producer = plan.children()[0]
+        t0 = _time.monotonic()
+        scan = self._materialize_exchange_node(
+            plan, plan.children()[0], query_id
+        )
+        sid = plan.stage_id if plan.stage_id is not None else 0
+        self._record_stage_span(query_id, sid, t0, t0, _time.monotonic())
+        return scan
+
+    def _materialize_exchanges_dag(
+        self, plan: ExecutionPlan, query_id: str, dag, parallelism: int
+    ) -> ExecutionPlan:
+        """Event-driven stage scheduler: submit every dependency-free stage
+        to a bounded pool, release consumers as their feeds materialize.
+        All DAG bookkeeping runs on THIS thread (no lock needed); stage
+        jobs only materialize their own exchange. The first fatal error
+        sets the per-query cancel event — in-flight stages abort at their
+        next dispatch/execute checkpoint and release their staged slices,
+        not-yet-ready stages never submit — and the error re-raises after
+        the in-flight jobs drained (deterministic teardown)."""
+        import concurrent.futures as cf
+        import time as _time
+
+        nodes = dag.nodes
+        resolved: dict = {}  # stage_id -> consumer-side scan
+
+        def resolve(node: ExecutionPlan) -> ExecutionPlan:
+            # rebuild `node`'s subtree with every frontier exchange
+            # replaced by its materialized scan (never descends past an
+            # exchange boundary — nested exchanges live inside their
+            # consumer's already-resolved subtree)
+            if getattr(node, "is_exchange", False):
+                return resolved[node.stage_id]
+            children = [resolve(c) for c in node.children()]
+            return node.with_new_children(children) if children else node
+
+        waiting = {sid: set(n.deps) for sid, n in nodes.items()}
+        consumers: dict = {}
+        for sid, n in nodes.items():
+            for d in n.deps:
+                consumers.setdefault(d, []).append(sid)
+        first_error: Optional[BaseException] = None
+        first_cancel: Optional[BaseException] = None
+
+        def job(exchange, submit_s):
+            self._check_cancelled()
+            t0 = _time.monotonic()
+            producer = resolve(exchange.children()[0])
+            scan = self._materialize_exchange_node(
+                exchange, producer, query_id
+            )
+            return scan, submit_s, t0, _time.monotonic()
+
+        with cf.ThreadPoolExecutor(
+            max_workers=parallelism, thread_name_prefix="dftpu-stage"
+        ) as pool:
+            futs: dict = {}
+
+            def submit(sid: int) -> None:
+                futs[pool.submit(
+                    job, nodes[sid].exchange, _time.monotonic()
+                )] = sid
+
+            for sid in sorted(
+                s for s, deps in waiting.items() if not deps
+            ):
+                submit(sid)
+            while futs:
+                done, _ = cf.wait(
+                    list(futs), return_when=cf.FIRST_COMPLETED
+                )
+                for f in sorted(done, key=lambda f: futs[f]):
+                    sid = futs.pop(f)
+                    try:
+                        scan, sub_s, t0, t1 = f.result()
+                    except TaskCancelledError as e:
+                        if first_cancel is None:
+                            first_cancel = e
+                        continue
+                    except BaseException as e:
+                        if first_error is None:
+                            first_error = e
+                        self._signal_cancel()
+                        continue
+                    resolved[sid] = scan
+                    self._record_stage_span(query_id, sid, sub_s, t0, t1)
+                    for c in sorted(consumers.get(sid, ())):
+                        waiting[c].discard(sid)
+                        ev = getattr(self, "_cancel_event", None)
+                        if not waiting[c] and first_error is None and (
+                            ev is None or not ev.is_set()
+                        ):
+                            submit(c)
+        if first_error is not None:
+            raise first_error
+        if first_cancel is not None:
+            # only cancellations surfaced: something upstream (another
+            # thread sharing this coordinator) set the event — propagate
+            raise first_cancel
+        return resolve(plan)
+
+    def _record_stage_span(self, query_id: str, stage_id: int,
+                           submit_s: float, start_s: float,
+                           end_s: float) -> None:
+        sm = self.stream_metrics.get((query_id, stage_id))
+        plane = (sm.get("plane", "stream") if sm else "bulk")
+        self.stage_metrics.record_stage_span(
+            query_id, stage_id, submit_s, start_s, end_s, plane=plane
+        )
+
+    # -- per-query cancellation ---------------------------------------------
+    def _check_cancelled(self) -> None:
+        """Raise if this query's cancel event is set (a sibling stage or
+        task already failed fatally). Checked at every dispatch/execute
+        boundary so orphaned work stops instead of running to completion
+        against a query that can no longer succeed."""
+        ev = getattr(self, "_cancel_event", None)
+        if ev is not None and ev.is_set():
+            raise TaskCancelledError(
+                "query cancelled: a sibling stage/task failed"
+            )
+
+    def _signal_cancel(self) -> None:
+        ev = getattr(self, "_cancel_event", None)
+        if ev is not None:
+            ev.set()
+
+    def _materialize_exchange_node(
+        self, plan: ExecutionPlan, producer: ExecutionPlan, query_id: str
+    ) -> ExecutionPlan:
+        """Materialize ONE exchange whose producer subtree is fully
+        resolved (every nested boundary already a scan): run the producer
+        stage through the appropriate data plane and return the
+        consumer-side scan."""
         stage_id = plan.stage_id if plan.stage_id is not None else 0
         t_prod = self._producer_task_count(plan, producer)
         if self._peer_plane_enabled(plan):
@@ -764,6 +995,12 @@ class Coordinator:
                                             rows, width)
                 return [f.result() for f in futs]
             except BaseException:
+                # `f.cancel()` only stops futures that never STARTED; the
+                # per-query cancel event reaches the in-flight ones — they
+                # abort at their next dispatch/execute checkpoint and
+                # release any already-staged slices (satellite of ISSUE 5:
+                # no orphaned tasks, no TTL-leaked TableStore entries)
+                self._signal_cancel()
                 for f in futs:
                     f.cancel()
                 raise
@@ -780,10 +1017,22 @@ class Coordinator:
         state = _RetryState()
         kt = (query_id, stage_id, task_number)
         while True:
+            self._check_cancelled()
             worker, key, plan_obj, store = self._dispatch_task_with_retry(
                 stage_plan, query_id, stage_id, task_number, task_count,
                 state=state,
             )
+            try:
+                self._check_cancelled()
+            except TaskCancelledError:
+                # a sibling failed while this task was shipping: release
+                # the just-staged slices NOW instead of leaking them until
+                # the worker registry's TTL sweep
+                try:
+                    self._cleanup_task(worker, key, plan_obj, store)
+                except Exception:
+                    pass
+                raise
             try:
                 try:
                     out = self._execute_with_deadline(worker, key)
@@ -862,14 +1111,14 @@ class Coordinator:
         return hit
 
     def _opt_float(self, name: str) -> float:
-        default = FAULT_TOLERANCE_DEFAULTS.get(name, 0.0)
+        default = _OPTION_DEFAULTS.get(name, 0.0)
         try:
             return float(self.config_options.get(name, default) or 0.0)
         except (TypeError, ValueError):
             return float(default)
 
     def _opt_int(self, name: str) -> int:
-        default = FAULT_TOLERANCE_DEFAULTS.get(name, 0)
+        default = _OPTION_DEFAULTS.get(name, 0)
         try:
             return int(self.config_options.get(name, default))
         except (TypeError, ValueError):
@@ -965,6 +1214,7 @@ class Coordinator:
         state = state if state is not None else _RetryState()
         kt = (query_id, stage_id, task_number)
         while True:
+            self._check_cancelled()
             try:
                 disp = self._dispatch_task(
                     stage_plan, query_id, stage_id, task_number, task_count,
@@ -1000,6 +1250,7 @@ class Coordinator:
         kt = (query_id, stage_id, task_number)
         done = object()  # first-chunk sentinel: body produced nothing
         while True:
+            self._check_cancelled()
             worker, key, plan_obj, store = self._dispatch_task_with_retry(
                 stage_plan, query_id, stage_id, task_number, task_count,
                 ttl=ttl, state=state,
@@ -1315,6 +1566,10 @@ class AdaptiveCoordinator(Coordinator):
         self._group_members: dict = {}
         self._group_heads: dict = {}
         self._group_pending: dict = {}
+        # serializes group registration under the concurrent stage-DAG
+        # scheduler (members of one co-shuffled group materialize in
+        # sibling threads; the last-one-in decide must fire exactly once)
+        self._group_lock = threading.Lock()
         #: stage_id -> (consumer head node, original exchange node_id) for
         #: the stage-cost model (compute_based_task_count analogue)
         self._stage_heads: dict = {}
@@ -1462,21 +1717,35 @@ class AdaptiveCoordinator(Coordinator):
         """Co-shuffled siblings defer their regroup until EVERY member of
         the group has materialized its producers; the shared consumer count
         is then decided once from the combined statistics. Solo shuffles
-        keep the immediate path (base + adaptive `_consumer_task_count`)."""
+        keep the immediate path (base + adaptive `_consumer_task_count`).
+
+        Under the stage-DAG scheduler the group members materialize
+        CONCURRENTLY, so the group decision is a real barrier now, not a
+        recursion-order artifact: registration is serialized by
+        `_group_lock` and exactly the member that completes the group runs
+        `_decide_group` (before its own stage job returns — the DAG edges
+        guarantee the consumer stage is only released after every feed's
+        job finished, i.e. after the decision filled the placeholders)."""
         gid = self._group_of.get(exchange.stage_id)
         if gid is None:
             return super()._finish_shuffle(exchange, outputs, producer)
-        pend = self._group_pending.setdefault(gid, {})
         # placeholder scan, filled in-place when the group decides: the
         # consumer stage only reads it after all its feeds materialized
-        # (the recursion finishes every feed before the parent stage runs)
+        # (sequential: recursion order; DAG: dependency edges + the
+        # synchronous decide below)
         scan = MemoryScanExec([], producer.schema())
-        pend[exchange.stage_id] = (exchange, outputs, scan)
-        if len(pend) == len(self._group_members[gid]):
-            self._decide_group(gid)
+        complete = None
+        with self._group_lock:
+            pend = self._group_pending.setdefault(gid, {})
+            pend[exchange.stage_id] = (exchange, outputs, scan)
+            if len(pend) == len(self._group_members[gid]):
+                complete = self._group_pending.pop(gid)
+        if complete is not None:
+            # heavy work (hash regroup) deliberately OUTSIDE the lock
+            self._decide_group(gid, complete)
         return scan
 
-    def _decide_group(self, gid) -> None:
+    def _decide_group(self, gid, pend) -> None:
         from datafusion_distributed_tpu.planner.statistics import (
             PlanStatistics,
             compute_based_task_count,
@@ -1484,12 +1753,16 @@ class AdaptiveCoordinator(Coordinator):
             stage_cost,
         )
 
-        pend = self._group_pending.pop(gid)
         head = self._group_heads[gid]
         planned = min(ex.num_tasks for ex, _, _ in pend.values())
         total_bytes = 0
         rows_stats: dict = {}
-        for sid, (ex, outputs, _scan) in pend.items():
+        # deterministic iteration: under the DAG scheduler dict insertion
+        # order is COMPLETION order, which varies run to run — the
+        # decision's inputs are order-independent sums/mins, but the
+        # regroup + decision log below must not be
+        for sid in sorted(pend):
+            (ex, outputs, _scan) = pend[sid]
             pred = self._predicted.get(sid)
             if pred is not None:
                 rows, nbytes = pred.rows, pred.bytes
@@ -1510,7 +1783,8 @@ class AdaptiveCoordinator(Coordinator):
             cost, float(max(self.bytes_per_task, 1)), planned
         )
         t = min(planned, max(t_bytes, t_cost))
-        for sid, (ex, outputs, scan) in pend.items():
+        for sid in sorted(pend):
+            (ex, outputs, scan) = pend[sid]
             scan.tasks[:] = _shuffle_regroup(
                 outputs, ex.key_names, t, ex.per_dest_capacity
             )

@@ -115,6 +115,17 @@ class TaskTimeoutError(WorkerError):
     retryable = True
 
 
+class TaskCancelledError(QueryError):
+    """The per-query cancel event was set (a sibling stage/task failed
+    fatally) before this task dispatched or executed. Deliberately NOT a
+    WorkerError: cancellation is coordinator-initiated teardown, so it
+    must neither count against any worker's health nor bump the
+    fatal-failure counters — the ORIGINAL sibling error is the one the
+    query surfaces."""
+
+    retryable = False
+
+
 class PlanIntegrityError(WorkerError):
     """A shipped plan failed its integrity check: the decoded plan's
     structural fingerprint (plan/fingerprint.py) does not match the

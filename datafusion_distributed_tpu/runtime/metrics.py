@@ -20,15 +20,126 @@ from typing import Any, Optional
 from datafusion_distributed_tpu.plan.physical import ExecutionPlan
 
 
+#: bound on distinct queries whose stage spans a MetricsStore retains
+#: (oldest evicted first — a long-lived coordinator must not grow forever)
+_STAGE_SPAN_QUERY_CAP = 64
+
+
 @dataclass
 class MetricsStore:
     """(task_label -> node_id -> {metric: value}); the watch-map analogue of
-    the reference's MetricsStore (`metrics_store.rs`)."""
+    the reference's MetricsStore (`metrics_store.rs`). Also holds the
+    concurrent stage scheduler's per-stage wall-clock spans
+    (submit -> start -> materialized) and per-query wall clocks, rendered
+    by `explain_analyze` as a critical-path summary whose
+    `sum(stage wall) / query wall` overlap factor is the proof that
+    independent stages actually ran concurrently."""
 
     per_task: dict = field(default_factory=dict)
+    #: query_id -> {stage_id: {"submit_s","start_s","end_s","wall_s",
+    #:                          "queue_s","plane"}} (insertion-ordered)
+    stage_spans: dict = field(default_factory=dict)
+    #: query_id -> total query wall seconds
+    query_walls: dict = field(default_factory=dict)
 
     def insert(self, task_label: str, node_metrics: dict) -> None:
         self.per_task[task_label] = node_metrics
+
+    # -- stage scheduling spans ---------------------------------------------
+    def record_stage_span(self, query_id: str, stage_id: int,
+                          submit_s: float, start_s: float, end_s: float,
+                          plane: str = "") -> None:
+        """One stage's scheduler span, in seconds on a shared monotonic
+        clock: ``submit_s`` when the scheduler enqueued it, ``start_s``
+        when a pool slot picked it up, ``end_s`` when its output
+        materialized. ``wall_s`` (start->end) is the stage's true
+        execution span; queue wait is reported separately so a bounded
+        stage_parallelism does not inflate the overlap arithmetic."""
+        spans = self.stage_spans.setdefault(query_id, {})
+        spans[stage_id] = {
+            "submit_s": submit_s,
+            "start_s": start_s,
+            "end_s": end_s,
+            "wall_s": max(end_s - start_s, 0.0),
+            "queue_s": max(start_s - submit_s, 0.0),
+            "plane": plane,
+        }
+        while len(self.stage_spans) > _STAGE_SPAN_QUERY_CAP:
+            self.stage_spans.pop(next(iter(self.stage_spans)))
+
+    def record_query_wall(self, query_id: str, wall_s: float) -> None:
+        self.query_walls[query_id] = wall_s
+        while len(self.query_walls) > _STAGE_SPAN_QUERY_CAP:
+            self.query_walls.pop(next(iter(self.query_walls)))
+
+    def _span_query(self, query_id: Optional[str]) -> Optional[str]:
+        if query_id is not None:
+            return query_id if query_id in self.stage_spans else None
+        return next(reversed(self.stage_spans), None)
+
+    def stage_schedule_summary(self, query_id: Optional[str] = None) -> dict:
+        """{"query_id", "stages", "sum_stage_wall_s", "query_wall_s",
+        "overlap_factor", "max_concurrent"} for ``query_id`` (default: the
+        most recent query). overlap_factor = sum(stage wall)/query wall —
+        1.0 means fully serial; >1.0 proves inter-stage overlap.
+        max_concurrent is the peak number of stage spans covering one
+        instant (computed from the recorded intervals)."""
+        qid = self._span_query(query_id)
+        if qid is None:
+            return {}
+        spans = self.stage_spans[qid]
+        total = sum(s["wall_s"] for s in spans.values())
+        wall = self.query_walls.get(qid)
+        events = []
+        for s in spans.values():
+            events.append((s["start_s"], 1))
+            events.append((s["end_s"], -1))
+        peak = cur = 0
+        for _, d in sorted(events):
+            cur += d
+            peak = max(peak, cur)
+        return {
+            "query_id": qid,
+            "stages": dict(spans),
+            "sum_stage_wall_s": total,
+            "query_wall_s": wall,
+            "overlap_factor": (total / wall) if wall else None,
+            "max_concurrent": peak,
+        }
+
+    def render_stage_schedule(self, query_id: Optional[str] = None) -> str:
+        """Human-readable critical-path summary (explain_analyze appends
+        this below the plan tree when spans exist)."""
+        s = self.stage_schedule_summary(query_id)
+        if not s:
+            return ""
+        lines = [f"-- stage schedule (query {s['query_id'][:8]}) --"]
+        t0 = min(
+            (sp["submit_s"] for sp in s["stages"].values()), default=0.0
+        )
+        for sid in sorted(s["stages"]):
+            sp = s["stages"][sid]
+            label = "root " if sid == -1 else f"stage {sid}"
+            plane = f"  [{sp['plane']}]" if sp.get("plane") else ""
+            lines.append(
+                f"{label:<9} wall {sp['wall_s']:.4f}s  "
+                f"+{sp['start_s'] - t0:.4f}s start  "
+                f"queue {sp['queue_s']:.4f}s{plane}"
+            )
+        wall = s["query_wall_s"]
+        if wall:
+            lines.append(
+                f"sum(stage wall) {s['sum_stage_wall_s']:.4f}s / "
+                f"query wall {wall:.4f}s = overlap factor "
+                f"{s['overlap_factor']:.2f}x "
+                f"(peak {s['max_concurrent']} concurrent stages)"
+            )
+        else:
+            lines.append(
+                f"sum(stage wall) {s['sum_stage_wall_s']:.4f}s "
+                f"(peak {s['max_concurrent']} concurrent stages)"
+            )
+        return "\n".join(lines)
 
     def aggregated(self) -> dict:
         """node_id -> {metric: summed value across tasks}."""
@@ -125,6 +236,16 @@ def explain_analyze(
             walk(c, indent + 1)
 
     walk(plan, 0)
+    # the schedule block binds to THIS plan's execution (the coordinator
+    # stamps `_last_query_id` at submit): a store holding spans for many
+    # queries must not render some other query's critical path here —
+    # an unstamped plan (never coordinator-executed) renders none
+    qid = getattr(plan, "_last_query_id", None)
+    if qid is not None and store.stage_spans:
+        schedule = store.render_stage_schedule(qid)
+        if schedule:
+            lines.append("")
+            lines.append(schedule)
     return "\n".join(lines)
 
 

@@ -172,6 +172,31 @@ class TaskData:
 
 RESERVED_HEADER_PREFIX = "x-dftpu-"
 
+#: The ONLY config keys a traced program reads through
+#: `ExecContext.config` (physical.py `collect_metrics`, exchanges.py
+#: `mesh_axis` — tests/test_stage_scheduler.py pins the inventory by AST
+#: scan). The stage-compile shared key keeps exactly these: everything
+#: else in `SET distributed.*` is coordinator-side plumbing (scheduling,
+#: fault tolerance, planning) that rides along in the shipped config, and
+#: flipping it — stage_parallelism, peer_shuffle, a retry budget — must
+#: NOT force an XLA recompile of structurally identical stages. An
+#: allow-list closes the class, not just the known knobs; any NEW
+#: `ExecContext.config` read in traced code must add its key here.
+TRACE_RELEVANT_CONFIG_KEYS = frozenset({
+    "mesh_axis",
+    "collect_metrics",
+})
+
+#: each key's READ-SITE default: the shared key normalizes by dropping
+#: entries equal to it, so a config that ships the default explicitly
+#: hashes identically to one that omits the key (no spurious recompile
+#: between two coordinators that spell the same effective config
+#: differently)
+_TRACE_RELEVANT_DEFAULTS = {
+    "mesh_axis": None,        # plan/exchanges.py ctx.config.get("mesh_axis")
+    "collect_metrics": True,  # plan/physical.py .get("collect_metrics", True)
+}
+
 
 def validate_passthrough_headers(headers: dict) -> None:
     """User headers must not collide with the engine's reserved prefix
@@ -403,7 +428,11 @@ class Worker:
         shared_key = (
             stage_identity,
             data.task_count,
-            tuple(sorted((data.config or {}).items())),
+            tuple(sorted(
+                (k, v) for k, v in (data.config or {}).items()
+                if k in TRACE_RELEVANT_CONFIG_KEYS
+                and v != _TRACE_RELEVANT_DEFAULTS[k]
+            )),
         )
         return cache, shared_key
 

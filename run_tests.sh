@@ -60,9 +60,19 @@ if ! python -m pytest tests/test_recompile_budget.py -q --no-header \
         -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
     FAILED+=("tests/test_recompile_budget.py[gate]")
 fi
+# Stage-DAG scheduler gate (tests/test_stage_scheduler.py): concurrent
+# vs sequential stage scheduling must stay byte-identical (incl. under a
+# seeded chaos schedule), the overlap factor must exceed 1.0 on bushy
+# plans, and a fatal error must cancel + release in-flight siblings.
+echo "=== tests/test_stage_scheduler.py (stage-DAG scheduler gate)"
+if ! python -m pytest tests/test_stage_scheduler.py -q --no-header \
+        -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
+    FAILED+=("tests/test_stage_scheduler.py[gate]")
+fi
 for f in tests/test_*.py; do
     [ "$f" = "tests/test_recompile_budget.py" ] && continue  # ran above
     [ "$f" = "tests/test_plan_verify.py" ] && continue  # ran above (gate)
+    [ "$f" = "tests/test_stage_scheduler.py" ] && continue  # ran above
     echo "=== $f"
     if ! python -m pytest "$f" -q --no-header -p no:cacheprovider \
             "${MARKER_ARGS[@]}" "$@"; then

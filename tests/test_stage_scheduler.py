@@ -1,0 +1,600 @@
+"""Concurrent stage-DAG scheduler (ISSUE 5).
+
+The coordinator's stage materialization used to be a depth-first
+recursion, serializing sibling subtrees (a hash join's build and probe
+sides, co-shuffled producer groups, union branches) even though they
+share no data dependency. The scheduler builds the stage dependency DAG
+(planner/distributed.py build_stage_dag) and materializes every
+dependency-free stage concurrently under a bounded in-flight budget
+(`SET distributed.stage_parallelism`, default = worker count).
+
+Contracts pinned here:
+
+- DAG extraction: deps mirror the exchange frontier; deterministic
+  topological order reproduces the sequential recursion's post-order.
+- Overlap: on a >= 4-worker cluster an instrumented run observes >= 2
+  stages executing concurrently, and the explain_analyze overlap factor
+  (sum stage wall / query wall) exceeds 1.0 for bushy TPC-H q5.
+- `stage_parallelism = 1` reproduces the sequential order exactly.
+- Byte-identical results between the two schedulers, including under a
+  seeded chaos schedule (retries + overlap compose).
+- The first fatal error cancels in-flight and not-yet-submitted work and
+  releases staged TableStore slices (no TTL leaks).
+- Flipping stage_parallelism (or any scheduling/fault knob) causes ZERO
+  new XLA traces — the knobs are excluded from the stage-compile key.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from datafusion_distributed_tpu.io.parquet import arrow_to_table
+from datafusion_distributed_tpu.ops.aggregate import AggSpec
+from datafusion_distributed_tpu.plan.physical import (
+    HashAggregateExec,
+    MemoryScanExec,
+)
+from datafusion_distributed_tpu.planner.distributed import (
+    DistributedConfig,
+    build_stage_dag,
+    distribute_plan,
+    exchange_frontier,
+)
+from datafusion_distributed_tpu.runtime.chaos import (
+    FaultPlan,
+    FaultSpec,
+    one_crash_per_stage,
+    wrap_cluster,
+)
+from datafusion_distributed_tpu.runtime.coordinator import (
+    FAULT_TOLERANCE_DEFAULTS,
+    SCHEDULER_DEFAULTS,
+    Coordinator,
+    InMemoryCluster,
+)
+from datafusion_distributed_tpu.runtime.errors import (
+    TaskCancelledError,
+    WorkerError,
+    is_retryable,
+)
+from datafusion_distributed_tpu.runtime.worker import (
+    TRACE_RELEVANT_CONFIG_KEYS,
+    Worker,
+)
+
+CHAOS_SEED = int(os.environ.get("DFTPU_CHAOS_SEED", "20260803"))
+
+FAST = {"task_retry_backoff_s": 0.001}
+
+# Inlined TPC-H texts (the reference checkout's testdata/ is absent in
+# this container; ADVICE: inline SQL a test depends on). q3/q5/q21 are
+# the bushy plans the ISSUE names: multi-join trees whose sibling
+# producer stages the scheduler overlaps.
+TPCH_Q3 = """
+select l_orderkey,
+       sum(l_extendedprice * (1 - l_discount)) as revenue,
+       o_orderdate, o_shippriority
+from customer, orders, lineitem
+where c_mktsegment = 'BUILDING'
+  and c_custkey = o_custkey
+  and l_orderkey = o_orderkey
+  and o_orderdate < date '1995-03-15'
+  and l_shipdate > date '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by revenue desc, o_orderdate
+limit 10
+"""
+
+TPCH_Q5 = """
+select n_name, sum(l_extendedprice * (1 - l_discount)) as revenue
+from customer, orders, lineitem, supplier, nation, region
+where c_custkey = o_custkey
+  and l_orderkey = o_orderkey
+  and l_suppkey = s_suppkey
+  and c_nationkey = s_nationkey
+  and s_nationkey = n_nationkey
+  and n_regionkey = r_regionkey
+  and r_name = 'ASIA'
+  and o_orderdate >= date '1994-01-01'
+  and o_orderdate < date '1995-01-01'
+group by n_name
+order by revenue desc
+"""
+
+TPCH_Q21 = """
+select s_name, count(*) as numwait
+from supplier, lineitem l1, orders, nation
+where s_suppkey = l1.l_suppkey
+  and o_orderkey = l1.l_orderkey
+  and o_orderstatus = 'F'
+  and l1.l_receiptdate > l1.l_commitdate
+  and exists (
+    select * from lineitem l2
+    where l2.l_orderkey = l1.l_orderkey
+      and l2.l_suppkey <> l1.l_suppkey
+  )
+  and not exists (
+    select * from lineitem l3
+    where l3.l_orderkey = l1.l_orderkey
+      and l3.l_suppkey <> l1.l_suppkey
+      and l3.l_receiptdate > l3.l_commitdate
+  )
+  and s_nationkey = n_nationkey
+  and n_name = 'SAUDI ARABIA'
+group by s_name
+order by numwait desc, s_name
+limit 100
+"""
+
+
+@pytest.fixture(scope="module")
+def tpch_ctx():
+    from datafusion_distributed_tpu.data.tpchgen import gen_tpch
+    from datafusion_distributed_tpu.sql.context import SessionContext
+
+    ctx = SessionContext()
+    ctx.config.distributed_options["bytes_per_task"] = 1  # force fan-out
+    # co-shuffle joins instead of broadcasting the small side: the bushy
+    # shape (2 independent producer feeds per join) is what this module
+    # exercises
+    ctx.config.distributed_options["broadcast_joins"] = False
+    for name, arrow in gen_tpch(sf=0.002, seed=7).items():
+        ctx.register_arrow(name, arrow)
+    return ctx
+
+
+def _coord(cluster, **opts):
+    return Coordinator(resolver=cluster, channels=cluster,
+                       config_options={**FAST, **opts})
+
+
+def _run(ctx, sql, cluster, **opts):
+    df = ctx.sql(sql)
+    coord = _coord(cluster, **opts)
+    out = df._strip_quals(
+        df.collect_coordinated_table(coordinator=coord, num_tasks=4)
+    ).to_pandas()
+    return out, coord
+
+
+def _assert_no_leaks(cluster: InMemoryCluster):
+    for w in cluster.workers.values():
+        assert not w.table_store.tables, (
+            f"{w.url} leaked TableStore entries"
+        )
+        assert len(w.registry) == 0, f"{w.url} leaked registry entries"
+
+
+def _assert_frames_identical(got, base, label=""):
+    assert list(got.columns) == list(base.columns)
+    for col in base.columns:
+        np.testing.assert_array_equal(
+            got[col].to_numpy(), base[col].to_numpy(),
+            err_msg=f"{label}.{col} diverged between schedulers",
+        )
+
+
+# ---------------------------------------------------------------------------
+# DAG extraction
+# ---------------------------------------------------------------------------
+
+
+def _join_plan(ctx, num_tasks=4):
+    """A staged plan with two independent feed stages (join build+probe)."""
+    df = ctx.sql(
+        "select o_orderkey, sum(l_extendedprice) s from orders, lineitem "
+        "where o_orderkey = l_orderkey group by o_orderkey"
+    )
+    return df.distributed_plan(num_tasks,
+                               config=df._seeded_host_config(num_tasks))
+
+
+def test_build_stage_dag_structure(tpch_ctx):
+    plan = _join_plan(tpch_ctx)
+    dag = build_stage_dag(plan)
+    assert dag is not None
+    sids = sorted(dag.nodes)
+    assert len(sids) >= 2
+    for sid, node in dag.nodes.items():
+        assert node.stage_id == sid
+        # deps are exactly the producer subtree's exchange frontier
+        assert sorted(node.deps) == sorted(
+            f.stage_id
+            for f in exchange_frontier(node.exchange.children()[0])
+        )
+        # stage ids are stamped bottom-up: every dependency precedes
+        assert all(d < sid for d in node.deps)
+    # deterministic topological order == the sequential recursion's
+    # post-order (stage ids are stamped in that same post-order walk)
+    assert dag.schedulable_order() == sids
+    # at least one stage pair shares no ancestry (the join's two feeds) —
+    # that sibling independence is what the scheduler overlaps
+    deps = {sid: set(dag.nodes[sid].deps) for sid in sids}
+
+    def ancestors(s, acc):
+        for d in deps[s]:
+            if d not in acc:
+                acc.add(d)
+                ancestors(d, acc)
+        return acc
+
+    independent = any(
+        a not in ancestors(b, set()) and b not in ancestors(a, set())
+        for a in sids for b in sids if a < b
+    )
+    assert independent, "join plan has no independent sibling stages"
+
+
+def test_build_stage_dag_rejects_unstamped_plans():
+    rng = np.random.default_rng(0)
+    t = arrow_to_table(pa.table({
+        "k": rng.integers(0, 8, 256), "v": rng.normal(size=256),
+    }))
+    scan = MemoryScanExec([t], t.schema())
+    agg = HashAggregateExec("single", ["k"],
+                            [AggSpec("sum", "v", "sv")], scan, 16)
+    staged = distribute_plan(agg, DistributedConfig(num_tasks=4))
+    assert build_stage_dag(staged) is not None
+    # strip a stamped id: hand-built plans fall back to the sequential
+    # recursion instead of mis-scheduling
+    exch = staged.collect(
+        lambda n: getattr(n, "is_exchange", False)
+    )[0]
+    exch.stage_id = None
+    assert build_stage_dag(staged) is None
+
+
+# ---------------------------------------------------------------------------
+# instrumented overlap + sequential-order reproduction
+# ---------------------------------------------------------------------------
+
+
+class _StageRecorder:
+    """Thread-safe record of which stages were executing when."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.active: dict = {}  # stage_id -> nesting count
+        self.peak_stages = 0
+        self.first_seen: list = []  # stage ids in first-execution order
+        self.intervals: dict = {}  # stage_id -> [t_enter, t_exit_max]
+
+    def enter(self, sid):
+        now = time.monotonic()
+        with self.lock:
+            if sid not in self.active or self.active[sid] == 0:
+                if sid not in self.intervals:
+                    self.first_seen.append(sid)
+                    self.intervals[sid] = [now, now]
+            self.active[sid] = self.active.get(sid, 0) + 1
+            live = sum(1 for v in self.active.values() if v > 0)
+            self.peak_stages = max(self.peak_stages, live)
+
+    def exit(self, sid):
+        now = time.monotonic()
+        with self.lock:
+            self.active[sid] -= 1
+            self.intervals[sid][1] = max(self.intervals[sid][1], now)
+
+    def overlapping_pairs(self):
+        iv = self.intervals
+        return {
+            (a, b)
+            for a in iv for b in iv
+            if a < b and iv[a][0] < iv[b][1] and iv[b][0] < iv[a][1]
+        }
+
+
+class _InstrumentedWorker(Worker):
+    """Worker recording per-stage execution intervals; a small sleep per
+    task makes sibling-stage overlap deterministic on a loaded CPU."""
+
+    def __init__(self, url, recorder, sleep_s=0.05):
+        super().__init__(url)
+        self._recorder = recorder
+        self._sleep_s = sleep_s
+
+    def _execute_task_body(self, key):
+        self._recorder.enter(key.stage_id)
+        try:
+            time.sleep(self._sleep_s)
+            return super()._execute_task_body(key)
+        finally:
+            self._recorder.exit(key.stage_id)
+
+
+class _InstrumentedCluster:
+    def __init__(self, n, recorder, sleep_s=0.05):
+        self.workers = {
+            f"mem://worker-{i}": _InstrumentedWorker(
+                f"mem://worker-{i}", recorder, sleep_s
+            )
+            for i in range(n)
+        }
+        for w in self.workers.values():
+            w.peer_channels = self
+
+    def get_urls(self):
+        return list(self.workers.keys())
+
+    def get_worker(self, url):
+        return self.workers[url]
+
+
+def test_join_feeds_overlap_under_dag_scheduler(tpch_ctx):
+    rec = _StageRecorder()
+    cluster = _InstrumentedCluster(4, rec)
+    # peerless: the eager planes execute stages AT materialization, so
+    # the recorder sees the scheduler's interleaving directly
+    out, coord = _run(
+        tpch_ctx,
+        "select o_orderkey, sum(l_extendedprice) s from orders, lineitem "
+        "where o_orderkey = l_orderkey group by o_orderkey order by s desc",
+        cluster, peer_shuffle=False, stage_parallelism=4,
+    )
+    assert len(out) > 0
+    assert rec.peak_stages >= 2, (
+        f"no inter-stage overlap observed (peak={rec.peak_stages})"
+    )
+    # the join's two feed stages concretely overlapped in wall time
+    assert rec.overlapping_pairs(), rec.intervals
+
+
+def test_stage_parallelism_one_reproduces_sequential_order(tpch_ctx):
+    rec = _StageRecorder()
+    cluster = _InstrumentedCluster(4, rec, sleep_s=0.0)
+    out, coord = _run(
+        tpch_ctx,
+        "select o_orderkey, sum(l_extendedprice) s from orders, lineitem "
+        "where o_orderkey = l_orderkey group by o_orderkey order by s desc",
+        cluster, peer_shuffle=False, stage_parallelism=1,
+    )
+    assert len(out) > 0
+    assert rec.peak_stages == 1, "sequential mode overlapped stages"
+    # depth-first recursion materializes stages in ascending stage_id
+    # (post-order stamping); the root task (-1) always comes last
+    order = rec.first_seen
+    assert order[-1] == -1
+    stages = [s for s in order if s != -1]
+    assert stages == sorted(stages), (
+        f"stage_parallelism=1 did not reproduce the sequential order: "
+        f"{order}"
+    )
+
+
+def test_stage_parallelism_budget_bounds_inflight(tpch_ctx):
+    rec = _StageRecorder()
+    cluster = _InstrumentedCluster(4, rec)
+    _out, coord = _run(tpch_ctx, TPCH_Q5, cluster,
+                       peer_shuffle=False, stage_parallelism=2)
+    summary = coord.stage_metrics.stage_schedule_summary()
+    # the recorded scheduler spans never exceed the in-flight budget
+    assert 1 <= summary["max_concurrent"] <= 2, summary
+
+
+# ---------------------------------------------------------------------------
+# byte-identical results: sequential vs DAG, with and without chaos
+# ---------------------------------------------------------------------------
+
+
+# q3 checks the peer plane only; q5 checks both planes (the peerless
+# variant is its own compiled plan shape — one cross-plane query keeps
+# the single-process tier-1 compile budget bounded)
+@pytest.mark.parametrize("qname,sql,variants", [
+    ("q3", TPCH_Q3, ({"stage_parallelism": 4},)),
+    ("q5", TPCH_Q5, ({"stage_parallelism": 4},
+                     {"stage_parallelism": 4, "peer_shuffle": False})),
+])
+def test_byte_identical_sequential_vs_dag(tpch_ctx, qname, sql, variants):
+    base, _ = _run(tpch_ctx, sql, InMemoryCluster(4), stage_parallelism=1)
+    for opts in variants:
+        got, coord = _run(tpch_ctx, sql, InMemoryCluster(4), **opts)
+        _assert_frames_identical(got, base, f"{qname}{opts}")
+
+
+@pytest.mark.parametrize("qname,sql", [("q5", TPCH_Q5)])
+def test_byte_identical_under_chaos_schedule(tpch_ctx, qname, sql):
+    """Retries + overlap compose: one injected crash per stage under the
+    CONCURRENT scheduler still yields results byte-identical to the
+    fault-free sequential run, and nothing leaks."""
+    base, _ = _run(tpch_ctx, sql, InMemoryCluster(4), stage_parallelism=1)
+    cluster = InMemoryCluster(4)
+    chaos = wrap_cluster(cluster, one_crash_per_stage(CHAOS_SEED))
+    got, coord = _run(tpch_ctx, sql, chaos, stage_parallelism=4)
+    _assert_frames_identical(got, base, qname)
+    assert chaos.plan.fired, "chaos schedule never fired"
+    assert coord.faults.get("task_retries") >= 1
+    _assert_no_leaks(cluster)
+
+
+@pytest.mark.slow
+def test_byte_identical_q21_including_chaos(tpch_ctx):
+    base, _ = _run(tpch_ctx, TPCH_Q21, InMemoryCluster(4),
+                   stage_parallelism=1)
+    got, _ = _run(tpch_ctx, TPCH_Q21, InMemoryCluster(4),
+                  stage_parallelism=4)
+    _assert_frames_identical(got, base, "q21")
+    cluster = InMemoryCluster(4)
+    chaos = wrap_cluster(cluster, one_crash_per_stage(CHAOS_SEED))
+    got2, coord = _run(tpch_ctx, TPCH_Q21, chaos, stage_parallelism=4)
+    _assert_frames_identical(got2, base, "q21-chaos")
+    assert coord.faults.get("task_retries") >= 1
+    _assert_no_leaks(cluster)
+
+
+# ---------------------------------------------------------------------------
+# observability: stage spans + overlap factor + explain_analyze rendering
+# ---------------------------------------------------------------------------
+
+
+def test_overlap_factor_exceeds_one_for_q5(tpch_ctx):
+    """The acceptance bar of ISSUE 5: on a 4-worker cluster the bushy q5's
+    explain_analyze overlap factor exceeds 1.0 under the DAG scheduler.
+    A uniform injected execute delay stands in for device/DCN latency so
+    the signal is robust on a starved CI core."""
+    cluster = wrap_cluster(InMemoryCluster(4), FaultPlan(CHAOS_SEED, [
+        FaultSpec(site="execute", kind="delay", delay_s=0.05, rate=1.0),
+    ]))
+    _out, coord = _run(tpch_ctx, TPCH_Q5, cluster,
+                       peer_shuffle=False, stage_parallelism=4)
+    factor = coord.overlap_factor()
+    assert factor is not None and factor > 1.0, (
+        f"overlap factor {factor} <= 1.0: stages did not overlap"
+    )
+    summary = coord.stage_metrics.stage_schedule_summary()
+    assert summary["max_concurrent"] >= 2
+    rendered = coord.stage_metrics.render_stage_schedule()
+    assert "overlap factor" in rendered
+    assert "stage schedule" in rendered
+
+
+def test_explain_analyze_renders_stage_schedule(tpch_ctx):
+    from datafusion_distributed_tpu.runtime.metrics import explain_analyze
+
+    df = tpch_ctx.sql(TPCH_Q5)
+    coord = _coord(InMemoryCluster(4), stage_parallelism=4)
+    plan = df.distributed_plan(4, coordinator=coord,
+                               config=df._seeded_host_config(4))
+    coord.execute(plan)
+    text = explain_analyze(plan, coord.stage_metrics)
+    assert "-- stage schedule" in text
+    assert "overlap factor" in text
+    # every materialized stage got a span, plus the root stage
+    spans = next(iter(coord.stage_metrics.stage_spans.values()))
+    assert -1 in spans
+    n_exchanges = len(plan.collect(
+        lambda n: getattr(n, "is_exchange", False)
+    ))
+    assert len(spans) == n_exchanges + 1
+    # the schedule block binds to the EXPLAINED plan's query: after a
+    # second query runs on the same coordinator, explaining the first
+    # plan still renders the FIRST query's spans, and a plan that never
+    # executed renders no schedule at all
+    qid = plan._last_query_id
+    df2 = tpch_ctx.sql(TPCH_Q3)
+    plan2 = df2.distributed_plan(4, coordinator=coord,
+                                 config=df2._seeded_host_config(4))
+    coord.execute(plan2)
+    text_again = explain_analyze(plan, coord.stage_metrics)
+    assert f"query {qid[:8]}" in text_again
+    assert plan2._last_query_id != qid
+    unexecuted = df2.distributed_plan(4, config=df2._seeded_host_config(4))
+    assert "-- stage schedule" not in explain_analyze(
+        unexecuted, coord.stage_metrics
+    )
+
+
+# ---------------------------------------------------------------------------
+# cancellation: first fatal error stops in-flight + pending work
+# ---------------------------------------------------------------------------
+
+
+def test_fatal_error_cancels_siblings_and_releases_slices(tpch_ctx):
+    """A fatal (non-retryable) fault on one task must cancel the query's
+    other in-flight and not-yet-submitted stages — their staged
+    TableStore slices are released NOW, not at the registry TTL sweep,
+    and slow siblings stop instead of running to completion."""
+    cluster = InMemoryCluster(3)
+    plan = FaultPlan(CHAOS_SEED, [
+        # unknown kind -> plain WorkerError (non-retryable, fatal)
+        FaultSpec(site="execute", kind="fatal_poison", rate=1.0,
+                  max_total=1),
+        FaultSpec(site="execute", kind="delay", delay_s=0.2, rate=1.0),
+    ])
+    chaos = wrap_cluster(cluster, plan)
+    t0 = time.monotonic()
+    with pytest.raises(WorkerError) as ei:
+        _run(tpch_ctx, TPCH_Q3, chaos,
+             stage_parallelism=4, max_task_retries=4)
+    elapsed = time.monotonic() - t0
+    assert not is_retryable(ei.value)
+    # teardown is prompt (in-flight tasks abort at their next checkpoint,
+    # pending stages never submit) and leaves nothing staged behind
+    assert elapsed < 60.0
+    _assert_no_leaks(cluster)
+
+
+def test_cancel_event_checked_before_dispatch():
+    """_run_stage_task aborts at its pre-dispatch checkpoint once the
+    query-level cancel event is set — no new work ships after a sibling
+    failure."""
+    cluster = InMemoryCluster(1)
+    coord = _coord(cluster)
+    coord._cancel_event = threading.Event()
+    coord._cancel_event.set()
+    rng = np.random.default_rng(0)
+    t = arrow_to_table(pa.table({"x": rng.integers(0, 9, 64)}))
+    stage_plan = MemoryScanExec([t], t.schema())
+    with pytest.raises(TaskCancelledError):
+        coord._run_stage_task(stage_plan, "q", 0, 0, 1)
+    # nothing was dispatched: no staged slices, no registry entries
+    _assert_no_leaks(cluster)
+
+
+def test_task_cancelled_error_is_not_workerfault():
+    e = TaskCancelledError("x")
+    assert not is_retryable(e)
+    assert not isinstance(e, WorkerError), (
+        "cancellation must not count against worker health/fatal counters"
+    )
+
+
+# ---------------------------------------------------------------------------
+# scheduling knobs never recompile
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_knobs_are_trace_irrelevant():
+    """The worker's stage-compile key keeps ONLY the trace-relevant
+    config keys (allow-list): flipping stage_parallelism — or any other
+    coordinator-side scheduling/fault knob, present or future — must not
+    recompile structurally identical stages."""
+    assert not set(SCHEDULER_DEFAULTS) & TRACE_RELEVANT_CONFIG_KEYS
+    assert not set(FAULT_TOLERANCE_DEFAULTS) & TRACE_RELEVANT_CONFIG_KEYS
+
+
+def test_trace_relevant_key_inventory_matches_source():
+    """AST-scan the package for `<...>.config.get("key")` reads (the only
+    way traced code consults the shipped config, via ExecContext.config)
+    and pin that every such key is in TRACE_RELEVANT_CONFIG_KEYS — a new
+    config read in traced code without an allow-list entry would silently
+    share compiled programs across configs that trace differently."""
+    import ast
+    import pathlib
+
+    import datafusion_distributed_tpu as pkg
+
+    root = pathlib.Path(pkg.__file__).parent
+    keys = set()
+    for sub in ("plan", "ops"):
+        for path in (root / sub).rglob("*.py"):
+            tree = ast.parse(path.read_text())
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "get"
+                        and isinstance(node.func.value, ast.Attribute)
+                        and node.func.value.attr == "config"
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)):
+                    keys.add(node.args[0].value)
+    assert keys, "inventory scan found no ExecContext.config reads"
+    assert keys <= TRACE_RELEVANT_CONFIG_KEYS, (
+        f"traced code reads config keys missing from the stage-compile "
+        f"allow-list: {sorted(keys - TRACE_RELEVANT_CONFIG_KEYS)}"
+    )
+
+
+def test_stage_parallelism_flip_causes_zero_new_traces(tpch_ctx):
+    from datafusion_distributed_tpu.plan import physical as phys
+
+    _run(tpch_ctx, TPCH_Q3, InMemoryCluster(4), stage_parallelism=1)
+    before = phys.trace_count()
+    _run(tpch_ctx, TPCH_Q3, InMemoryCluster(4), stage_parallelism=4)
+    assert phys.trace_count() == before, (
+        "changing stage_parallelism recompiled identical stage programs"
+    )
