@@ -39,8 +39,10 @@ def _fmt_bytes(n: float) -> str:
 
 class Console:
     def __init__(self, resolver, channels, poll_s: float = 0.5,
-                 out=None):
-        self.obs = ObservabilityService(resolver, channels)
+                 out=None, health=None):
+        # ``health``: a coordinator's HealthTracker — wiring it in joins
+        # circuit-breaker state into the membership rows below
+        self.obs = ObservabilityService(resolver, channels, health=health)
         self.poll_s = poll_s
         self.out = out or sys.stdout
         self.tracked_keys: list = []  # TaskKeys to poll progress for
@@ -56,22 +58,47 @@ class Console:
             f"{_DIM}{time.strftime('%H:%M:%S')}{_RESET}"
         )
         workers = self.obs.get_cluster_workers()
-        lines.append(f"\n{_BOLD}workers ({len(workers)}){_RESET}")
+        mem = self.obs.get_membership()
+        health = {
+            w["url"]: w.get("health", {})
+            for w in mem.get("workers", ())
+        }
+        draining = list(mem.get("draining", ()))
+        head = f"\n{_BOLD}workers ({len(workers)} active"
+        if draining:
+            head += f", {len(draining)} draining"
+        head += f"){_RESET}"
+        if mem.get("epoch") is not None:
+            head += f"  {_DIM}membership epoch {mem['epoch']}{_RESET}"
+        lines.append(head)
         lines.append(
-            f"  {'url':<28} {'tasks':>5} {'ver':>7} {'status':>8}"
+            f"  {'url':<28} {'tasks':>5} {'ver':>7} {'status':>10}"
         )
         for w in workers:
             if "error" in w:
                 lines.append(
                     f"  {w.get('url', '?'):<28} {'-':>5} {'-':>7} "
-                    f"{'DOWN':>8}  {_DIM}{w['error'][:40]}{_RESET}"
+                    f"{'DOWN':>10}  {_DIM}{w['error'][:40]}{_RESET}"
                 )
                 continue
+            url = w.get("url", "?")
+            breaker = health.get(url, {}).get("state")
+            status = breaker if breaker and breaker != "closed" else "up"
             lines.append(
-                f"  {w.get('url', '?'):<28} "
+                f"  {url:<28} "
                 f"{w.get('tasks_cached', 0):>5} "
                 f"{w.get('version', '-'):>7} "
-                f"{'up':>8}"
+                f"{status:>10}"
+            )
+        for url in draining:
+            try:
+                info = self.obs.channels.get_worker(url).get_info()
+                tasks = info.get("tasks_cached", 0)
+                ver = info.get("version", "-")
+            except Exception:
+                tasks, ver = "-", "-"
+            lines.append(
+                f"  {url:<28} {tasks:>5} {ver:>7} {'draining':>10}"
             )
         if self.tracked_keys:
             prog = self.obs.get_task_progress(self.tracked_keys)

@@ -802,6 +802,20 @@ class StageDag:
     nodes: dict  # stage_id -> StageDagNode
     root_deps: tuple  # frontier stage ids of the root consumer stage
 
+    def consumers_map(self) -> dict:
+        """stage_id -> sorted stage ids consuming its output (the reverse
+        edges). The concurrent scheduler releases these as their feeds
+        materialize; because every released stage's task dispatch resolves
+        LIVE cluster membership, a worker that joins mid-query starts
+        receiving tasks at the next stage released off this map."""
+        out: dict = {}
+        for sid, n in self.nodes.items():
+            for d in n.deps:
+                out.setdefault(d, []).append(sid)
+        for sids in out.values():
+            sids.sort()
+        return out
+
     def schedulable_order(self) -> list:
         """Deterministic topological order (ascending stage_id within each
         ready frontier) — with stage_parallelism=1 this reproduces the

@@ -153,6 +153,175 @@ class InMemoryCluster(WorkerResolver, ChannelResolver):
         return self.workers[url]
 
 
+class DynamicCluster(WorkerResolver, ChannelResolver):
+    """Epoch-versioned MUTABLE cluster membership (the reference's
+    WorkerResolver as a dynamic layer, SURVEY §1): workers `add_worker`/
+    `remove_worker`/`drain_worker` at any time — including mid-query — and
+    every mutation bumps the monotonically increasing `membership_epoch`
+    the coordinator keys its per-membership caches on.
+
+    Three membership roles:
+
+      active    listed by `get_urls()` — eligible for new task dispatch
+      draining  NOT listed by `get_urls()` (no new tasks) but still
+                resolvable via `get_worker` so in-flight tasks finish and
+                staged peer-producer plans keep serving pulls; removed by
+                `finish_drains()` only once EMPTY (zero registry entries,
+                zero staged TableStore slices)
+      departed  `get_worker` raises the retryable WorkerUnavailableError —
+                the coordinator's retry machinery re-routes/re-stages the
+                affected work onto survivors
+
+    `remove_worker` models an abrupt leave (process death): the worker's
+    registry and shipment store are released, as the dying process would
+    release them — so leak accounting stays exact across churn."""
+
+    def __init__(self, num_workers: int = 0, ttl_seconds: float = 600.0,
+                 worker_factory: Optional[Callable[[str], Worker]] = None):
+        self._lock = threading.RLock()
+        self._epoch = 0
+        self._active: dict[str, Worker] = {}
+        self._draining: dict[str, Worker] = {}
+        self._departed: set[str] = set()
+        self._ttl = ttl_seconds
+        self._factory = worker_factory or (
+            lambda url: Worker(url, ttl_seconds)
+        )
+        for i in range(num_workers):
+            self.add_worker(f"mem://worker-{i}")
+
+    # -- resolver surface ---------------------------------------------------
+    @property
+    def membership_epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def get_urls(self) -> list[str]:
+        with self._lock:
+            return list(self._active.keys())
+
+    def get_worker(self, url: str) -> Worker:
+        with self._lock:
+            w = self._active.get(url) or self._draining.get(url)
+            if w is not None:
+                return w
+        raise WorkerUnavailableError(
+            f"worker {url} is not in the cluster membership"
+            + (" (departed)" if url in self._departed else ""),
+            worker_url=url,
+        )
+
+    # -- membership mutation -------------------------------------------------
+    def add_worker(self, worker) -> Worker:
+        """Add ``worker`` (a Worker instance or a url for the factory).
+        A joining worker is immediately eligible for new dispatches —
+        including later stages of an already-running query."""
+        w = worker if isinstance(worker, Worker) else self._factory(worker)
+        with self._lock:
+            if w.url in self._active or w.url in self._draining:
+                raise ValueError(f"worker {w.url} already in the cluster")
+            # peers resolve each other through the cluster itself, so a
+            # joiner can serve AND issue peer pulls right away
+            w.peer_channels = self
+            self._active[w.url] = w
+            self._departed.discard(w.url)
+            self._epoch += 1
+        return w
+
+    def remove_worker(self, url: str, release: bool = True) -> None:
+        """Abrupt leave: the url stops resolving NOW. ``release`` frees the
+        worker's registry + shipment store the way its dying process would
+        (in-flight coordinator attempts against it fail retryably)."""
+        with self._lock:
+            w = self._active.pop(url, None) or self._draining.pop(url, None)
+            if w is None:
+                raise KeyError(f"worker {url} not in the cluster")
+            self._departed.add(url)
+            self._epoch += 1
+        if release:
+            w.registry.clear()
+            w.table_store.tables.clear()
+
+    def drain_worker(self, url: str) -> None:
+        """Graceful half of leave: accept no NEW tasks (the url drops out
+        of `get_urls()`), keep serving in-flight work and staged peer
+        producers, and become removable only once empty."""
+        with self._lock:
+            w = self._active.pop(url, None)
+            if w is None:
+                if url in self._draining:
+                    return  # already draining
+                raise KeyError(f"worker {url} not in the active membership")
+            self._draining[url] = w
+            self._epoch += 1
+
+    # -- drain accounting ----------------------------------------------------
+    def in_flight(self, url: str) -> int:
+        """Tasks the worker still holds: registry entries (staged or
+        executing) — zero plus an empty shipment store means drained."""
+        with self._lock:
+            w = self._active.get(url) or self._draining.get(url)
+        return 0 if w is None else len(w.registry)
+
+    def is_drained(self, url: str) -> bool:
+        with self._lock:
+            w = self._draining.get(url)
+        return (
+            w is not None
+            and len(w.registry) == 0
+            and not w.table_store.tables
+        )
+
+    def finish_drains(self) -> list[str]:
+        """Remove every draining worker that reached empty; -> the removed
+        urls. A draining worker still holding tasks/slices stays — the
+        'removed only when empty' contract."""
+        removed = []
+        with self._lock:
+            for url, w in list(self._draining.items()):
+                if len(w.registry) == 0 and not w.table_store.tables:
+                    del self._draining[url]
+                    self._departed.add(url)
+                    self._epoch += 1
+                    removed.append(url)
+        return removed
+
+    def wait_drained(self, url: str, timeout_s: float = 10.0,
+                     poll_s: float = 0.01) -> bool:
+        """Block until ``url`` is drained (then remove it) or the timeout
+        elapses; -> whether it drained."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_s
+        while _time.monotonic() < deadline:
+            if self.is_drained(url):
+                self.finish_drains()
+                return True
+            _time.sleep(poll_s)
+        return False
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def workers(self) -> dict:
+        """url -> Worker for every member still owning resources (active +
+        draining) — the InMemoryCluster-compatible leak-check surface."""
+        with self._lock:
+            return {**self._active, **self._draining}
+
+    def is_departed(self, url: str) -> bool:
+        with self._lock:
+            return url in self._departed
+
+    def membership_snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "epoch": self._epoch,
+                "active": list(self._active.keys()),
+                "draining": list(self._draining.keys()),
+                "departed": sorted(self._departed),
+            }
+
+
 @dataclass
 class Coordinator:
     resolver: WorkerResolver
@@ -218,6 +387,17 @@ class Coordinator:
         # plane): released at query end — the reference's query-end EOS
         # notifier role (`query_coordinator.rs:188-192`)
         self._peer_shipped: list = []
+        # (query_id, stage_id) -> (prepared producer plan, t_prod, ttl):
+        # the re-ship source when a worker holding a shipped peer-producer
+        # plan departs the membership mid-query (_heal_departed_peers)
+        self._peer_plan_registry: dict = {}
+        # accumulated ACROSS heal passes (healing is incremental — each
+        # failing consumer heals when IT retries, possibly long after the
+        # pass that moved a producer): producer key tuple -> the url now
+        # serving it, and the set of shipped copies whose on-worker plan
+        # pre-dates a spec rewrite and must be refreshed before trusted
+        self._peer_url_map: dict = {}
+        self._peer_stale: set = set()
         # per-query caches (span plans are keyed by query_id; the plan-walk
         # verdicts key by object id which is only stable within a query).
         # The lock serializes span check-and-ship: concurrent stage tasks
@@ -229,6 +409,7 @@ class Coordinator:
         import threading as _threading
 
         self._span_lock = _threading.Lock()
+        self._peer_heal_lock = _threading.Lock()
         # per-query cancel event: the FIRST fatal error sets it, and every
         # dispatch/execute path checks it before doing work — a failed
         # sibling stage/task cancels in-flight and not-yet-submitted work
@@ -321,14 +502,74 @@ class Coordinator:
     def _stage_parallelism(self) -> int:
         """`SET distributed.stage_parallelism`: the in-flight stage budget
         (memory control — every in-flight stage holds its producer outputs).
-        0/unset = auto: the worker count."""
+        0/unset = auto: the LIVE worker count at query start (task routing
+        inside each stage re-resolves membership per dispatch, so joiners
+        still receive tasks even though the stage budget is fixed)."""
         n = self._opt_int("stage_parallelism")
         if n <= 0:
-            try:
-                n = max(len(self.resolver.get_urls()), 1)
-            except Exception:
-                n = 1
+            n = self._live_worker_count()
         return n
+
+    # -- membership awareness -------------------------------------------------
+    def _membership_token(self, urls=None):
+        """Cache key for everything derived from cluster membership. An
+        epoch-versioned resolver (DynamicCluster) keys by its monotonic
+        `membership_epoch`; static resolvers key by the url tuple itself,
+        so even a user mutating `InMemoryCluster.workers` between
+        dispatches invalidates the derived caches."""
+        ep = getattr(self.resolver, "membership_epoch", None)
+        if isinstance(ep, int):
+            return ("epoch", ep)
+        if urls is None:
+            try:
+                urls = self.resolver.get_urls()
+            except Exception:
+                urls = []
+        return ("urls", tuple(urls))
+
+    def _note_membership(self, urls=None):
+        """Observe the current membership; on a CHANGE, prune
+        health/quarantine state for workers that departed — a shrunk or
+        grown cluster must not carry breaker state for endpoints that no
+        longer exist. Per-membership caches (peer capability, mesh span
+        width) are not cleared here — each stores the token it was
+        computed under and is ignored on mismatch, so a slow probe racing
+        a membership change can only install a verdict stamped with its
+        own stale token, never poison the new epoch."""
+        tok = self._membership_token(urls)
+        if tok == getattr(self, "_membership_seen", None):
+            return tok
+        self._membership_seen = tok
+        if self.health is not None:
+            for _u in self.health.prune(self._full_membership_urls()):
+                self.faults.bump("health_entries_pruned")
+        return tok
+
+    def _full_membership_urls(self) -> list[str]:
+        """Active + draining urls — the set that still owns resources.
+        Draining workers keep their health state (they are finishing
+        work); only truly departed workers are pruned."""
+        snap = getattr(self.resolver, "membership_snapshot", None)
+        if callable(snap):
+            try:
+                s = snap()
+                return list(s.get("active", ())) + list(
+                    s.get("draining", ())
+                )
+            except Exception:
+                pass
+        try:
+            return self.resolver.get_urls()
+        except Exception:
+            return []
+
+    def _live_worker_count(self) -> int:
+        try:
+            urls = self.resolver.get_urls()
+        except Exception:
+            return 1
+        self._note_membership(urls)
+        return max(len(urls), 1)
 
     def _materialize_exchanges_sequential(
         self, plan: ExecutionPlan, query_id: str
@@ -379,10 +620,7 @@ class Coordinator:
             return node.with_new_children(children) if children else node
 
         waiting = {sid: set(n.deps) for sid, n in nodes.items()}
-        consumers: dict = {}
-        for sid, n in nodes.items():
-            for d in n.deps:
-                consumers.setdefault(d, []).append(sid)
+        consumers = dag.consumers_map()
         first_error: Optional[BaseException] = None
         first_cancel: Optional[BaseException] = None
 
@@ -609,9 +847,14 @@ class Coordinator:
         return self._workers_peer_capable()
 
     def _workers_peer_capable(self) -> bool:
-        """Cached capability probe: cluster membership is static per
-        coordinator — probing every worker per boundary would put O(stages
-        x workers) resolver calls on the dispatch path.
+        """Capability probe cached PER MEMBERSHIP TOKEN — the verdict is
+        stored WITH the token it was computed under and ignored on
+        mismatch, so a worker added after the first dispatch is probed,
+        not assumed, and a slow probe racing a membership change cannot
+        install a stale verdict for the new epoch. Probing every worker
+        per boundary would put O(stages x workers) resolver calls on the
+        dispatch path, but a stale verdict on a mutated cluster either
+        fails at consumer load time or silently degrades the plane.
 
         Checks the data-plane surface AND actual peer WIRING
         (`Worker.peer_capable` / the gRPC GetInfo flag): a user-built
@@ -619,18 +862,30 @@ class Coordinator:
         keep the coordinator-mediated plane, not fail at consumer load
         time. A single-worker cluster is always capable (every pull
         short-circuits to the local bypass)."""
+        urls = self.resolver.get_urls()
+        tok = self._note_membership(urls)
         cached = getattr(self, "_peer_capable", None)
-        if cached is None:
-            urls = self.resolver.get_urls()
-            workers = [self.channels.get_worker(u) for u in urls]
-            cached = all(
-                hasattr(w, "execute_task_partitions") for w in workers
-            ) and (
-                len(urls) <= 1
-                or all(getattr(w, "peer_capable", False) for w in workers)
-            )
-            self._peer_capable = cached
-        return cached
+        if cached is not None and cached[0] == tok:
+            return cached[1]
+        workers = []
+        for u in urls:
+            try:
+                workers.append(self.channels.get_worker(u))
+            except WorkerUnavailableError:
+                # departed between listing and probe (this runs at
+                # boundary materialization, OUTSIDE the dispatch retry
+                # loops — an escape here would fail the query, not
+                # reroute it): judge the survivors; the token is already
+                # stale, so the next boundary re-probes the new epoch
+                continue
+        verdict = all(
+            hasattr(w, "execute_task_partitions") for w in workers
+        ) and (
+            len(urls) <= 1
+            or all(getattr(w, "peer_capable", False) for w in workers)
+        )
+        self._peer_capable = (tok, verdict)
+        return verdict
 
     def _peer_boundary(
         self, exchange, producer: ExecutionPlan, query_id: str,
@@ -653,6 +908,12 @@ class Coordinator:
         # idle-TTL default, so ship them with a query-lifetime TTL (the
         # query-end sweep, not the TTI cache, owns their cleanup)
         peer_ttl = float(self.config_options.get("peer_task_ttl", 3600.0))
+        # retained for the membership-churn path: a producer shipped here
+        # whose worker later LEAVES is re-shipped from this prepared plan
+        # onto a survivor (_heal_departed_peers)
+        self._peer_plan_registry[(query_id, stage_id)] = (
+            prepared, t_prod, peer_ttl
+        )
         producers = []  # (key_obj, url)
         for i in range(t_prod):
             worker, key, plan_obj, _store = self._dispatch_task_with_retry(
@@ -698,6 +959,157 @@ class Coordinator:
             "partitions": t_cons,
         }
         return scan
+
+    def _heal_departed_peers(self, stage_plan, query_id) -> int:
+        """Membership-churn recovery for the peer data plane: producer
+        tasks whose worker LEFT the membership (neither active nor
+        draining) are re-shipped onto survivors from the prepared plans
+        retained at boundary time, and every pull spec naming them is
+        rewritten to the survivor — so the failing consumer's next attempt
+        pulls from live endpoints.
+
+        The heal is TRANSITIVE: registered peer stages are processed
+        bottom-up (ascending stage id — `_prepare` stamps producers before
+        consumers), so when a re-shipped producer's own plan pulls from an
+        earlier departed producer, it ships with already-healed specs; and
+        a producer still sitting on a LIVE worker whose shipped copy names
+        a departed upstream is REFRESHED in place (same key, same worker —
+        its consumers' specs keep pointing at it). Original scan nodes are
+        mutated (task specialization copies pull lists per dispatch), so
+        every retrying sibling task sees the healed specs; the heal lock
+        serializes concurrent retries, and a second pass finds everything
+        reachable and no-ops. -> producer tasks re-shipped."""
+        from datafusion_distributed_tpu.runtime.codec import (
+            collect_table_ids,
+        )
+        from datafusion_distributed_tpu.runtime.peer import (
+            PeerShuffleScanExec,
+            reroute_pulls,
+        )
+
+        plans = getattr(self, "_peer_plan_registry", None)
+        if not plans:
+            return 0
+
+        def peer_scans(plan):
+            return plan.collect(
+                lambda n: isinstance(n, PeerShuffleScanExec)
+            )
+
+        lock = getattr(self, "_peer_heal_lock", None)
+        if lock is None:
+            lock = self._peer_heal_lock = threading.Lock()
+        healed = 0
+        with lock:
+            # url_map/stale accumulate ACROSS heal passes for the query
+            # (direct-call safety: tests invoke the heal without execute)
+            url_map = getattr(self, "_peer_url_map", None)
+            if url_map is None:
+                url_map = self._peer_url_map = {}
+            stale = getattr(self, "_peer_stale", None)
+            if stale is None:
+                stale = self._peer_stale = set()
+            reachable = set(self._full_membership_urls())
+            if not url_map and not stale and all(
+                w.url in reachable for w, _ in self._peer_shipped
+            ):
+                # nothing ever moved and every shipped worker is still a
+                # member: the heal is a no-op. This runs on EVERY
+                # retryable failure (plain fault chaos included), so skip
+                # the per-stage plan walks before sibling retries convoy
+                # behind the lock
+                return 0
+            # latest shipped location of every peer producer task
+            loc: dict = {}
+            for w, k in self._peer_shipped:
+                loc[(k.query_id, k.stage_id, k.task_number)] = (w, k)
+            for qid, sid in sorted(plans, key=lambda e: e[1]):
+                prepared, t_prod, ttl = plans[(qid, sid)]
+                if sum(
+                    reroute_pulls(s, url_map) for s in peer_scans(prepared)
+                ):
+                    # this pass changed the stage's specs: every shipped
+                    # copy now pre-dates them and must be refreshed (or
+                    # re-shipped) before its consumers can trust it — the
+                    # mark persists across passes so copies whose workers
+                    # are busy THIS pass still refresh on a later one
+                    stale.update((qid, sid, i) for i in range(t_prod))
+                for i in range(t_prod):
+                    ko = (qid, sid, i)
+                    held = loc.get(ko)
+                    if held is None:
+                        continue
+                    worker, key = held
+                    if worker.url not in reachable:
+                        # departed: re-ship onto a survivor (the prepared
+                        # plan's own specs were healed just above)
+                        worker, key, _po, _st = (
+                            self._dispatch_task_with_retry(
+                                prepared, qid, sid, i, t_prod, ttl=ttl
+                            )
+                        )
+                        self._peer_shipped.append((worker, key))
+                        loc[ko] = (worker, key)
+                        url_map[ko] = worker.url
+                        stale.discard(ko)
+                        self.faults.bump("peer_producers_reshipped")
+                        healed += 1
+                    elif ko in stale:
+                        # live worker, stale shipped copy (its pulls named
+                        # a departed upstream): refresh in place so the
+                        # worker-held plan pulls from the survivors —
+                        # consumers keep addressing this same (key, url).
+                        # No pre-invalidate: registry.put evicts the
+                        # displaced entry atomically, so a concurrent
+                        # consumer pull never sees a "no plan" gap, and a
+                        # failed refresh leaves the old copy registered
+                        plan_obj = encode_plan(
+                            _task_specialized(prepared, i),
+                            worker.table_store,
+                        )
+                        try:
+                            worker.set_plan(
+                                key, plan_obj, t_prod,
+                                config=self.config_options,
+                                headers=self.passthrough_headers,
+                                ttl=ttl,
+                            )
+                        except BaseException as e:
+                            worker.table_store.remove(
+                                collect_table_ids(plan_obj)
+                            )
+                            if not getattr(e, "retryable", False):
+                                raise
+                            # transient refresh failure (the heal runs
+                            # inside the callers' failure-handling branch,
+                            # OUTSIDE their retry loops — an escape here
+                            # would fail the query): fall back to a full
+                            # re-ship, which retries/reroutes internally.
+                            # The old copy stays registered but unreferenced
+                            # once url_map points its consumers at the
+                            # re-shipped location; the query-end sweep
+                            # releases it.
+                            worker, key, _po, _st = (
+                                self._dispatch_task_with_retry(
+                                    prepared, qid, sid, i, t_prod, ttl=ttl
+                                )
+                            )
+                            self._peer_shipped.append((worker, key))
+                            loc[ko] = (worker, key)
+                            url_map[ko] = worker.url
+                            stale.discard(ko)
+                            self.faults.bump("peer_producers_reshipped")
+                            healed += 1
+                            continue
+                        stale.discard(ko)
+                        self.faults.bump("peer_producers_refreshed")
+            if url_map:
+                # the ACCUMULATED map, not just this pass's additions: a
+                # consumer whose specs were pinned before an earlier pass
+                # moved a producer heals here on its own retry
+                for s in peer_scans(stage_plan):
+                    reroute_pulls(s, url_map)
+        return healed
 
     # -- partition-range data plane ------------------------------------------
     def _partition_streams_enabled(self, exchange) -> bool:
@@ -958,52 +1370,57 @@ class Coordinator:
         from datafusion_distributed_tpu.planner.statistics import row_width
 
         width = row_width(producer.schema())
-        workers = max(len(self.resolver.get_urls()), 1)
         obs = self._chunk_observer(stage_id)
-        if task_count == 1 or workers == 1:
-            outs = []
-            rows = 0
-            for i in range(task_count):
-                out = self._run_stage_task(producer, query_id, stage_id, i,
-                                           task_count)
-                outs.append(out)
-                rows += int(out.num_rows)
-                if obs is not None:
-                    obs(out)
-                self._producer_progress(stage_id, i + 1, task_count, rows,
-                                        width)
-            return outs
-        with cf.ThreadPoolExecutor(max_workers=workers) as pool:
-            futs = [
-                pool.submit(self._run_stage_task, producer, query_id,
-                            stage_id, i, task_count)
-                for i in range(task_count)
-            ]
-            try:
-                # drain in completion order so mid-execution LoadInfo flows
-                # while the slower producers are still running (bulk-plane
-                # "chunks" are whole task outputs)
-                rows = 0
-                done = 0
-                for f in cf.as_completed(futs):
-                    out = f.result()
-                    rows += int(out.num_rows)
-                    done += 1
-                    if obs is not None:
-                        obs(out)
-                    self._producer_progress(stage_id, done, task_count,
-                                            rows, width)
-                return [f.result() for f in futs]
-            except BaseException:
-                # `f.cancel()` only stops futures that never STARTED; the
-                # per-query cancel event reaches the in-flight ones — they
-                # abort at their next dispatch/execute checkpoint and
-                # release any already-staged slices (satellite of ISSUE 5:
-                # no orphaned tasks, no TTL-leaked TableStore entries)
-                self._signal_cancel()
-                for f in futs:
-                    f.cancel()
-                raise
+        outs: dict[int, Table] = {}
+        rows = 0
+        done = 0
+
+        def account(i: int, out: Table) -> None:
+            nonlocal rows, done
+            outs[i] = out
+            rows += int(out.num_rows)
+            done += 1
+            if obs is not None:
+                obs(out)
+            self._producer_progress(stage_id, done, task_count, rows, width)
+
+        # worker count is LIVE, re-checked per task in the sequential path:
+        # a cluster of 1 that grows mid-stage (elastic join) promotes the
+        # REMAINING tasks to the concurrent fan-out instead of serializing
+        # the whole stage on the stale snapshot taken at stage start
+        pending = list(range(task_count))
+        while pending and (
+            task_count == 1 or self._live_worker_count() == 1
+        ):
+            i = pending.pop(0)
+            account(i, self._run_stage_task(producer, query_id, stage_id, i,
+                                            task_count))
+        if pending:
+            workers = self._live_worker_count()
+            with cf.ThreadPoolExecutor(max_workers=workers) as pool:
+                futs = {
+                    pool.submit(self._run_stage_task, producer, query_id,
+                                stage_id, i, task_count): i
+                    for i in pending
+                }
+                try:
+                    # drain in completion order so mid-execution LoadInfo
+                    # flows while the slower producers are still running
+                    # (bulk-plane "chunks" are whole task outputs)
+                    for f in cf.as_completed(futs):
+                        account(futs[f], f.result())
+                except BaseException:
+                    # `f.cancel()` only stops futures that never STARTED;
+                    # the per-query cancel event reaches the in-flight ones
+                    # — they abort at their next dispatch/execute checkpoint
+                    # and release any already-staged slices (satellite of
+                    # ISSUE 5: no orphaned tasks, no TTL-leaked TableStore
+                    # entries)
+                    self._signal_cancel()
+                    for f in futs:
+                        f.cancel()
+                    raise
+        return [outs[i] for i in range(task_count)]
 
     def _run_stage_task(
         self,
@@ -1059,6 +1476,10 @@ class Coordinator:
                 if self._handle_task_failure(
                     e, getattr(e, "worker_url", "") or worker.url, kt, state
                 ):
+                    # a departed worker may have taken shipped peer-producer
+                    # plans with it: re-ship them onto survivors and rewrite
+                    # this stage plan's pull specs BEFORE the re-dispatch
+                    self._heal_departed_peers(stage_plan, query_id)
                     continue
                 raise
             self._record_worker_success(worker.url)
@@ -1166,16 +1587,42 @@ class Coordinator:
         Only RETRYABLE (infrastructure) errors count toward quarantine:
         a query-semantic failure would raise identically on any worker,
         and tripping breakers on it would punish healthy endpoints."""
+        member = set(self._full_membership_urls())
         if not is_retryable(exc):
-            if isinstance(exc, WorkerError):
-                self.faults.bump("fatal_failures")
-            return False
-        if url:
+            if url and member and url not in member and isinstance(
+                exc, WorkerError
+            ):
+                # the failure is attributed to a worker that LEFT the
+                # membership: whatever the attempt relied on — staged
+                # slices, cached partitions, an in-flight execution —
+                # died with it, so the "fatal" classification is an
+                # artifact of the departure. Reclassify as retryable
+                # infrastructure so the task re-stages onto survivors.
+                self.faults.bump("departed_worker_faults")
+            else:
+                if isinstance(exc, WorkerError):
+                    self.faults.bump("fatal_failures")
+                return False
+        if url and url in member:
+            # departed workers get no breaker state: quarantining an
+            # endpoint that no longer exists would only re-grow the
+            # health map the membership prune just cleaned
             self._record_worker_failure(url)
-        if getattr(self, "_mesh_span_width", 0):
-            # span (mesh) dispatch shares one shipped plan across sibling
-            # tasks; re-dispatching a lone task elsewhere is undefined
-            return False
+        spans = getattr(self, "_span_shipped", None)
+        if spans:
+            with self._span_lock:  # vs concurrent sibling-stage shipment
+                span_hit = any(
+                    k[0] == key_tuple[0] and k[1] == key_tuple[1]
+                    for k in spans
+                )
+            if span_hit:
+                # this (query, stage) actually shipped as mesh SPANS: a
+                # span plan is shared across sibling tasks, so
+                # re-dispatching a lone task elsewhere is undefined.
+                # Keyed on what shipped, not on the width cache — a
+                # membership change resetting the cache mid-stage must
+                # not silently lift this guard
+                return False
         if state.attempt >= self._opt_int("max_task_retries"):
             self.faults.bump("retries_exhausted")
             return False
@@ -1299,6 +1746,10 @@ class Coordinator:
                 if not yielded and self._handle_task_failure(
                     e, getattr(e, "worker_url", "") or worker.url, kt, state
                 ):
+                    # the failure may be a departed PEER PRODUCER feeding
+                    # this streamed stage: re-ship it onto a survivor and
+                    # rewrite the pull specs before the re-dispatch
+                    self._heal_departed_peers(stage_plan, query_id)
                     continue
                 raise
             self._record_worker_success(worker.url)
@@ -1317,19 +1768,34 @@ class Coordinator:
         already failed this task. Exclusion is best-effort — when it would
         leave no candidate (single-worker cluster), the excluded workers
         come back; quarantine is not — with every circuit open the query
-        fails rather than hammer known-bad endpoints."""
+        fails rather than hammer known-bad endpoints.
+
+        Candidates come from LIVE membership on every call: a retry's
+        ``exclude`` set is first PRUNED of urls that departed the cluster,
+        so the no-candidate fallback keys on the membership of THIS
+        attempt, not attempt 0's — a cluster that shrank mid-retry cannot
+        exclude itself into a dead end, and a joiner is immediately
+        eligible."""
         urls = self.resolver.get_urls()
+        self._note_membership(urls)
         if not urls:
             raise _terminal(WorkerUnavailableError("cluster has no workers"))
+        if exclude:
+            # in-place: the caller's _RetryState.excluded forgets departed
+            # workers for its NEXT attempts too
+            exclude.intersection_update(urls)
         if self.health is not None:
             healthy = self.health.route_filter(urls)
             if not healthy:
-                # terminal (instance-level retryable=False): retrying
-                # cannot conjure a healthy worker — the query fails NOW
-                # instead of spinning through the whole retry budget
-                raise _terminal(WorkerUnavailableError(
+                # RETRYABLE under elastic membership: time CAN conjure a
+                # healthy worker — a quarantine expires into a half-open
+                # probe, an outstanding probe resolves, a joiner arrives.
+                # The retry backoff rides out the window without hammering
+                # anything (this raise happens before any RPC), and the
+                # retry budget still bounds a truly dead cluster
+                raise WorkerUnavailableError(
                     f"no healthy workers remain ({len(urls)} quarantined)"
-                ))
+                )
             urls = healthy
         if exclude:
             candidates = [u for u in urls if u not in exclude]
@@ -1395,9 +1861,14 @@ class Coordinator:
         custom routing, span-inexpressible plans)."""
         if self.route_tasks is not None:
             return None
-        span_w = getattr(self, "_mesh_span_width", None)
-        if span_w is None:
-            # cached: cluster membership is static per coordinator
+        tok = self._note_membership()
+        cached_w = getattr(self, "_mesh_span_width", None)
+        if cached_w is not None and cached_w[0] == tok:
+            span_w = cached_w[1]
+        else:
+            # cached per membership token (stored WITH the token and
+            # ignored on mismatch — same stale-probe protection as
+            # _workers_peer_capable)
             urls0 = self.resolver.get_urls()
             widths = [
                 getattr(self.channels.get_worker(u), "mesh_width", 0)
@@ -1406,7 +1877,7 @@ class Coordinator:
             span_w = min(widths) if widths and all(
                 w > 0 for w in widths
             ) else 0
-            self._mesh_span_width = span_w
+            self._mesh_span_width = (tok, span_w)
         if span_w <= 0:
             return None
         from datafusion_distributed_tpu.runtime.mesh_worker import (
@@ -1426,9 +1897,6 @@ class Coordinator:
         if not ok:
             return None
         span = task_number // span_w
-        urls = self.resolver.get_urls()
-        url = urls[(stage_id + span) % len(urls)]
-        worker = self.channels.get_worker(url)
         key = TaskKey(query_id, stage_id, task_number)
         lo, hi = span * span_w, min((span + 1) * span_w, task_count)
         if not hasattr(self, "_span_shipped"):  # direct-call safety
@@ -1438,7 +1906,16 @@ class Coordinator:
             self._span_lock = _threading.Lock()
         ship_key = (query_id, stage_id, lo)
         with self._span_lock:
-            if ship_key not in self._span_shipped:
+            hit = self._span_shipped.get(ship_key)
+            if hit is None:
+                # route from live membership only when SHIPPING the span;
+                # sibling tasks reuse the shipped worker below, so a
+                # membership change between siblings cannot split one
+                # span's tasks across two workers (only one of which
+                # holds the span plan)
+                urls = self.resolver.get_urls()
+                url = urls[(stage_id + span) % len(urls)]
+                worker = self.channels.get_worker(url)
                 plan_obj = encode_plan(
                     span_specialized(stage_plan, lo, hi), worker.table_store
                 )
@@ -1456,8 +1933,9 @@ class Coordinator:
 
                     worker.table_store.remove(collect_table_ids(plan_obj))
                     raise
-                self._span_shipped[ship_key] = plan_obj
-        return worker, key, self._span_shipped[ship_key], worker.table_store
+                hit = self._span_shipped[ship_key] = (plan_obj, worker)
+        plan_obj, worker = hit
+        return worker, key, plan_obj, worker.table_store
 
     def _record_task_progress(self, worker, key) -> None:
         if not self.collect_metrics:

@@ -23,6 +23,7 @@ LocalWorkerConnection-vs-RemoteWorkerConnection duality of the reference
 from __future__ import annotations
 
 import json
+import threading
 from concurrent import futures
 from typing import Optional
 
@@ -567,31 +568,139 @@ class GrpcPeerResolver:
 
 class GrpcCluster:
     """N gRPC workers on random localhost ports, one process — the
-    `start_localhost_context` analogue (`src/test_utils/localhost.rs`)."""
+    `start_localhost_context` analogue (`src/test_utils/localhost.rs`).
+
+    Membership is DYNAMIC (the gRPC face of the in-memory
+    `DynamicCluster`): `add_worker` spawns a new server and bumps the
+    monotonically increasing `membership_epoch`; `remove_worker` stops a
+    server NOW (in-flight RPCs fail with UNAVAILABLE -> the retryable
+    taxonomy); `drain_worker` keeps the server running for in-flight work
+    and peer pulls but drops the url from `get_urls()` so no new tasks
+    route to it."""
 
     def __init__(self, num_workers: int, ttl_seconds: float = 600.0):
         self.servers = []
         self.urls = []
         self.local_workers: list[Worker] = []  # test introspection
         self._clients: dict[str, GrpcWorkerClient] = {}
-        peer_resolver = GrpcPeerResolver()
+        self._peer_resolver = GrpcPeerResolver()
+        self._ttl = ttl_seconds
+        self._epoch = 0
+        self._by_url: dict[str, tuple] = {}  # url -> (server, Worker)
+        # requested label -> bound url: a membership schedule names a
+        # joiner by label ("grpc://w-new") but the real endpoint is the
+        # bound localhost port; later leave/drain events for the label
+        # must resolve to the server they spawned
+        self._aliases: dict[str, str] = {}
+        self._draining: list[str] = []
+        self._departed: set = set()
+        # chaos membership events mutate from worker-call threads while
+        # coordinator pool threads read urls/epoch — same guarantee as
+        # DynamicCluster's RLock (a reader never sees a torn url-set/epoch
+        # pair, concurrent mutations never lose an epoch bump)
+        self._lock = threading.RLock()
         for i in range(num_workers):
-            w = Worker(url=f"grpc-local-{i}", ttl_seconds=ttl_seconds,
-                       peer_channels=peer_resolver)
-            server, port = serve_worker(w)
-            url = f"grpc://127.0.0.1:{port}"
-            w.url = url
-            self.servers.append(server)
-            self.urls.append(url)
-            self.local_workers.append(w)
+            self.add_worker()
+
+    def _resolve(self, url: str) -> str:
+        return self._aliases.get(url, url)
+
+    @property
+    def membership_epoch(self) -> int:
+        with self._lock:
+            return self._epoch
 
     def get_urls(self):
-        return list(self.urls)
+        with self._lock:
+            return list(self.urls)
 
     def get_worker(self, url: str) -> GrpcWorkerClient:
-        if url not in self._clients:
-            self._clients[url] = GrpcWorkerClient(url)
-        return self._clients[url]
+        with self._lock:
+            url = self._resolve(url)
+            if url in self._departed:
+                raise WorkerUnavailableError(
+                    f"worker {url} has left the cluster", worker_url=url
+                )
+            if url not in self._clients:
+                self._clients[url] = GrpcWorkerClient(url)
+            return self._clients[url]
+
+    # -- dynamic membership --------------------------------------------------
+    def add_worker(self, url: Optional[str] = None) -> str:
+        """Spawn + serve a new worker; -> its url. A requested ``url`` is
+        only a label — the real endpoint is the bound localhost port, and
+        the label resolves to it for later membership calls."""
+        i = len(self.local_workers)
+        w = Worker(url=url or f"grpc-local-{i}", ttl_seconds=self._ttl,
+                   peer_channels=self._peer_resolver)
+        server, port = serve_worker(w)
+        real_url = f"grpc://127.0.0.1:{port}"
+        w.url = real_url
+        with self._lock:
+            if url:
+                self._aliases[url] = real_url
+            self.servers.append(server)
+            self.urls.append(real_url)
+            self.local_workers.append(w)
+            self._by_url[real_url] = (server, w)
+            self._departed.discard(real_url)
+            self._epoch += 1
+        return real_url
+
+    def remove_worker(self, url: str, release: bool = True) -> None:
+        """Abrupt leave: stop the server now. ``release`` clears the local
+        worker's registry/store the way the dying process would."""
+        with self._lock:
+            url = self._resolve(url)
+            server, w = self._by_url[url]
+            if url in self.urls:
+                self.urls.remove(url)
+            if url in self._draining:
+                self._draining.remove(url)
+            self._departed.add(url)
+            self._epoch += 1
+        server.stop(grace=None)
+        if release:
+            w.registry.clear()
+            w.table_store.tables.clear()
+
+    def drain_worker(self, url: str) -> None:
+        with self._lock:
+            url = self._resolve(url)
+            if url not in self.urls:
+                return
+            self.urls.remove(url)
+            self._draining.append(url)
+            self._epoch += 1
+
+    def is_departed(self, url: str) -> bool:
+        with self._lock:
+            return self._resolve(url) in self._departed
+
+    def is_drained(self, url: str) -> bool:
+        with self._lock:
+            url = self._resolve(url)
+            if url not in self._draining:
+                return False
+            _server, w = self._by_url[url]
+        return len(w.registry) == 0 and not w.table_store.tables
+
+    def finish_drains(self) -> list:
+        with self._lock:
+            draining = list(self._draining)
+        removed = [u for u in draining if self.is_drained(u)]
+        for u in removed:
+            self.remove_worker(u, release=False)
+        return removed
+
+    def membership_snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "epoch": self._epoch,
+                "active": list(self.urls),
+                "draining": list(self._draining),
+                "departed": sorted(self._departed),
+            }
 
     def shutdown(self) -> None:
         for s in self.servers:

@@ -155,6 +155,26 @@ class HealthTracker:
                     return [u]
             return avail
 
+    def forget(self, url: str) -> bool:
+        """Drop all breaker state for ``url`` (the worker left the
+        membership — quarantine/backoff state for a nonexistent endpoint
+        is dead weight, and a rejoining worker under the same url starts
+        with a clean slate). -> whether state existed."""
+        with self._lock:
+            return self._workers.pop(url, None) is not None
+
+    def prune(self, live_urls) -> list[str]:
+        """Forget every tracked worker NOT in ``live_urls`` — called by the
+        coordinator on membership change so the per-worker maps track the
+        cluster instead of growing monotonically across churn. -> the urls
+        dropped."""
+        live = set(live_urls)
+        with self._lock:
+            dead = [u for u in self._workers if u not in live]
+            for u in dead:
+                del self._workers[u]
+            return dead
+
     def state_of(self, url: str) -> str:
         with self._lock:
             s = self._workers.get(url)

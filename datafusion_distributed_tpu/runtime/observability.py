@@ -101,6 +101,39 @@ class ObservabilityService:
         """url -> circuit-breaker state (empty without a wired tracker)."""
         return self.health.snapshot() if self.health is not None else {}
 
+    def get_membership(self) -> dict:
+        """Combined membership + health snapshot: the resolver's epoch and
+        role sets (an epoch-versioned DynamicCluster exposes them via
+        `membership_snapshot`; a static resolver degrades to active-only)
+        with each worker's circuit-breaker state joined in — one surface
+        answering both "who is in the cluster" and "who is being routed
+        around"."""
+        snap = getattr(self.resolver, "membership_snapshot", None)
+        if callable(snap):
+            base = snap()
+        else:
+            base = {
+                "epoch": getattr(self.resolver, "membership_epoch", None),
+                "active": list(self.resolver.get_urls()),
+                "draining": [],
+                "departed": [],
+            }
+        health = self.health.snapshot() if self.health is not None else {}
+        workers = []
+        for role in ("active", "draining"):
+            for url in base.get(role, ()):
+                entry = {"url": url, "role": role}
+                if url in health:
+                    entry["health"] = health[url]
+                workers.append(entry)
+        return {
+            "epoch": base.get("epoch"),
+            "active": list(base.get("active", ())),
+            "draining": list(base.get("draining", ())),
+            "departed": list(base.get("departed", ())),
+            "workers": workers,
+        }
+
     def get_fault_counters(self) -> dict:
         """Retry/quarantine/timeout counters (empty without wiring)."""
         if self.fault_counters is None:

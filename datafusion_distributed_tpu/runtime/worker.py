@@ -227,7 +227,18 @@ class TaskRegistry:
     def put(self, data: TaskData) -> None:
         with self._lock:
             self._evict()
+            # replacement evicts the displaced entry (releases its shipped
+            # slices — table ids are unique per encode, so the new entry's
+            # slices are untouched): a re-ship of the same key (retry to
+            # the same worker, peer-producer refresh after membership
+            # churn) must not strand the old attempt's slices, and callers
+            # must NOT pre-invalidate — that would open a window where a
+            # concurrent pull sees "no plan" for a key that is merely
+            # being replaced
+            old = self._entries.get(data.key)
             self._entries[data.key] = (time.time(), data)
+            if old is not None:
+                self._fire_evict(old[1])
 
     def get(self, key: TaskKey) -> Optional[TaskData]:
         with self._lock:
@@ -250,6 +261,17 @@ class TaskRegistry:
             hit = self._entries.pop(key, None)
             if hit is not None:
                 self._fire_evict(hit[1])
+
+    def clear(self) -> None:
+        """Evict EVERY entry (firing on_evict for each — shipped slices
+        are released), as a dying worker process would: DynamicCluster's
+        abrupt-leave path uses this so leak accounting across membership
+        churn stays exact."""
+        with self._lock:
+            entries = list(self._entries.values())
+            self._entries.clear()
+            for _, data in entries:
+                self._fire_evict(data)
 
     def _evict(self) -> None:
         now = time.time()
@@ -667,7 +689,15 @@ class Worker:
             # query-end sweep instead (the reference keeps its broadcast
             # batch cache for the query lifetime the same way,
             # `broadcast.rs:71-98`).
-            if done and key_names:
+            # The same retention applies to any producer shipped with a
+            # per-entry TTL override (data.ttl — peer-plane producers, which
+            # the coordinator's query-end sweep owns): a consumer whose load
+            # succeeded against THIS producer but failed against a departed
+            # sibling retries its whole pull set, and the re-pull of an
+            # already-fully-served partition must serve from the cached
+            # slices instead of dying with a fatal "no plan" (elastic
+            # membership: partial-success loads are routine under churn).
+            if done and key_names and data.ttl is None:
                 # metrics fire on last drop (impl_execute_task.rs:97-112):
                 # retain the final progress past the invalidation so the
                 # consumer's post-stream progress read still sees it

@@ -1,14 +1,14 @@
-"""Rolling-upgrade version safety across a worker fleet.
+"""Rolling upgrade with REAL drain on an elastic cluster.
 
 The reference's `examples/localhost_versioned_run` pair: workers advertise a
 version via GetWorkerInfo, and a coordinator built `with_version` refuses to
-ship plans to a mixed-version cluster (`worker_service.rs:175-179`) —
-protecting a rolling upgrade from silently running one query across two
-incompatible plan codecs.
-
-Here: a 3-worker in-memory cluster where one worker is mid-upgrade. The
-version-pinned coordinator rejects the query with a structured WorkerError
-naming the skewed worker; after the "upgrade" completes, the same query runs.
+ship plans to a mixed-version cluster (`worker_service.rs:175-179`). The
+membership layer underneath is the reference's dynamic `WorkerResolver`
+(SURVEY §1) — here `DynamicCluster`: each worker is upgraded by DRAINING it
+(no new tasks; in-flight work finishes; removed only when empty), then
+adding its upgraded replacement, which becomes routable immediately. The
+cluster serves queries through the whole roll; the version-pinned
+coordinator is the safety rail that refuses the mixed-fleet window.
 """
 
 import os
@@ -43,9 +43,12 @@ from datafusion_distributed_tpu.planner.distributed import (
 )
 from datafusion_distributed_tpu.runtime.coordinator import (
     Coordinator,
-    InMemoryCluster,
+    DynamicCluster,
 )
 from datafusion_distributed_tpu.runtime.errors import WorkerError
+from datafusion_distributed_tpu.runtime.worker import Worker
+
+OLD, NEW = "1.0.3", "1.1.0"
 
 
 def main() -> None:
@@ -67,27 +70,43 @@ def main() -> None:
     )
     dplan = distribute_plan(plan, DistributedConfig(num_tasks=3))
 
-    cluster = InMemoryCluster(num_workers=3)
-    # one worker is still on the old release
-    workers = list(cluster.workers.values())
-    workers[0].version = "1.1.0"
-    workers[1].version = "1.1.0"
-    workers[2].version = "1.0.3"
+    cluster = DynamicCluster()
+    for i in range(3):
+        cluster.add_worker(Worker(f"mem://w{i}-{OLD}", version=OLD))
 
-    coord = Coordinator(
-        resolver=cluster, channels=cluster, expected_version="1.1.0",
+    serving = Coordinator(resolver=cluster, channels=cluster)
+    pinned_new = Coordinator(
+        resolver=cluster, channels=cluster, expected_version=NEW,
     )
-    print("-- mixed-version cluster: the coordinator refuses the query --")
-    try:
-        coord.execute(dplan)
-        raise AssertionError("version skew not detected")
-    except WorkerError as e:
-        print(f"rejected: {e}")
 
-    # the upgrade finishes...
-    workers[2].version = "1.1.0"
-    print("\n-- fleet upgraded: same coordinator, same plan --")
-    out = coord.execute(dplan).to_pandas()
+    print(f"-- fleet on {OLD}, epoch {cluster.membership_epoch} --")
+    print(serving.execute(dplan).to_pandas().head(3).to_string(index=False))
+
+    print("\n-- rolling upgrade, one worker at a time (drain -> replace) --")
+    for i, url in enumerate(cluster.get_urls()):
+        cluster.drain_worker(url)
+        assert cluster.wait_drained(url, timeout_s=10.0), (
+            f"{url} did not drain"
+        )
+        print(f"drained+removed {url} "
+              f"(in-flight at removal: {cluster.in_flight(url)})")
+        cluster.add_worker(Worker(f"mem://w{i}-{NEW}", version=NEW))
+        # the cluster keeps serving mid-roll: routing sees live membership
+        out = serving.execute(dplan).to_pandas()
+        assert len(out) == 6
+        if i == 0:
+            # mixed-fleet window: the version-pinned coordinator refuses
+            print("mixed fleet: ", end="")
+            try:
+                pinned_new.execute(dplan)
+                raise AssertionError("version skew not detected")
+            except WorkerError as e:
+                print(f"pinned coordinator rejected ({e})")
+
+    snap = cluster.membership_snapshot()
+    print(f"\n-- roll complete: epoch {snap['epoch']}, "
+          f"active={snap['active']} --")
+    out = pinned_new.execute(dplan).to_pandas()
     print(out.to_string(index=False))
     assert len(out) == 6
 

@@ -69,10 +69,22 @@ if ! python -m pytest tests/test_stage_scheduler.py -q --no-header \
         -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
     FAILED+=("tests/test_stage_scheduler.py[gate]")
 fi
+# Elasticity gate (tests/test_elasticity.py): dynamic membership —
+# workers joining/leaving/draining MID-QUERY under seeded chaos schedules
+# (DFTPU_CHAOS_SEED above) must keep TPC-H results byte-identical, leak
+# zero TableStore slices, drain to zero in-flight before removal, and
+# route tasks to mid-query joiners. The long churn+fault sweeps are
+# @slow; DFTPU_TEST_MARKERS="" runs them.
+echo "=== tests/test_elasticity.py (elastic-membership gate)"
+if ! python -m pytest tests/test_elasticity.py -q --no-header \
+        -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
+    FAILED+=("tests/test_elasticity.py[gate]")
+fi
 for f in tests/test_*.py; do
     [ "$f" = "tests/test_recompile_budget.py" ] && continue  # ran above
     [ "$f" = "tests/test_plan_verify.py" ] && continue  # ran above (gate)
     [ "$f" = "tests/test_stage_scheduler.py" ] && continue  # ran above
+    [ "$f" = "tests/test_elasticity.py" ] && continue  # ran above (gate)
     echo "=== $f"
     if ! python -m pytest "$f" -q --no-header -p no:cacheprovider \
             "${MARKER_ARGS[@]}" "$@"; then
