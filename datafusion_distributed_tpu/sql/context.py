@@ -43,24 +43,36 @@ from datafusion_distributed_tpu.sql.parser import (
 from datafusion_distributed_tpu.sql.planner import PhysicalPlanner, PlannerConfig
 
 
+#: distinct sentinel for Catalog._ndv_cache misses (None is a valid
+#: cached verdict: "no such column")
+_NDV_MISS = object()
+
+
 class Catalog:
-    """Named tables (device-resident) + views."""
+    """Named tables (device-resident) + views. NDV computation and
+    registration serialize on a lock: the serving tier plans concurrent
+    submissions from N client threads against one catalog."""
 
     def __init__(self) -> None:
+        import threading
+
         self.tables: dict[str, Table] = {}
         self.views: dict[str, LogicalPlan] = {}
         self._ndv_cache: dict = {}
+        self._ndv_lock = threading.Lock()
         # bumped on every (re-)registration: physical plans embed scan
         # Tables and plan-time scalar-subquery results, so the session's
         # plan cache keys on this to drop plans built over replaced data
         self.generation = 0
 
     def register_table(self, name: str, table: Table) -> None:
-        self.tables[name.lower()] = table
-        self.generation += 1
-        self._ndv_cache = {
-            k: v for k, v in self._ndv_cache.items() if k[0] != name.lower()
-        }
+        with self._ndv_lock:
+            self.tables[name.lower()] = table
+            self.generation += 1
+            self._ndv_cache = {
+                k: v for k, v in self._ndv_cache.items()
+                if k[0] != name.lower()
+            }
 
     def has_table(self, name: str) -> bool:
         return name.lower() in self.tables
@@ -76,39 +88,50 @@ class Catalog:
         orderer's fan-out estimates — the statistics the reference gets from
         DataFusion's table providers)."""
         key = (table.lower(), column)
-        if key not in self._ndv_cache:
-            import numpy as np
+        with self._ndv_lock:
+            cached = self._ndv_cache.get(key, _NDV_MISS)
+            gen0 = self.generation
+        if cached is not _NDV_MISS:
+            return cached
+        import numpy as np
 
-            t = self.tables.get(table.lower())
-            if t is None or column not in t:
-                self._ndv_cache[key] = None
-            else:
-                # sample-bounded: the heuristic only needs the order of
-                # magnitude, and a full 60M-row device->host pull at bind
-                # time would eat the benchmark budget. STRIDED, not a prefix:
-                # generated keys are clustered (l_orderkey repeats ~4x in a
-                # run), so a prefix under-counts distincts and freezes the
-                # estimate below the extrapolation threshold.
-                total = int(t.num_rows)
-                n = min(total, 1 << 20)
-                stride = max(1, total // max(n, 1))
-                col = t.column(column)
-                vals = np.asarray(col.data[:total:stride][:n])
-                if col.validity is not None:
-                    vals = vals[np.asarray(col.validity[:total:stride][:n])]
-                sampled = max(len(vals), 1)
-                ndv = int(len(np.unique(vals)))
-                # distinct-on-sample extrapolates only when near-unique
-                # (a saturated sample means the column's true NDV is small)
-                if ndv > 0.9 * sampled:
-                    ndv = min(int(ndv * (total / sampled)), total)
-                elif sampled < total:
-                    # a non-extrapolated sampled count can still undercount
-                    # the true NDV; pad it so downstream hash-table sizing
-                    # (which treats this as an upper bound) overflows less
-                    ndv = min(int(ndv * 1.5) + 16, total)
-                self._ndv_cache[key] = ndv
-        return self._ndv_cache[key]
+        t = self.tables.get(table.lower())
+        if t is None or column not in t:
+            ndv = None
+        else:
+            # sample-bounded: the heuristic only needs the order of
+            # magnitude, and a full 60M-row device->host pull at bind
+            # time would eat the benchmark budget. STRIDED, not a prefix:
+            # generated keys are clustered (l_orderkey repeats ~4x in a
+            # run), so a prefix under-counts distincts and freezes the
+            # estimate below the extrapolation threshold.
+            total = int(t.num_rows)
+            n = min(total, 1 << 20)
+            stride = max(1, total // max(n, 1))
+            col = t.column(column)
+            vals = np.asarray(col.data[:total:stride][:n])
+            if col.validity is not None:
+                vals = vals[np.asarray(col.validity[:total:stride][:n])]
+            sampled = max(len(vals), 1)
+            ndv = int(len(np.unique(vals)))
+            # distinct-on-sample extrapolates only when near-unique
+            # (a saturated sample means the column's true NDV is small)
+            if ndv > 0.9 * sampled:
+                ndv = min(int(ndv * (total / sampled)), total)
+            elif sampled < total:
+                # a non-extrapolated sampled count can still undercount
+                # the true NDV; pad it so downstream hash-table sizing
+                # (which treats this as an upper bound) overflows less
+                ndv = min(int(ndv * 1.5) + 16, total)
+        # the compute ran OUTSIDE the lock (concurrent planners may race
+        # the same cold column; both compute the same deterministic
+        # value). Cache only if the catalog generation is unchanged — a
+        # re-registration mid-compute means this estimate sampled the
+        # REPLACED table and must not be installed for the new one.
+        with self._ndv_lock:
+            if self.generation != gen0:
+                return ndv
+            return self._ndv_cache.setdefault(key, ndv)
 
     def scan_exec(self, name: str, columns: Sequence[str]) -> ExecutionPlan:
         t = self.tables[name.lower()]
@@ -169,6 +192,35 @@ class SessionConfig:
                         f"invalid verify_plans mode {value!r} (expected "
                         f"one of {MODES})"
                     )
+            elif key == "max_concurrent_queries":
+                # serving-tier admission knobs (runtime/serving.py) are
+                # validated at SET time: a bad value must fail the SET,
+                # not wedge admission decisions mid-serve
+                value = int(value)
+                if value < 1:
+                    raise ValueError(
+                        "max_concurrent_queries must be >= 1"
+                    )
+            elif key == "admission_budget_bytes":
+                value = float(value)
+                if value < 0:
+                    raise ValueError(
+                        "admission_budget_bytes must be >= 0 (0 = "
+                        "unlimited)"
+                    )
+            elif key == "serving_stage_slots":
+                value = int(value)
+                if value < 0:
+                    raise ValueError(
+                        "serving_stage_slots must be >= 0 (0 = auto: "
+                        "the worker count)"
+                    )
+            elif key == "fair_share":
+                if isinstance(value, str):
+                    value = value.strip().lower() not in (
+                        "0", "false", "off", ""
+                    )
+                value = bool(value)
             self.distributed_options[key] = value
         elif scope == "planner":
             if not hasattr(self.planner, key):
@@ -177,6 +229,16 @@ class SessionConfig:
         else:
             raise ValueError(f"unknown option scope {scope!r}")
 
+    def distributed_snapshot(self) -> dict:
+        """GIL-atomic copy of `distributed_options`: under the serving
+        tier a client thread's first `SET distributed.<new_key>` can
+        insert a key while another query's driver copies the dict, and a
+        Python-level `dict(d)`/`.items()` iteration racing that insert
+        raises "dictionary changed size during iteration" — failing an
+        innocent query. `list(d.items())` materializes in one C call
+        (no bytecode runs mid-snapshot), so readers always see a
+        consistent point-in-time copy."""
+        return dict(list(self.distributed_options.items()))
 
 
 class OverflowRetryAbandoned(RuntimeError):
@@ -400,7 +462,8 @@ class DataFrame:
 
         if config is None:
             opts = {
-                k: v for k, v in self.ctx.config.distributed_options.items()
+                k: v
+                for k, v in self.ctx.config.distributed_snapshot().items()
                 if k in DistributedConfig.__dataclass_fields__
             }
             opts.setdefault("num_tasks", num_tasks)
@@ -517,7 +580,7 @@ class DataFrame:
         )
 
         opts = {
-            k: v for k, v in self.ctx.config.distributed_options.items()
+            k: v for k, v in self.ctx.config.distributed_snapshot().items()
             if k in DistributedConfig.__dataclass_fields__
         }
         opts["num_tasks"] = num_tasks
@@ -557,7 +620,7 @@ class DataFrame:
             cls = AdaptiveCoordinator if adaptive else Coordinator
             coordinator = cls(
                 resolver=cluster, channels=cluster,
-                config_options=dict(self.ctx.config.distributed_options),
+                config_options=self.ctx.config.distributed_snapshot(),
                 passthrough_headers=dict(self.ctx.config.passthrough_headers),
             )
         pcfg = self.ctx.config.planner
@@ -671,6 +734,8 @@ class VerifyReport(str):
 
 class SessionContext:
     def __init__(self, config: Optional[SessionConfig] = None):
+        import threading
+
         self.catalog = Catalog()
         self.config = config or SessionConfig()
         # session-level physical-plan cache, keyed by (logical-plan
@@ -678,22 +743,27 @@ class SessionContext:
         # ctx.sql(text) submissions of the same query reuse the planned
         # tree (and therefore every downstream compiled-program cache
         # entry) instead of re-planning. Bounded LRU: entries pin scan
-        # Tables that may since have been de-registered.
+        # Tables that may since have been de-registered. Locked: the
+        # serving tier plans concurrent submissions from N client/driver
+        # threads against this one cache.
         self._plans: dict = {}
+        self._plans_lock = threading.Lock()
 
     _PLAN_CACHE_ENTRIES = 128
 
     def _plan_cache_get(self, key):
-        plan = self._plans.get(key)
-        if plan is not None:
-            self._plans.pop(key)
-            self._plans[key] = plan  # move-to-end: LRU
-        return plan
+        with self._plans_lock:
+            plan = self._plans.get(key)
+            if plan is not None:
+                self._plans.pop(key)
+                self._plans[key] = plan  # move-to-end: LRU
+            return plan
 
     def _plan_cache_put(self, key, plan) -> None:
-        while len(self._plans) >= self._PLAN_CACHE_ENTRIES:
-            self._plans.pop(next(iter(self._plans)))
-        self._plans[key] = plan
+        with self._plans_lock:
+            while len(self._plans) >= self._PLAN_CACHE_ENTRIES:
+                self._plans.pop(next(iter(self._plans)))
+            self._plans[key] = plan
 
     # -- registration ---------------------------------------------------------
     def register_parquet(self, name: str, paths, capacity: Optional[int] = None):
@@ -754,6 +824,200 @@ class SessionContext:
                 return None  # DDL/SET-only script
             raise ValueError("no SQL statements in input")
         return result
+
+    def prepare(self, template: str) -> PreparedStatement:
+        """Prepared-statement API: ``ctx.prepare("... where x < $1")``
+        -> a PreparedStatement whose ``execute(params)`` /
+        ``submit(serving_session, params)`` bindings share one compiled
+        program per stage via the literal-hoisting + fingerprint
+        machinery (plan/fingerprint.py) — zero compiles at serving time
+        after the first execution."""
+        return PreparedStatement(self, template)
+
+
+def _parse_placeholders(template: str) -> list:
+    """-> [(literal_text | None, param_name | None)] segments of a
+    prepared-statement template. Placeholders are ``$name`` or ``$1``-style
+    (1-based positional); ``$`` inside single-quoted SQL string literals,
+    double-quoted identifiers, and ``--`` / ``/* */`` comments is text,
+    not a placeholder (standard '' / "" escaping respected)."""
+    import re as _re
+
+    out: list = []
+    buf: list = []
+    i, n = 0, len(template)
+    ph = _re.compile(r"\$([A-Za-z_][A-Za-z0-9_]*|[0-9]+)")
+    while i < n:
+        c = template[i]
+        if c in ("'", '"'):
+            q = c
+            j = i + 1
+            while j < n:
+                if template[j] == q:
+                    if j + 1 < n and template[j + 1] == q:
+                        j += 2
+                        continue
+                    break
+                j += 1
+            buf.append(template[i:j + 1])
+            i = j + 1
+        elif c == "-" and template[i:i + 2] == "--":
+            j = template.find("\n", i)
+            j = n if j < 0 else j
+            buf.append(template[i:j])
+            i = j
+        elif c == "/" and template[i:i + 2] == "/*":
+            j = template.find("*/", i + 2)
+            j = n if j < 0 else j + 2
+            buf.append(template[i:j])
+            i = j
+        elif c == "$":
+            m = ph.match(template, i)
+            if m:
+                if buf:
+                    out.append(("".join(buf), None))
+                    buf = []
+                out.append((None, m.group(1)))
+                i = m.end()
+            else:
+                buf.append(c)
+                i += 1
+        else:
+            buf.append(c)
+            i += 1
+    if buf:
+        out.append(("".join(buf), None))
+    return out
+
+
+def _format_param(value) -> str:
+    """SQL literal text for a bound parameter value. Numeric and date
+    parameters become exactly the literals the template author would have
+    written — so the PR 2 literal hoist lifts them into the runtime
+    parameter vectors and every binding shares one compiled program."""
+    import datetime as _dt
+
+    if value is None:
+        return "NULL"
+    if isinstance(value, bool):
+        return "true" if value else "false"
+    if isinstance(value, int):
+        return str(value)
+    if isinstance(value, float):
+        return repr(value)
+    if isinstance(value, _dt.datetime):
+        # DATE32 is the engine's only temporal type: a datetime binds as
+        # its date ONLY when that loses nothing — a nonzero time-of-day
+        # silently admitting/excluding a day's rows must be an error
+        if (value.hour or value.minute or value.second
+                or value.microsecond or value.tzinfo is not None):
+            raise TypeError(
+                "datetime parameters with a time-of-day (or tzinfo) are "
+                "not supported — the engine's temporal type is DATE32; "
+                "pass a datetime.date"
+            )
+        return f"date '{value.date().isoformat()}'"
+    if isinstance(value, _dt.date):
+        return f"date '{value.isoformat()}'"
+    if isinstance(value, str):
+        return "'" + value.replace("'", "''") + "'"
+    raise TypeError(
+        f"unsupported prepared-statement parameter type "
+        f"{type(value).__name__}"
+    )
+
+
+class PreparedStatement:
+    """A parameterized query template (``ctx.prepare(sql)``) riding the
+    cross-query compile-reuse machinery: every ``execute(params)`` binds
+    the parameter values as literals, and because the PR 2 literal hoist
+    lifts numeric/date comparison literals into runtime parameter vectors
+    keyed out of the plan fingerprint, all bindings of one template share
+    ONE compiled program per stage — zero new XLA compiles at serving
+    time after the first (warming) execution. String parameters bind too,
+    but distinct string values fingerprint distinctly (their evaluation
+    is trace-time dictionary work) and compile per distinct value.
+
+    Placeholders: ``$name`` (bind with a dict / kwargs) or ``$1..$n``
+    (bind with a sequence). `warm()` runs the first (compiling) execution
+    eagerly so serving-path submissions are execute-bound from the start.
+    """
+
+    def __init__(self, ctx: "SessionContext", template: str):
+        self.ctx = ctx
+        self.template = template
+        self._segments = _parse_placeholders(template)
+        names: list[str] = []
+        for _text, name in self._segments:
+            if name is not None and name not in names:
+                names.append(name)
+        if not names:
+            raise ValueError(
+                "prepared statement has no $placeholders — use ctx.sql()"
+                " for parameter-free queries"
+            )
+        self.param_names = names
+        self.positional = all(n.isdigit() for n in names)
+
+    def _mapping(self, params, kw) -> dict:
+        if params is None:
+            mapping = dict(kw)
+        elif isinstance(params, dict):
+            mapping = {**params, **kw}
+        elif isinstance(params, (list, tuple)):
+            if not self.positional:
+                raise ValueError(
+                    "sequence parameters require $1..$n placeholders; "
+                    f"this template names {self.param_names}"
+                )
+            mapping = {str(i + 1): v for i, v in enumerate(params)}
+            mapping.update(kw)
+        else:
+            raise TypeError(
+                "params must be a dict, a sequence, or keyword arguments"
+            )
+        missing = [n for n in self.param_names if n not in mapping]
+        if missing:
+            raise ValueError(f"missing parameters: {missing}")
+        return mapping
+
+    def bind_sql(self, params=None, **kw) -> str:
+        """The template with every placeholder bound as a SQL literal."""
+        mapping = self._mapping(params, kw)
+        return "".join(
+            text if name is None else _format_param(mapping[name])
+            for text, name in self._segments
+        )
+
+    def to_df(self, params=None, **kw) -> "DataFrame":
+        """Plan the bound statement (session plan cache applies)."""
+        return self.ctx.sql(self.bind_sql(params, **kw))
+
+    def execute(self, params=None, **kw):
+        """Single-process execution -> pyarrow Table."""
+        return self.to_df(params, **kw).collect()
+
+    def execute_coordinated(self, params=None, coordinator=None,
+                            num_workers: int = 2, num_tasks: int = 4,
+                            **kw):
+        """Distributed (host-runtime tier) execution -> pyarrow Table."""
+        return self.to_df(params, **kw).collect_coordinated(
+            coordinator=coordinator, num_workers=num_workers,
+            num_tasks=num_tasks,
+        )
+
+    def submit(self, session, params=None, priority: int = 0, **kw):
+        """Submit a binding to a ServingSession -> QueryHandle (the
+        serving hot path: parse + bind + plan-cache hit + fingerprint-
+        keyed program reuse, no compiles after warm())."""
+        return session.submit(self.bind_sql(params, **kw),
+                              priority=priority)
+
+    def warm(self, params=None, **kw) -> "PreparedStatement":
+        """Run the first (compiling) execution now; subsequent bindings
+        are execute-bound. -> self, for chaining."""
+        self.execute(params, **kw)
+        return self
 
 
 class _ViewCatalog:
