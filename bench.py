@@ -356,6 +356,192 @@ def _child_main() -> None:
 
 
 # --------------------------------------------------------------------------
+# Serving bench (`bench.py --serving`): closed-loop multi-query throughput.
+# Runs IN-PROCESS on the CPU backend by default (BENCH_PLATFORM overrides)
+# — this measures the serving tier's concurrency arbitration, not the
+# tunnel. N clients each submit-and-wait over a mixed workload against one
+# shared cluster; one client is a HEAVY analytical query (q21) so the
+# fair-share-vs-FIFO comparison shows whether cheap q1/q6 latency stays
+# bounded next to it. A uniform injected execute delay stands in for
+# device/DCN latency (the micro_bench stage_overlap precedent; both the
+# sequential baseline and the concurrent arms pay it identically per
+# task). Emits BENCH metric lines; the LAST is the authoritative qps.
+#
+# Env knobs: BENCH_SERVING_CLIENTS (8), BENCH_SERVING_ITERS (2),
+# BENCH_SF (0.002), BENCH_SERVING_DELAY_MS (80; 0 disables).
+#
+# Default regime is DELAY-dominated (small SF, 80 ms per execute): the
+# tier arbitrates stage placement, so its wins show where per-stage
+# latency is device/DCN wait — the production regime. On this 2-core
+# container a COMPUTE-dominated workload (large SF) measures core
+# contention instead: one-at-a-time execution is then near-optimal for
+# makespan and fair share trades heavy-query completion for cheap-query
+# latency (observed sf0.005: fair cheap-p50 2.8s vs 17.1s serialized,
+# but aggregate qps 0.21 vs 0.46 — the classic fairness/throughput
+# tradeoff, amplified by 2 cores). Both regimes are one env var away.
+# --------------------------------------------------------------------------
+
+_SERVING_Q1 = """
+select l_returnflag, l_linestatus,
+       sum(l_quantity) as sum_qty,
+       sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+       count(*) as count_order
+from lineitem
+where l_shipdate <= date '1998-09-02'
+group by l_returnflag, l_linestatus
+order by l_returnflag, l_linestatus
+"""
+
+_SERVING_Q6 = """
+select sum(l_extendedprice * l_discount) as revenue
+from lineitem
+where l_shipdate >= date '1994-01-01'
+  and l_shipdate < date '1995-01-01'
+  and l_discount between 0.05 and 0.07
+  and l_quantity < 24
+"""
+
+_SERVING_Q21 = """
+select s_name, count(*) as numwait
+from supplier, lineitem l1, orders, nation
+where s_suppkey = l1.l_suppkey
+  and o_orderkey = l1.l_orderkey
+  and o_orderstatus = 'F'
+  and l1.l_receiptdate > l1.l_commitdate
+  and exists (
+    select * from lineitem l2
+    where l2.l_orderkey = l1.l_orderkey
+      and l2.l_suppkey <> l1.l_suppkey
+  )
+  and not exists (
+    select * from lineitem l3
+    where l3.l_orderkey = l1.l_orderkey
+      and l3.l_suppkey <> l1.l_suppkey
+      and l3.l_receiptdate > l3.l_commitdate
+  )
+  and s_nationkey = n_nationkey
+  and n_name = 'SAUDI ARABIA'
+group by s_name
+order by numwait desc, s_name
+limit 100
+"""
+
+
+def _serving_bench() -> None:
+    platform = os.environ.get("BENCH_PLATFORM", "cpu")
+    os.environ.setdefault("JAX_PLATFORMS", platform)
+    import jax
+
+    if jax.config.jax_platforms != platform:
+        jax.config.update("jax_platforms", platform)
+
+    from datafusion_distributed_tpu.data.tpchgen import gen_tpch
+    from datafusion_distributed_tpu.runtime.chaos import (
+        FaultPlan,
+        FaultSpec,
+        wrap_cluster,
+    )
+    from datafusion_distributed_tpu.runtime.coordinator import (
+        InMemoryCluster,
+    )
+    from datafusion_distributed_tpu.runtime.serving import ServingSession
+    from datafusion_distributed_tpu.sql.context import SessionContext
+
+    sf = float(os.environ.get("BENCH_SF", "0.002"))
+    clients = int(os.environ.get("BENCH_SERVING_CLIENTS", "8"))
+    iters = int(os.environ.get("BENCH_SERVING_ITERS", "2"))
+    delay_ms = float(os.environ.get("BENCH_SERVING_DELAY_MS", "80"))
+    workers = 4
+
+    t0 = time.perf_counter()
+    ctx = SessionContext()
+    ctx.config.distributed_options["bytes_per_task"] = 1
+    ctx.config.distributed_options["broadcast_joins"] = False
+    for name, arrow in gen_tpch(sf=sf, seed=0).items():
+        ctx.register_arrow(name, arrow)
+    print(f"serving bench: registered tpch sf{sf} in "
+          f"{time.perf_counter() - t0:.1f}s", file=sys.stderr, flush=True)
+
+    def cluster():
+        inner = InMemoryCluster(workers)
+        if delay_ms <= 0:
+            return inner
+        return wrap_cluster(inner, FaultPlan(0, [
+            FaultSpec(site="execute", kind="delay",
+                      delay_s=delay_ms / 1e3, rate=1.0),
+        ], query_scoped=True))
+
+    def client_workload(ci: int) -> list:
+        # client 0 runs the heavy q21; everyone else a q1/q6 mix
+        if ci == 0:
+            return [_SERVING_Q21] * iters
+        return [(_SERVING_Q1 if (ci + i) % 2 else _SERVING_Q6)
+                for i in range(iters)]
+
+    def run_arm(max_conc: int, fair: bool) -> dict:
+        from datafusion_distributed_tpu.runtime.serving import (
+            percentile_ms,
+            run_closed_loop,
+        )
+
+        srv = ServingSession(
+            ctx, cluster=cluster(), num_tasks=workers,
+            max_concurrent_queries=max_conc, fair_share=fair,
+        )
+        res = run_closed_loop(
+            srv, [client_workload(i) for i in range(clients)],
+            classify=lambda ci: "heavy" if ci == 0 else "cheap",
+            timeout=1800.0,
+        )
+        srv.close()
+        if res["errors"]:
+            print(f"serving bench errors: {res['errors']}",
+                  file=sys.stderr, flush=True)
+        cheap = res["walls"].get("cheap", [])
+        heavy = res["walls"].get("heavy", [])
+        return {
+            "qps": round(res["queries"] / res["wall_s"], 3),
+            "wall_s": round(res["wall_s"], 2),
+            "queries": res["queries"],
+            "cheap_p50_ms": percentile_ms(cheap, 0.50),
+            "cheap_p99_ms": percentile_ms(cheap, 0.99),
+            "heavy_max_ms": percentile_ms(heavy, 0.99),
+            "errors": len(res["errors"]),
+        }
+
+    # warm every compile cache (templates + stage programs) off-clock
+    run_arm(clients, True)
+    seq = run_arm(1, True)  # serialized: the pre-serving baseline
+    fifo = run_arm(clients, False)
+    fair = run_arm(clients, True)
+    detail = {"sequential": seq, "fifo": fifo, "fair": fair,
+              "clients": clients, "sf": sf, "delay_ms": delay_ms,
+              "platform": platform}
+    print(json.dumps({"serving_detail": detail}), file=sys.stderr,
+          flush=True)
+    # cheap-query p99 with the heavy q21 alongside: fair share must keep
+    # it bounded vs FIFO (lower is better; vs_baseline = fifo/fair, >1
+    # means fair share improved tail latency)
+    if fair["cheap_p99_ms"] and fifo["cheap_p99_ms"]:
+        print(json.dumps({
+            "metric": f"serving_cheap_p99_ms_fair_{clients}clients",
+            "value": fair["cheap_p99_ms"],
+            "unit": "milliseconds",
+            "vs_baseline": round(
+                fifo["cheap_p99_ms"] / fair["cheap_p99_ms"], 4),
+        }), flush=True)
+    # authoritative line LAST: aggregate throughput at N clients;
+    # vs_baseline = speedup over the serialized one-query-at-a-time
+    # baseline (>1.0 = cross-query stage overlap is real)
+    print(json.dumps({
+        "metric": f"serving_qps_{clients}clients_sf{sf}",
+        "value": fair["qps"],
+        "unit": "qps",
+        "vs_baseline": round(fair["qps"] / max(seq["qps"], 1e-9), 4),
+    }), flush=True)
+
+
+# --------------------------------------------------------------------------
 # Parent: no JAX. Spawns/monitors children, aggregates, reports.
 # Never kills a child (a kill mid-init wedges the single-client tunnel);
 # children own their lifecycle via deadline watchdogs.
@@ -440,6 +626,9 @@ def _spawn_child(remaining_queries, deadline, platform):
 
 
 def main() -> None:
+    if "--serving" in sys.argv:
+        _serving_bench()
+        return
     if os.environ.get("BENCH_CHILD") == "1":
         _child_main()
         return

@@ -311,6 +311,90 @@ def main() -> None:
     })
     print(json.dumps(results[-1]), flush=True)
 
+    # ---- multi-query serving throughput -----------------------------------
+    # Closed-loop serving bench (runtime/serving.py): N clients each
+    # submit-and-wait over a mixed workload — cheap q6-shaped aggregates
+    # plus the bushy q5 — against ONE shared 4-worker cluster. Three arms
+    # on identical workloads: serialized (max_concurrent_queries=1), the
+    # fair-share global scheduler, and FIFO. The same injected execute
+    # delay as the stage_overlap case stands in for device/DCN latency;
+    # all arms pay it identically per task, so the qps ratio isolates the
+    # cross-query scheduling. Reported: qps + p50/p99 per arm, and the
+    # cheap-query p99 under fair vs FIFO (the "heavy query must not
+    # starve cheap ones" number).
+    from datafusion_distributed_tpu.runtime.serving import ServingSession
+
+    q6 = """
+    select sum(l_extendedprice * l_discount) as revenue
+    from lineitem
+    where l_shipdate >= date '1994-01-01'
+      and l_shipdate < date '1995-01-01'
+      and l_discount between 0.05 and 0.07 and l_quantity < 24
+    """
+    serve_delay_ms = 60.0
+    n_clients = 4
+
+    def serve_cluster():
+        return wrap_cluster(InMemoryCluster(4), FaultPlan(0, [
+            FaultSpec(site="execute", kind="delay",
+                      delay_s=serve_delay_ms / 1e3, rate=1.0),
+        ], query_scoped=True))
+
+    def run_serving(max_conc, fair):
+        from datafusion_distributed_tpu.runtime.serving import (
+            percentile_ms,
+            run_closed_loop,
+        )
+
+        srv = ServingSession(
+            sctx, cluster=serve_cluster(), num_tasks=4,
+            max_concurrent_queries=max_conc, fair_share=fair,
+        )
+        # one heavy client (q5), the rest cheap (q6): the starvation
+        # scenario the fair-share policy exists for
+        workloads = [[q5] * 2] + [[q6] * 4] * (n_clients - 1)
+        res = run_closed_loop(
+            srv, workloads,
+            classify=lambda ci: "heavy" if ci == 0 else "cheap",
+        )
+        srv.close()
+        cheap = res["walls"].get("cheap", [])
+        heavy = res["walls"].get("heavy", [])
+        return {
+            "qps": round(res["queries"] / res["wall_s"], 2),
+            "wall_ms": round(res["wall_s"] * 1e3, 1),
+            "cheap_p50_ms": percentile_ms(cheap, 0.50),
+            "cheap_p99_ms": percentile_ms(cheap, 0.99),
+            "heavy_max_ms": percentile_ms(heavy, 1.0),
+            "errors": res["errors"],
+        }
+
+    run_serving(n_clients, True)  # warm every compile cache once
+    seq = run_serving(1, True)
+    fair = run_serving(n_clients, True)
+    fifo = run_serving(n_clients, False)
+    results.append({"bench": "serving_throughput_sequential", **seq})
+    print(json.dumps(results[-1]), flush=True)
+    results.append({
+        "bench": "serving_throughput_fair",
+        **fair,
+        "speedup_vs_sequential": round(
+            fair["qps"] / max(seq["qps"], 1e-9), 2
+        ),
+        "clients": n_clients,
+        "injected_delay_ms": serve_delay_ms,
+    })
+    print(json.dumps(results[-1]), flush=True)
+    results.append({
+        "bench": "serving_throughput_fifo",
+        **fifo,
+        "cheap_p99_fair_over_fifo": (
+            round(fair["cheap_p99_ms"] / fifo["cheap_p99_ms"], 3)
+            if fair["cheap_p99_ms"] and fifo["cheap_p99_ms"] else None
+        ),
+    })
+    print(json.dumps(results[-1]), flush=True)
+
     # ---- transport framing ------------------------------------------------
     from datafusion_distributed_tpu.runtime import transport
     from datafusion_distributed_tpu.runtime.codec import encode_table

@@ -39,10 +39,13 @@ def _fmt_bytes(n: float) -> str:
 
 class Console:
     def __init__(self, resolver, channels, poll_s: float = 0.5,
-                 out=None, health=None):
+                 out=None, health=None, serving=None):
         # ``health``: a coordinator's HealthTracker — wiring it in joins
-        # circuit-breaker state into the membership rows below
-        self.obs = ObservabilityService(resolver, channels, health=health)
+        # circuit-breaker state into the membership rows below.
+        # ``serving``: a runtime/serving.py ServingSession — wiring it in
+        # adds the multi-query tier's active/queued/admitted line
+        self.obs = ObservabilityService(resolver, channels, health=health,
+                                        serving=serving)
         self.poll_s = poll_s
         self.out = out or sys.stdout
         self.tracked_keys: list = []  # TaskKeys to poll progress for
@@ -100,6 +103,30 @@ class Console:
             lines.append(
                 f"  {url:<28} {tasks:>5} {ver:>7} {'draining':>10}"
             )
+        srv = self.obs.get_serving_stats()
+        if srv and "error" not in srv:
+            comp = srv.get("completed", {})
+            lat = srv.get("latency", {}) or {}
+            p99 = lat.get("p99")
+            line = (
+                f"\n{_BOLD}serving{_RESET}  "
+                f"{srv.get('active', 0)} active, "
+                f"{srv.get('queued', 0)} queued, "
+                f"{srv.get('admitted_total', 0)} admitted "
+                f"({comp.get('done', 0)} done, "
+                f"{comp.get('failed', 0)} failed, "
+                f"{comp.get('cancelled', 0)} cancelled)"
+            )
+            budget = srv.get("budget_bytes") or 0
+            if budget:
+                line += (
+                    f"  {_DIM}footprint "
+                    f"{_fmt_bytes(srv.get('in_use_bytes', 0))}/"
+                    f"{_fmt_bytes(budget)}{_RESET}"
+                )
+            if p99 is not None:
+                line += f"  {_DIM}p99 {p99 * 1e3:.0f}ms{_RESET}"
+            lines.append(line)
         if self.tracked_keys:
             prog = self.obs.get_task_progress(self.tracked_keys)
             lines.append(f"\n{_BOLD}tasks ({len(prog)}){_RESET}")

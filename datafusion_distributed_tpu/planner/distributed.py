@@ -783,11 +783,16 @@ class StageDagNode:
     exchanges on the producer subtree's FRONTIER — the stages whose
     materialized output this one consumes (node = stage, edge = data
     dependency; the reference fans all stage work out as concurrent async
-    sends, `query_coordinator.rs:140-222`)."""
+    sends, `query_coordinator.rs:140-222`). ``est_bytes`` is the stage's
+    OWN device-buffer estimate (output_capacity x row_width summed over
+    the nodes between this boundary and its frontier, nested stages
+    excluded) — the cost hint the multi-query serving scheduler uses to
+    order same-pass stages deterministically (runtime/serving.py)."""
 
     stage_id: int
     exchange: ExecutionPlan
     deps: tuple = ()
+    est_bytes: int = 0
 
 
 @dataclass
@@ -848,6 +853,39 @@ def exchange_frontier(node: ExecutionPlan) -> list:
     return out
 
 
+def stage_device_bytes(exchange: ExecutionPlan) -> int:
+    """Device-buffer estimate for ONE stage: the exchange boundary plus
+    its producer subtree up to (not across) nested exchange boundaries —
+    the statistics.plan_device_bytes arithmetic scoped to a single
+    schedulable unit. Nested stages are their own DAG nodes and carry
+    their own estimates."""
+    from datafusion_distributed_tpu.planner.statistics import row_width
+
+    total = 0
+
+    def node_bytes(node) -> int:
+        try:
+            w = row_width(node.schema())
+        except Exception:
+            w = 8
+        try:
+            cap = int(node.output_capacity())
+        except Exception:
+            cap = 0
+        return cap * max(w, 1)
+
+    def walk(node, root: bool) -> None:
+        nonlocal total
+        if not root and getattr(node, "is_exchange", False):
+            return  # nested boundary: a different stage's cost
+        total += node_bytes(node)
+        for c in node.children():
+            walk(c, False)
+
+    walk(exchange, True)
+    return total
+
+
 def build_stage_dag(plan: ExecutionPlan) -> Optional[StageDag]:
     """Extract the stage dependency DAG from a staged plan, or None when
     the plan is not DAG-schedulable and the caller must fall back to the
@@ -883,6 +921,7 @@ def build_stage_dag(plan: ExecutionPlan) -> Optional[StageDag]:
             e.stage_id, e,
             deps=tuple(f.stage_id
                        for f in exchange_frontier(e.children()[0])),
+            est_bytes=stage_device_bytes(e),
         )
         for e in exchanges
     }

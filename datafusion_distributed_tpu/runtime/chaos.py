@@ -157,14 +157,27 @@ class FaultPlan:
     dynamic-membership surface at the same call sites."""
 
     def __init__(self, seed: int, specs: Sequence[FaultSpec],
-                 membership: Sequence[MembershipEvent] = ()):
+                 membership: Sequence[MembershipEvent] = (),
+                 query_scoped: bool = False):
         self.seed = int(seed)
         self.specs = list(specs)
         self.membership = list(membership)
+        #: per-QUERY call counting (the multi-query serving tier): each
+        #: query's (stage, task) call counts start at zero and the hash
+        #: input stays query-free, so every concurrent query replays the
+        #: IDENTICAL seeded fault schedule regardless of how the queries
+        #: interleave — per-query chaos determinism. Off (the default),
+        #: counts accumulate plan-wide across queries/attempts, the
+        #: pre-serving behavior every existing schedule was written
+        #: against. Caps (max_per_stage / max_total) stay plan-global in
+        #: both modes: they bound total injected damage, not per-query
+        #: schedules.
+        self.query_scoped = bool(query_scoped)
         self.fired: list[dict] = []
         self._lock = threading.Lock()
-        #: (spec_idx, site, stage, task) -> call count (the nth-call input
-        #: of the hash, so repeated attempts of one task re-roll)
+        #: (spec_idx, query_scope, site, stage, task) -> call count (the
+        #: nth-call input of the hash, so repeated attempts of one task
+        #: re-roll; query_scope is "" unless query_scoped)
         self._calls: dict[tuple, int] = {}
         self._per_stage: dict[tuple, int] = {}
         self._totals: dict[int, int] = {}
@@ -213,11 +226,14 @@ class FaultPlan:
         fires per call (first declared wins)."""
         stage_id = getattr(key, "stage_id", -1)
         task_number = getattr(key, "task_number", 0)
+        qscope = (getattr(key, "query_id", "") or "") if (
+            self.query_scoped
+        ) else ""
         with self._lock:
             for i, spec in enumerate(self.specs):
                 if not spec._matches(site, url, stage_id, task_number):
                     continue
-                ck = (i, site, stage_id, task_number)
+                ck = (i, qscope, site, stage_id, task_number)
                 nth = self._calls.get(ck, 0)
                 self._calls[ck] = nth + 1
                 if spec.max_total is not None and (
@@ -241,6 +257,20 @@ class FaultPlan:
                 })
                 return spec
         return None
+
+    def sweep_query(self, query_id: str) -> int:
+        """Release the per-query call-count state for a COMPLETED query
+        (meaningful under ``query_scoped``: each in-flight query holds its
+        own counters, and a long-lived serving process must shed them when
+        the query resolves); -> entries removed. The coordinator's
+        ``on_query_end`` hook is the natural caller."""
+        if not query_id:
+            return 0
+        with self._lock:
+            dead = [ck for ck in self._calls if ck[1] == query_id]
+            for ck in dead:
+                del self._calls[ck]
+        return len(dead)
 
 
 def _raise_for(spec: FaultSpec, site: str, url: str, key) -> None:

@@ -352,6 +352,21 @@ class Coordinator:
     # renders them as a critical-path summary whose overlap factor
     # (sum stage wall / query wall) proves inter-stage overlap
     stage_metrics: MetricsStore = field(default_factory=MetricsStore)
+    # -- multi-query serving hooks (runtime/serving.py) ---------------------
+    # external stage scheduler: an object with submit(fn, cost_hint=0) ->
+    # concurrent.futures.Future. When set, stage jobs (and the root stage)
+    # run on the GLOBAL cross-query pool under its fair-share policy
+    # instead of a per-query ThreadPoolExecutor — the generalization of
+    # the per-query stage-DAG scheduler to the whole serving tier
+    stage_pool: "object" = None
+    # pre-installed per-query cancel event: lets an async QueryHandle
+    # cancel a query BEFORE and DURING execute() without racing the
+    # event's creation (execute reuses this one when present)
+    cancel_event: "object" = None
+    # called with the query_id after every execute() completes (success,
+    # failure, or cancellation): the serving tier sweeps per-query chaos/
+    # metrics state here so a long-lived process sheds resolved queries
+    on_query_end: Optional[Callable[[str], None]] = None
 
     def overlap_factor(self, query_id: Optional[str] = None):
         """sum(stage wall) / query wall for ``query_id`` (default: most
@@ -414,19 +429,42 @@ class Coordinator:
         # dispatch/execute path checks it before doing work — a failed
         # sibling stage/task cancels in-flight and not-yet-submitted work
         # instead of leaving orphaned tasks running (and their staged
-        # TableStore slices leaking until TTL)
+        # TableStore slices leaking until TTL). FRESH per execute: the
+        # overflow-retry loops re-enter execute() on this same object, and
+        # a stale set event would abort every retry as cancelled. The
+        # separate `cancel_event` field (the serving tier's
+        # QueryHandle.cancel surface) is a read-only cancel REQUEST this
+        # coordinator never sets — _check_cancelled honors both, so an
+        # external cancel reaches any execute attempt without being
+        # conflated with one attempt's internal teardown.
         self._cancel_event = _threading.Event()
+        # pin this query's spans against the shared store's LRU for as
+        # long as it runs (runtime/metrics.py begin/finish_query)
+        self.stage_metrics.begin_query(query_id)
         q_t0 = _time.monotonic()
         try:
             resolved = self._materialize_exchanges(plan, query_id)
-            # the root stage: a single consumer task
-            r_t0 = _time.monotonic()
-            out = self._run_stage_task(
-                resolved, query_id, stage_id=-1, task_number=0, task_count=1
-            )
+            # the root stage: a single consumer task — routed through the
+            # global serving pool when one is installed, so even a
+            # single-stage query's heavy consumer competes under the
+            # fair-share policy instead of bypassing it on this thread
+            r_sub = _time.monotonic()
+            if self.stage_pool is not None:
+                fut = self.stage_pool.submit(
+                    lambda: (_time.monotonic(), self._run_stage_task(
+                        resolved, query_id, -1, 0, 1
+                    ))
+                )
+                r_t0, out = fut.result()
+            else:
+                r_t0 = r_sub
+                out = self._run_stage_task(
+                    resolved, query_id, stage_id=-1, task_number=0,
+                    task_count=1,
+                )
             r_t1 = _time.monotonic()
             self.stage_metrics.record_stage_span(
-                query_id, -1, r_t0, r_t0, r_t1, plane="root"
+                query_id, -1, r_sub, r_t0, r_t1, plane="root"
             )
             self.stage_metrics.record_query_wall(
                 query_id, r_t1 - q_t0
@@ -451,6 +489,37 @@ class Coordinator:
                         worker.registry.invalidate(key)
                 except Exception:
                     pass  # cleanup must not mask the query's own error
+            self.stage_metrics.finish_query(query_id)
+            if self.on_query_end is not None:
+                try:
+                    self.on_query_end(query_id)
+                except Exception:
+                    pass  # sweep hook must not mask the query's error
+
+    def sweep_query(self, query_id: str) -> None:
+        """Drop THIS query's accumulated per-task/stream metrics — the
+        unbounded per-query dicts a long-lived serving coordinator would
+        otherwise grow forever (stage spans are separately LRU-bounded in
+        MetricsStore and stay for explain_analyze). Callers that want the
+        data harvest it before sweeping; the serving tier calls this from
+        `on_query_end` once the QueryHandle captured its summary."""
+        # list() snapshots are taken in C (no GIL release) so sweeping one
+        # query never races another in-flight query's inserts
+        for key in [k for k in list(self.metrics) if k.query_id == query_id]:
+            self.metrics.pop(key, None)
+        for key in [
+            k for k in list(self.stream_metrics) if k[0] == query_id
+        ]:
+            self.stream_metrics.pop(key, None)
+        spans = getattr(self, "_span_shipped", None)
+        if spans:
+            with self._span_lock:
+                for k in [k for k in spans if k[0] == query_id]:
+                    spans.pop(k, None)
+        ok = getattr(self, "_span_ok_cache", None)
+        if ok:
+            for k in [k for k in ok if k[0] == query_id]:
+                ok.pop(k, None)
 
     def _check_worker_versions(self) -> None:
         from datafusion_distributed_tpu.runtime.errors import WorkerError
@@ -489,13 +558,18 @@ class Coordinator:
         """
         par = self._stage_parallelism()
         dag = None
-        if par > 1:
+        if par > 1 or self.stage_pool is not None:
             from datafusion_distributed_tpu.planner.distributed import (
                 build_stage_dag,
             )
 
             dag = build_stage_dag(plan)
-        if dag is None or len(dag.nodes) <= 1:
+        if dag is None or (
+            len(dag.nodes) <= 1 and self.stage_pool is None
+        ):
+            # a global serving pool routes even single-stage plans through
+            # the DAG path so every stage competes under the fair-share
+            # policy; without one a single stage gains nothing from it
             return self._materialize_exchanges_sequential(plan, query_id)
         return self._materialize_exchanges_dag(plan, query_id, dag, par)
 
@@ -633,20 +707,50 @@ class Coordinator:
             )
             return scan, submit_s, t0, _time.monotonic()
 
-        with cf.ThreadPoolExecutor(
-            max_workers=parallelism, thread_name_prefix="dftpu-stage"
-        ) as pool:
+        # the stage jobs' executor: a per-query bounded pool, or — under
+        # the serving tier — the GLOBAL cross-query scheduler installed as
+        # `stage_pool`, whose fair-share policy decides which query's
+        # ready stage gets the next slot (runtime/serving.py). Either way
+        # this thread keeps all DAG bookkeeping; only the job placement
+        # policy changes.
+        ext = self.stage_pool
+        pool = None
+        if ext is None:
+            pool = cf.ThreadPoolExecutor(
+                max_workers=parallelism, thread_name_prefix="dftpu-stage"
+            )
+        try:
             futs: dict = {}
+            # ready-but-unsubmitted stage ids: with the EXTERNAL pool the
+            # per-query `stage_parallelism` budget still bounds THIS
+            # query's in-flight stages (its documented memory-control
+            # role — every in-flight stage holds its producer outputs);
+            # the global pool's slots bound the tier, not the query. The
+            # internal pool needs no backlog: max_workers IS the bound.
+            backlog: list = []
 
             def submit(sid: int) -> None:
-                futs[pool.submit(
-                    job, nodes[sid].exchange, _time.monotonic()
-                )] = sid
+                node = nodes[sid]
+                sub_t = _time.monotonic()
+                if ext is not None:
+                    fut = ext.submit(
+                        lambda e=node.exchange, t=sub_t: job(e, t),
+                        cost_hint=node.est_bytes,
+                    )
+                else:
+                    fut = pool.submit(job, node.exchange, sub_t)
+                futs[fut] = sid
+
+            def enqueue(sid: int) -> None:
+                if ext is not None and len(futs) >= parallelism:
+                    backlog.append(sid)
+                else:
+                    submit(sid)
 
             for sid in sorted(
                 s for s, deps in waiting.items() if not deps
             ):
-                submit(sid)
+                enqueue(sid)
             while futs:
                 done, _ = cf.wait(
                     list(futs), return_when=cf.FIRST_COMPLETED
@@ -668,11 +772,19 @@ class Coordinator:
                     self._record_stage_span(query_id, sid, sub_s, t0, t1)
                     for c in sorted(consumers.get(sid, ())):
                         waiting[c].discard(sid)
-                        ev = getattr(self, "_cancel_event", None)
                         if not waiting[c] and first_error is None and (
-                            ev is None or not ev.is_set()
+                            not self._cancelled()
                         ):
-                            submit(c)
+                            enqueue(c)
+                # freed budget: promote backlogged ready stages (in
+                # deterministic stage-id order)
+                if backlog and first_error is None and not self._cancelled():
+                    backlog.sort()
+                    while backlog and len(futs) < parallelism:
+                        submit(backlog.pop(0))
+        finally:
+            if pool is not None:
+                pool.shutdown(wait=True)
         if first_error is not None:
             raise first_error
         if first_cancel is not None:
@@ -691,15 +803,26 @@ class Coordinator:
         )
 
     # -- per-query cancellation ---------------------------------------------
-    def _check_cancelled(self) -> None:
-        """Raise if this query's cancel event is set (a sibling stage or
-        task already failed fatally). Checked at every dispatch/execute
-        boundary so orphaned work stops instead of running to completion
-        against a query that can no longer succeed."""
+    def _cancelled(self) -> bool:
+        """Whether this query should stop: the per-execute internal event
+        (a sibling stage/task failed fatally) OR the externally-owned
+        cancel request (serving-tier QueryHandle.cancel)."""
         ev = getattr(self, "_cancel_event", None)
         if ev is not None and ev.is_set():
+            return True
+        ext = self.cancel_event
+        return ext is not None and ext.is_set()
+
+    def _check_cancelled(self) -> None:
+        """Raise if this query's cancel event is set (a sibling stage or
+        task already failed fatally, or an external cancel request).
+        Checked at every dispatch/execute boundary so orphaned work stops
+        instead of running to completion against a query that can no
+        longer succeed."""
+        if self._cancelled():
             raise TaskCancelledError(
-                "query cancelled: a sibling stage/task failed"
+                "query cancelled: a sibling stage/task failed or the "
+                "caller cancelled"
             )
 
     def _signal_cancel(self) -> None:

@@ -21,7 +21,10 @@ from datafusion_distributed_tpu.plan.physical import ExecutionPlan
 
 
 #: bound on distinct queries whose stage spans a MetricsStore retains
-#: (oldest evicted first — a long-lived coordinator must not grow forever)
+#: (least-recently-touched evicted first — a long-lived serving process
+#: must not grow forever; queries still RUNNING are pinned and never
+#: evicted, so a burst of short queries cannot erase an in-flight heavy
+#: query's spans before its own explain_analyze reads them)
 _STAGE_SPAN_QUERY_CAP = 64
 
 
@@ -33,17 +36,66 @@ class MetricsStore:
     (submit -> start -> materialized) and per-query wall clocks, rendered
     by `explain_analyze` as a critical-path summary whose
     `sum(stage wall) / query wall` overlap factor is the proof that
-    independent stages actually ran concurrently."""
+    independent stages actually ran concurrently.
+
+    Thread-safe: under the multi-query serving tier one store is shared
+    by every in-flight query's coordinator, so span recording, the
+    running-query pin set, and LRU eviction all serialize on one lock."""
 
     per_task: dict = field(default_factory=dict)
     #: query_id -> {stage_id: {"submit_s","start_s","end_s","wall_s",
-    #:                          "queue_s","plane"}} (insertion-ordered)
+    #:                          "queue_s","plane"}} (LRU-ordered: a touch
+    #: moves the query to the end; eviction pops from the front)
     stage_spans: dict = field(default_factory=dict)
     #: query_id -> total query wall seconds
     query_walls: dict = field(default_factory=dict)
 
+    def __post_init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        #: queries currently executing — exempt from LRU eviction
+        self._running: set = set()
+
     def insert(self, task_label: str, node_metrics: dict) -> None:
         self.per_task[task_label] = node_metrics
+
+    # -- query lifetime (eviction pinning) ----------------------------------
+    def begin_query(self, query_id: str) -> None:
+        """Pin ``query_id``: its spans/wall survive any LRU pressure until
+        `finish_query`. Coordinator.execute brackets every query with
+        these; a begin without a finish (caller died mid-query) is still
+        bounded — the pin set only holds in-flight queries."""
+        with self._lock:
+            self._running.add(query_id)
+
+    def finish_query(self, query_id: str) -> None:
+        with self._lock:
+            self._running.discard(query_id)
+            self._evict_lru()
+
+    def running_queries(self) -> set:
+        with self._lock:
+            return set(self._running)
+
+    def _evict_lru(self) -> None:
+        """Evict least-recently-touched NON-running queries down to the
+        cap (caller holds the lock). If running queries alone exceed the
+        cap the store grows past it — never evict a live query."""
+        for store in (self.stage_spans, self.query_walls):
+            if len(store) <= _STAGE_SPAN_QUERY_CAP:
+                continue
+            for qid in list(store):
+                if len(store) <= _STAGE_SPAN_QUERY_CAP:
+                    break
+                if qid in self._running:
+                    continue
+                store.pop(qid)
+
+    def _touch(self, store: dict, query_id: str) -> None:
+        hit = store.pop(query_id, None)
+        if hit is not None:
+            store[query_id] = hit  # move-to-end: LRU
 
     # -- stage scheduling spans ---------------------------------------------
     def record_stage_span(self, query_id: str, stage_id: int,
@@ -55,22 +107,24 @@ class MetricsStore:
         materialized. ``wall_s`` (start->end) is the stage's true
         execution span; queue wait is reported separately so a bounded
         stage_parallelism does not inflate the overlap arithmetic."""
-        spans = self.stage_spans.setdefault(query_id, {})
-        spans[stage_id] = {
-            "submit_s": submit_s,
-            "start_s": start_s,
-            "end_s": end_s,
-            "wall_s": max(end_s - start_s, 0.0),
-            "queue_s": max(start_s - submit_s, 0.0),
-            "plane": plane,
-        }
-        while len(self.stage_spans) > _STAGE_SPAN_QUERY_CAP:
-            self.stage_spans.pop(next(iter(self.stage_spans)))
+        with self._lock:
+            self._touch(self.stage_spans, query_id)
+            spans = self.stage_spans.setdefault(query_id, {})
+            spans[stage_id] = {
+                "submit_s": submit_s,
+                "start_s": start_s,
+                "end_s": end_s,
+                "wall_s": max(end_s - start_s, 0.0),
+                "queue_s": max(start_s - submit_s, 0.0),
+                "plane": plane,
+            }
+            self._evict_lru()
 
     def record_query_wall(self, query_id: str, wall_s: float) -> None:
-        self.query_walls[query_id] = wall_s
-        while len(self.query_walls) > _STAGE_SPAN_QUERY_CAP:
-            self.query_walls.pop(next(iter(self.query_walls)))
+        with self._lock:
+            self._touch(self.query_walls, query_id)
+            self.query_walls[query_id] = wall_s
+            self._evict_lru()
 
     def _span_query(self, query_id: Optional[str]) -> Optional[str]:
         if query_id is not None:
@@ -84,12 +138,13 @@ class MetricsStore:
         1.0 means fully serial; >1.0 proves inter-stage overlap.
         max_concurrent is the peak number of stage spans covering one
         instant (computed from the recorded intervals)."""
-        qid = self._span_query(query_id)
-        if qid is None:
-            return {}
-        spans = self.stage_spans[qid]
+        with self._lock:
+            qid = self._span_query(query_id)
+            if qid is None:
+                return {}
+            spans = dict(self.stage_spans[qid])
+            wall = self.query_walls.get(qid)
         total = sum(s["wall_s"] for s in spans.values())
-        wall = self.query_walls.get(qid)
         events = []
         for s in spans.values():
             events.append((s["start_s"], 1))
@@ -268,6 +323,7 @@ class LatencySketch:
 
     def __init__(self, gamma: float = 1.02, min_value: float = 1e-6):
         import math
+        import threading
 
         self.gamma = gamma
         self.min_value = min_value
@@ -276,42 +332,53 @@ class LatencySketch:
         self.count = 0
         self.min: Optional[float] = None
         self.max: Optional[float] = None
+        # the serving tier shares ONE sketch across every concurrent
+        # query's coordinator + driver threads: the read-modify-write on
+        # buckets/count must serialize or updates are silently lost
+        self._lock = threading.Lock()
 
     def record(self, value: float) -> None:
         import math
 
         v = max(float(value), self.min_value)
         idx = int(math.ceil(math.log(v / self.min_value) / self._log_gamma))
-        self.buckets[idx] = self.buckets.get(idx, 0) + 1
-        self.count += 1
-        self.min = v if self.min is None else min(self.min, v)
-        self.max = v if self.max is None else max(self.max, v)
+        with self._lock:
+            self.buckets[idx] = self.buckets.get(idx, 0) + 1
+            self.count += 1
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
 
     def merge(self, other: "LatencySketch") -> "LatencySketch":
         assert other.gamma == self.gamma
-        for idx, c in other.buckets.items():
-            self.buckets[idx] = self.buckets.get(idx, 0) + c
-        self.count += other.count
-        for bound in ("min", "max"):
-            ov = getattr(other, bound)
-            sv = getattr(self, bound)
-            if ov is not None:
-                pick = min if bound == "min" else max
-                setattr(self, bound, ov if sv is None else pick(sv, ov))
+        with other._lock:
+            obuckets = dict(other.buckets)
+            ocount, omin, omax = other.count, other.min, other.max
+        with self._lock:
+            for idx, c in obuckets.items():
+                self.buckets[idx] = self.buckets.get(idx, 0) + c
+            self.count += ocount
+            for bound, ov in (("min", omin), ("max", omax)):
+                sv = getattr(self, bound)
+                if ov is not None:
+                    pick = min if bound == "min" else max
+                    setattr(self, bound, ov if sv is None else pick(sv, ov))
         return self
 
     def percentile(self, q: float) -> Optional[float]:
         """q in [0, 1] -> value with <= gamma relative error."""
-        if self.count == 0:
-            return None
-        target = max(1, int(round(q * self.count)))
+        with self._lock:
+            if self.count == 0:
+                return None
+            buckets = dict(self.buckets)
+            count, vmax = self.count, self.max
+        target = max(1, int(round(q * count)))
         seen = 0
-        for idx in sorted(self.buckets):
-            seen += self.buckets[idx]
+        for idx in sorted(buckets):
+            seen += buckets[idx]
             if seen >= target:
                 # bucket midpoint in log space
                 return self.min_value * self.gamma ** (idx - 0.5)
-        return self.max
+        return vmax
 
     def summary(self) -> dict:
         return {
@@ -326,14 +393,15 @@ class LatencySketch:
 
     def to_dict(self) -> dict:
         """Wire format (the sketch-bytes analogue)."""
-        return {
-            "gamma": self.gamma,
-            "min_value": self.min_value,
-            "buckets": {str(k): v for k, v in self.buckets.items()},
-            "count": self.count,
-            "min": self.min,
-            "max": self.max,
-        }
+        with self._lock:
+            return {
+                "gamma": self.gamma,
+                "min_value": self.min_value,
+                "buckets": {str(k): v for k, v in self.buckets.items()},
+                "count": self.count,
+                "min": self.min,
+                "max": self.max,
+            }
 
     @classmethod
     def from_dict(cls, d: dict) -> "LatencySketch":

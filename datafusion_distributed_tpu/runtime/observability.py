@@ -71,14 +71,20 @@ class ObservabilityService:
     ``health``/``fault_counters`` (optional): the coordinator's
     `HealthTracker` and `FaultCounters` — wiring them in annotates cluster
     listings with circuit-breaker state and exposes the retry/quarantine
-    counters next to the task-progress surface."""
+    counters next to the task-progress surface.
+
+    ``serving`` (optional): a `runtime/serving.py ServingSession` — wiring
+    it in exposes the multi-query tier's active/queued/admitted counts and
+    latency summary through `get_serving_stats` (and the console's
+    serving line)."""
 
     def __init__(self, resolver, channels, sample_system: bool = False,
-                 health=None, fault_counters=None):
+                 health=None, fault_counters=None, serving=None):
         self.resolver = resolver
         self.channels = channels
         self.health = health
         self.fault_counters = fault_counters
+        self.serving = serving
         self.sampler = SystemMetricsSampler().start() if sample_system else None
 
     def ping(self) -> dict:
@@ -139,6 +145,17 @@ class ObservabilityService:
         if self.fault_counters is None:
             return {}
         return self.fault_counters.as_dict()
+
+    def get_serving_stats(self) -> dict:
+        """Multi-query serving tier counters (empty without a wired
+        ServingSession): active/queued query counts, admitted totals,
+        admission budget accounting, scheduler state, latency summary."""
+        if self.serving is None:
+            return {}
+        try:
+            return self.serving.stats()
+        except Exception as e:
+            return {"error": str(e)}
 
     def get_task_progress(self, keys) -> dict:
         """TaskKey list -> progress dicts from whichever worker holds each."""

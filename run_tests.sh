@@ -69,6 +69,18 @@ if ! python -m pytest tests/test_stage_scheduler.py -q --no-header \
         -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
     FAILED+=("tests/test_stage_scheduler.py[gate]")
 fi
+# Serving gate (tests/test_serving.py): the multi-query tier —
+# N concurrent clients over one cluster must produce byte-identical
+# results vs sequential execution (incl. under seeded chaos + membership
+# churn), admission control must queue instead of over-committing, the
+# global cross-query scheduler must respect its slot bound and fair-share
+# policy, and prepared-statement serving must perform zero new XLA
+# traces across parameter variations (the recompile gate's serving arm).
+echo "=== tests/test_serving.py (multi-query serving gate)"
+if ! python -m pytest tests/test_serving.py -q --no-header \
+        -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
+    FAILED+=("tests/test_serving.py[gate]")
+fi
 # Elasticity gate (tests/test_elasticity.py): dynamic membership —
 # workers joining/leaving/draining MID-QUERY under seeded chaos schedules
 # (DFTPU_CHAOS_SEED above) must keep TPC-H results byte-identical, leak
@@ -84,6 +96,7 @@ for f in tests/test_*.py; do
     [ "$f" = "tests/test_recompile_budget.py" ] && continue  # ran above
     [ "$f" = "tests/test_plan_verify.py" ] && continue  # ran above (gate)
     [ "$f" = "tests/test_stage_scheduler.py" ] && continue  # ran above
+    [ "$f" = "tests/test_serving.py" ] && continue  # ran above (gate)
     [ "$f" = "tests/test_elasticity.py" ] && continue  # ran above (gate)
     echo "=== $f"
     if ! python -m pytest "$f" -q --no-header -p no:cacheprovider \
