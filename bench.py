@@ -349,10 +349,62 @@ def _child_main() -> None:
             if hbm_gbps:
                 ev["pct_hbm_roofline"] = round(100.0 * gbps / hbm_gbps, 2)
             _emit(fh, **ev)
+            if (os.environ.get("BENCH_TRACE") == "1"
+                    and time.time() < deadline - 30):
+                _export_query_trace(ctx, sql, suite, sf, q, platform, fh)
         except Exception as e:  # a failing query must not eat the report
             _emit(fh, event="query_failed", q=q, platform=platform,
                   error=f"{type(e).__name__}: {e}"[:300])
     _emit(fh, event="done", hbm_gbps=hbm_gbps, platform=platform)
+
+
+_TRACE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          ".bench_traces")
+
+
+def _export_query_trace(ctx, sql, suite, sf, q, platform, fh) -> None:
+    """`bench.py --trace` artifact: one coordinated run of the query with
+    distributed tracing on, exported as Chrome trace-event JSON (load in
+    Perfetto) plus a per-stage GB/s summary in the events stream — so
+    BENCH_r*.json runs carry data-plane attribution, not just totals.
+    Best-effort by design: a trace-export failure must never eat the
+    query's timing."""
+    try:
+        from datafusion_distributed_tpu.runtime.tracing import (
+            DEFAULT_TRACE_STORE,
+            stage_data_rates,
+            to_chrome_trace,
+            trace_coverage,
+        )
+
+        saved = ctx.config.distributed_options.get("tracing")
+        ctx.config.distributed_options["tracing"] = "on"
+        try:
+            ctx.sql(sql).collect_coordinated_table(
+                num_workers=2, num_tasks=4
+            )
+        finally:
+            if saved is None:
+                ctx.config.distributed_options.pop("tracing", None)
+            else:
+                ctx.config.distributed_options["tracing"] = saved
+        trace = DEFAULT_TRACE_STORE.last()
+        if trace is None:
+            return
+        os.makedirs(_TRACE_DIR, exist_ok=True)
+        path = os.path.join(_TRACE_DIR, f"{suite}_sf{sf}_{q}.json")
+        with open(path, "w") as tf:
+            json.dump(to_chrome_trace(trace), tf)
+        cov, _gap = trace_coverage(trace)
+        stage_gbps = {
+            str(sid): round((slot.get("bytes_per_s") or 0.0) / 1e9, 4)
+            for sid, slot in stage_data_rates(trace).items()
+        }
+        _emit(fh, event="trace", q=q, platform=platform, path=path,
+              coverage=round(cov, 4), stage_gbps=stage_gbps)
+    except Exception as e:
+        _emit(fh, event="trace_failed", q=q, platform=platform,
+              error=f"{type(e).__name__}: {e}"[:200])
 
 
 # --------------------------------------------------------------------------
@@ -629,6 +681,12 @@ def main() -> None:
     if "--serving" in sys.argv:
         _serving_bench()
         return
+    if "--trace" in sys.argv:
+        # distributed-tracing artifacts: each query additionally runs
+        # once through the coordinated tier with `SET distributed.
+        # tracing = on`, exporting a Chrome trace-event JSON (Perfetto)
+        # with per-stage data-plane GB/s next to the timings
+        os.environ["BENCH_TRACE"] = "1"
     if os.environ.get("BENCH_CHILD") == "1":
         _child_main()
         return
@@ -794,6 +852,12 @@ def main() -> None:
                 progressed = True
             elif kind == "query_failed":
                 state["failed"][f"{plat}:{ev['q']}"] = ev.get("error", "")
+            elif kind == "trace":
+                # --trace artifact: Perfetto JSON path + per-stage GB/s
+                # attribution rides into BENCH_DETAIL meta
+                state["meta"].setdefault("traces", {})[ev["q"]] = {
+                    k: ev[k] for k in ("path", "coverage", "stage_gbps")
+                    if k in ev}
             elif kind == "done":
                 if ev.get("hbm_gbps") is not None:
                     state["meta"]["hbm_gbps"] = ev["hbm_gbps"]

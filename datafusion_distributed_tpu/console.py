@@ -29,12 +29,11 @@ _DIM = "\x1b[2m"
 _RESET = "\x1b[0m"
 
 
-def _fmt_bytes(n: float) -> str:
-    for unit in ("B", "KiB", "MiB", "GiB"):
-        if abs(n) < 1024:
-            return f"{n:.1f}{unit}"
-        n /= 1024
-    return f"{n:.1f}TiB"
+# one byte formatter for the whole observability surface (panel +
+# trace profile report) — defined in runtime/tracing.py
+from datafusion_distributed_tpu.runtime.tracing import (  # noqa: E402
+    format_bytes as _fmt_bytes,
+)
 
 
 class Console:
@@ -126,6 +125,26 @@ class Console:
                 )
             if p99 is not None:
                 line += f"  {_DIM}p99 {p99 * 1e3:.0f}ms{_RESET}"
+            lines.append(line)
+        ts = self.obs.get_trace_summary()
+        if ts and not ts.get("error") and ts.get("traces"):
+            line = (
+                f"\n{_BOLD}tracing{_RESET}  "
+                f"{ts['traces']} traces ({ts.get('running', 0)} running), "
+                f"{ts.get('spans', 0)} spans, "
+                f"{ts.get('events', 0)} events, "
+                f"data plane {_fmt_bytes(ts.get('data_plane_bytes', 0))}"
+            )
+            if ts.get("spans_dropped"):
+                line += f"  {_DIM}{ts['spans_dropped']} dropped{_RESET}"
+            ev = ts.get("events_by_name") or {}
+            faults = {k: v for k, v in ev.items()
+                      if k in ("task_retry", "task_rerouted", "peer_heal",
+                               "worker_quarantined", "query_cancel")}
+            if faults:
+                line += "  " + _DIM + ", ".join(
+                    f"{k}={faults[k]}" for k in sorted(faults)
+                ) + _RESET
             lines.append(line)
         if self.tracked_keys:
             prog = self.obs.get_task_progress(self.tracked_keys)

@@ -703,7 +703,14 @@ def execute_plan(
         # ride a single transfer
         return out, jnp.stack([any_overflow, any_precision]), metric_vals
 
-    cfg_items = tuple(sorted((config or {}).items()))
+    # the distributed-tracing wire context (runtime/tracing.py
+    # TRACE_CTX_KEY) must NEVER key a compiled program: its span ids
+    # differ per task/query, so admitting it would force one XLA trace
+    # per task. Worker.execute_task already strips it; this filter is the
+    # defense for direct execute_plan callers.
+    cfg_items = tuple(sorted(
+        (k, v) for k, v in (config or {}).items() if k != "trace_ctx"
+    ))
     # structural fingerprint -> content-addressed entry shared across plan
     # objects (fresh ctx.sql() submissions, literal-hoisted template
     # variants); no fingerprint -> legacy object-identity keying

@@ -793,6 +793,21 @@ class StageDagNode:
     exchange: ExecutionPlan
     deps: tuple = ()
     est_bytes: int = 0
+    #: planned output rows of the exchange boundary (capacity upper
+    #: bound) — with est_bytes, the planner's cost hints for this stage
+    est_rows: int = 0
+
+    def span_attrs(self) -> dict:
+        """Planner cost hints as trace-span attributes: the distributed
+        tracer (runtime/tracing.py) stamps these onto the stage span so a
+        profile can compare planned bytes/rows against the measured
+        data-plane counters of the same stage."""
+        return {
+            "est_bytes": int(self.est_bytes),
+            "est_rows": int(self.est_rows),
+            "deps": list(self.deps),
+            "exchange": type(self.exchange).__name__,
+        }
 
 
 @dataclass
@@ -916,12 +931,19 @@ def build_stage_dag(plan: ExecutionPlan) -> Optional[StageDag]:
     sids = [e.stage_id for e in exchanges]
     if any(s is None for s in sids) or len(set(sids)) != len(sids):
         return None
+    def est_rows_of(e) -> int:
+        try:
+            return int(e.output_capacity())
+        except Exception:
+            return 0
+
     nodes = {
         e.stage_id: StageDagNode(
             e.stage_id, e,
             deps=tuple(f.stage_id
                        for f in exchange_frontier(e.children()[0])),
             est_bytes=stage_device_bytes(e),
+            est_rows=est_rows_of(e),
         )
         for e in exchanges
     }

@@ -55,6 +55,13 @@ from datafusion_distributed_tpu.runtime.metrics import (
     FaultCounters,
     MetricsStore,
 )
+from datafusion_distributed_tpu.runtime.tracing import (
+    DEFAULT_TRACE_STORE,
+    NULL_TRACER,
+    TRACE_CTX_KEY,
+    resolve_tracing_mode,
+    table_nbytes,
+)
 from datafusion_distributed_tpu.runtime.worker import (
     TaskKey,
     Worker,
@@ -367,6 +374,28 @@ class Coordinator:
     # failure, or cancellation): the serving tier sweeps per-query chaos/
     # metrics state here so a long-lived process sheds resolved queries
     on_query_end: Optional[Callable[[str], None]] = None
+    # distributed-tracing store (runtime/tracing.py). The process-wide
+    # default backs `ctx.last_trace()` / `QueryHandle.trace()` /
+    # explain_analyze's profile fold; per-query Tracers hang on
+    # `self._tracer` for the execute's duration (NULL_TRACER when
+    # `SET distributed.tracing` is off — the always-cheap-when-off path)
+    trace_store: "object" = None
+
+    def _tr(self):
+        """The current query's tracer (NULL_TRACER outside execute or with
+        tracing off): one unconditional accessor so every instrumentation
+        site stays a plain call, never a branch tree."""
+        return getattr(self, "_tracer", NULL_TRACER)
+
+    def last_query_trace(self):
+        """The most recent query's QueryTrace on this coordinator (None
+        without tracing). Naming convention across surfaces:
+        ``*query_trace()`` returns the QueryTrace object,
+        ``trace()``/``last_trace()`` (QueryHandle / SessionContext)
+        return the exported Chrome trace-event dict."""
+        qid = getattr(self, "last_query_id", None)
+        store = self.trace_store or DEFAULT_TRACE_STORE
+        return store.get(qid) if qid else None
 
     def overlap_factor(self, query_id: Optional[str] = None):
         """sum(stage wall) / query wall for ``query_id`` (default: most
@@ -398,6 +427,23 @@ class Coordinator:
         # explain_analyze can bind the stage-schedule block to THIS
         # query's spans (a long-lived coordinator holds spans for many)
         plan._last_query_id = query_id
+        self.last_query_id = query_id
+        # distributed tracing (runtime/tracing.py): NULL_TRACER when off
+        trace_store = self.trace_store or DEFAULT_TRACE_STORE
+        try:
+            sample_rate = float(
+                self.config_options.get("tracing_sample_rate", 0.125)
+            )
+        except (TypeError, ValueError):
+            sample_rate = 0.125
+        self._tracer = trace_store.begin(
+            query_id, resolve_tracing_mode(self.config_options),
+            sample_rate=sample_rate,
+        )
+        # fresh per execute: stage ids repeat across queries, and a stale
+        # hint map would stamp the PREVIOUS query's planner estimates
+        # onto this query's stage spans
+        self._stage_span_hints = {}
         # producer tasks shipped but never coordinator-executed (peer data
         # plane): released at query end — the reference's query-end EOS
         # notifier role (`query_coordinator.rs:188-192`)
@@ -442,6 +488,10 @@ class Coordinator:
         # long as it runs (runtime/metrics.py begin/finish_query)
         self.stage_metrics.begin_query(query_id)
         q_t0 = _time.monotonic()
+        tracer = self._tracer
+        qspan = tracer.start_span("query", "query", query_id=query_id)
+        if tracer.active:
+            tracer.trace.root_id = qspan.span_id
         try:
             resolved = self._materialize_exchanges(plan, query_id)
             # the root stage: a single consumer task — routed through the
@@ -466,6 +516,7 @@ class Coordinator:
             self.stage_metrics.record_stage_span(
                 query_id, -1, r_sub, r_t0, r_t1, plane="root"
             )
+            self._trace_stage_span(-1, r_sub, r_t0, r_t1, "root")
             self.stage_metrics.record_query_wall(
                 query_id, r_t1 - q_t0
             )
@@ -489,6 +540,11 @@ class Coordinator:
                         worker.registry.invalidate(key)
                 except Exception:
                     pass  # cleanup must not mask the query's own error
+            # close the trace AFTER the peer sweep so last-drop worker
+            # spans (peer producers report at query end) still splice
+            tracer.end_span(qspan)
+            trace_store.finish(query_id)
+            self._tracer = NULL_TRACER
             self.stage_metrics.finish_query(query_id)
             if self.on_query_end is not None:
                 try:
@@ -564,14 +620,26 @@ class Coordinator:
             )
 
             dag = build_stage_dag(plan)
+        tr = self._tr()
+        if tr.active and dag is not None:
+            # planner stage cost hints become span attributes: the stage
+            # spans recorded later pick these up by stage id
+            self._stage_span_hints = {
+                sid: n.span_attrs() for sid, n in dag.nodes.items()
+            }
         if dag is None or (
             len(dag.nodes) <= 1 and self.stage_pool is None
         ):
             # a global serving pool routes even single-stage plans through
             # the DAG path so every stage competes under the fair-share
             # policy; without one a single stage gains nothing from it
-            return self._materialize_exchanges_sequential(plan, query_id)
-        return self._materialize_exchanges_dag(plan, query_id, dag, par)
+            with tr.span("schedule", "schedule", mode="sequential"):
+                return self._materialize_exchanges_sequential(
+                    plan, query_id
+                )
+        with tr.span("schedule", "schedule", mode="dag",
+                     stages=len(dag.nodes), parallelism=par):
+            return self._materialize_exchanges_dag(plan, query_id, dag, par)
 
     def _stage_parallelism(self) -> int:
         """`SET distributed.stage_parallelism`: the in-flight stage budget
@@ -614,6 +682,10 @@ class Coordinator:
         if tok == getattr(self, "_membership_seen", None):
             return tok
         self._membership_seen = tok
+        self._tr().event(
+            "membership_change",
+            epoch=tok[1] if tok[0] == "epoch" else None,
+        )
         if self.health is not None:
             for _u in self.health.prune(self._full_membership_urls()):
                 self.faults.bump("health_entries_pruned")
@@ -801,6 +873,29 @@ class Coordinator:
         self.stage_metrics.record_stage_span(
             query_id, stage_id, submit_s, start_s, end_s, plane=plane
         )
+        self._trace_stage_span(stage_id, submit_s, start_s, end_s, plane)
+
+    def _trace_stage_span(self, stage_id: int, submit_s: float,
+                          start_s: float, end_s: float,
+                          plane: str) -> None:
+        """Record a stage's trace span under the pre-reserved stage span
+        id (task spans created while the stage ran already parent to it);
+        planner cost hints (StageDagNode.span_attrs) ride as attributes."""
+        tr = self._tr()
+        if not tr.active:
+            return
+        attrs = dict(getattr(self, "_stage_span_hints", {}).get(
+            stage_id, ()
+        ))
+        attrs.update(
+            stage=stage_id, plane=plane,
+            queue_s=round(max(start_s - submit_s, 0.0), 6),
+        )
+        tr.finish_reserved(
+            ("stage", stage_id),
+            "root" if stage_id == -1 else f"stage {stage_id}",
+            "stage", submit_s, end_s, **attrs,
+        )
 
     # -- per-query cancellation ---------------------------------------------
     def _cancelled(self) -> bool:
@@ -820,6 +915,7 @@ class Coordinator:
         instead of running to completion against a query that can no
         longer succeed."""
         if self._cancelled():
+            self._tr().event("task_cancelled")
             raise TaskCancelledError(
                 "query cancelled: a sibling stage/task failed or the "
                 "caller cancelled"
@@ -828,6 +924,8 @@ class Coordinator:
     def _signal_cancel(self) -> None:
         ev = getattr(self, "_cancel_event", None)
         if ev is not None:
+            if not ev.is_set():
+                self._tr().event("query_cancel")
             ev.set()
 
     def _materialize_exchange_node(
@@ -839,6 +937,19 @@ class Coordinator:
         consumer-side scan."""
         stage_id = plan.stage_id if plan.stage_id is not None else 0
         t_prod = self._producer_task_count(plan, producer)
+        tr = self._tr()
+        with tr.span("exchange", "exchange",
+                     parent=tr.reserved_id(("stage", stage_id)),
+                     stage=stage_id, exchange=type(plan).__name__,
+                     producer_tasks=t_prod):
+            return self._materialize_exchange_body(
+                plan, producer, query_id, stage_id, t_prod
+            )
+
+    def _materialize_exchange_body(
+        self, plan: ExecutionPlan, producer: ExecutionPlan, query_id: str,
+        stage_id: int, t_prod: int,
+    ) -> ExecutionPlan:
         if self._peer_plane_enabled(plan):
             scan = self._peer_boundary(plan, producer, query_id, stage_id,
                                        t_prod)
@@ -1232,6 +1343,8 @@ class Coordinator:
                 # moved a producer heals here on its own retry
                 for s in peer_scans(stage_plan):
                     reroute_pulls(s, url_map)
+        if healed:
+            self._tr().event("peer_heal", reshipped=healed)
         return healed
 
     # -- partition-range data plane ------------------------------------------
@@ -1281,20 +1394,28 @@ class Coordinator:
                     yield (p, piece), est
 
             def pull(cancel):
+                # `xfer` binds when the transfer span opens below, before
+                # any puller runs — pull spans nest under the transfer
                 yield from self._pull_task_with_retry(
                     prepared, query_id, stage_id, task_number, t_prod,
-                    body, cancel,
+                    body, cancel, trace_parent=xfer.span_id,
                 )
 
             return pull
 
         obs = self._chunk_observer(stage_id)
-        chunks, stats = stream_stage_chunks(
-            [make_puller(i) for i in range(t_prod)], budget,
-            max_concurrent=max(len(self.resolver.get_urls()), 1),
-            payload_rows=lambda pr: int(pr[1].num_rows),
-            on_chunk=(lambda pr: obs(pr[1])) if obs is not None else None,
-        )
+        tr = self._tr()
+        with tr.span("transfer", "transfer", stage=stage_id,
+                     plane="partition-stream") as xfer:
+            chunks, stats = stream_stage_chunks(
+                [make_puller(i) for i in range(t_prod)], budget,
+                max_concurrent=max(len(self.resolver.get_urls()), 1),
+                payload_rows=lambda pr: int(pr[1].num_rows),
+                on_chunk=(lambda pr: obs(pr[1])) if obs is not None
+                else None,
+            )
+            xfer.set(bytes=stats.bytes_streamed, rows=stats.rows,
+                     chunks=stats.chunks)
         self.stream_metrics[(query_id, stage_id)] = {
             "bytes_streamed": stats.bytes_streamed,
             "chunks": stats.chunks,
@@ -1439,9 +1560,11 @@ class Coordinator:
                         yield out.slice_rows(lo, c), c * width
 
             def pull(cancel):
+                # `xfer` binds when the transfer span opens below, before
+                # any puller runs — pull spans nest under the transfer
                 yield from self._pull_task_with_retry(
                     prepared, query_id, stage_id, task_number, t_prod,
-                    body, cancel,
+                    body, cancel, trace_parent=xfer.span_id,
                 )
 
             return pull
@@ -1453,13 +1576,18 @@ class Coordinator:
         def progress(done, total, rows, _bytes):
             self._producer_progress(stage_id, done, total, rows, width)
 
-        chunks, stats = stream_stage_chunks(
-            [make_puller(i) for i in range(t_prod)], budget,
-            row_target=fetch,
-            max_concurrent=max(len(self.resolver.get_urls()), 1),
-            on_progress=progress,
-            on_chunk=self._chunk_observer(stage_id),
-        )
+        tr = self._tr()
+        with tr.span("transfer", "transfer", stage=stage_id,
+                     plane="stream") as xfer:
+            chunks, stats = stream_stage_chunks(
+                [make_puller(i) for i in range(t_prod)], budget,
+                row_target=fetch,
+                max_concurrent=max(len(self.resolver.get_urls()), 1),
+                on_progress=progress,
+                on_chunk=self._chunk_observer(stage_id),
+            )
+            xfer.set(bytes=stats.bytes_streamed, rows=stats.rows,
+                     chunks=stats.chunks, early_exit=stats.early_exit)
         self.stream_metrics[(query_id, stage_id)] = {
             "bytes_streamed": stats.bytes_streamed,
             "chunks": stats.chunks,
@@ -1556,57 +1684,80 @@ class Coordinator:
         stage_plan = self._prepare_stage_plan(stage_plan)
         state = _RetryState()
         kt = (query_id, stage_id, task_number)
-        while True:
-            self._check_cancelled()
-            worker, key, plan_obj, store = self._dispatch_task_with_retry(
-                stage_plan, query_id, stage_id, task_number, task_count,
-                state=state,
-            )
-            try:
+        tr = self._tr()
+        with tr.span("task", "task",
+                     parent=tr.reserved_id(("stage", stage_id)),
+                     stage=stage_id, task=task_number) as tsp:
+            while True:
                 self._check_cancelled()
-            except TaskCancelledError:
-                # a sibling failed while this task was shipping: release
-                # the just-staged slices NOW instead of leaking them until
-                # the worker registry's TTL sweep
-                try:
-                    self._cleanup_task(worker, key, plan_obj, store)
-                except Exception:
-                    pass
-                raise
-            try:
-                try:
-                    out = self._execute_with_deadline(worker, key)
-                    # metrics are best-effort: a flaky progress RPC after
-                    # a SUCCESSFUL execute must not discard the result,
-                    # re-run the task, or count against the worker
+                with tr.span("attempt", "attempt",
+                             attempt=state.attempt) as asp:
+                    worker, key, plan_obj, store = (
+                        self._dispatch_task_with_retry(
+                            stage_plan, query_id, stage_id, task_number,
+                            task_count, state=state,
+                        )
+                    )
                     try:
-                        self._record_task_progress(worker, key)
-                    except Exception:
-                        pass
-                finally:
-                    # best-effort: with the result in hand a cleanup
-                    # hiccup must not discard it (or re-execute the
-                    # task), and on the failure path it must not MASK
-                    # the execute error; cleanup is local-only ops
+                        self._check_cancelled()
+                    except TaskCancelledError:
+                        # a sibling failed while this task was shipping:
+                        # release the just-staged slices NOW instead of
+                        # leaking them until the registry's TTL sweep
+                        try:
+                            self._cleanup_task(worker, key, plan_obj, store)
+                        except Exception:
+                            pass
+                        raise
+                    asp.set(worker=worker.url)
                     try:
-                        self._cleanup_task(worker, key, plan_obj, store)
-                    except Exception:
-                        pass
-            except BaseException as e:
-                # attribute the failure to the worker the ERROR names when
-                # it names one (a dead peer PRODUCER failing a consumer's
-                # pull must not quarantine the healthy consumer)
-                if self._handle_task_failure(
-                    e, getattr(e, "worker_url", "") or worker.url, kt, state
-                ):
-                    # a departed worker may have taken shipped peer-producer
-                    # plans with it: re-ship them onto survivors and rewrite
-                    # this stage plan's pull specs BEFORE the re-dispatch
-                    self._heal_departed_peers(stage_plan, query_id)
-                    continue
-                raise
-            self._record_worker_success(worker.url)
-            return out
+                        try:
+                            with tr.span("execute_rpc", "execute",
+                                         worker=worker.url):
+                                out = self._execute_with_deadline(
+                                    worker, key
+                                )
+                            # metrics are best-effort: a flaky progress
+                            # RPC after a SUCCESSFUL execute must not
+                            # discard the result, re-run the task, or
+                            # count against the worker
+                            try:
+                                self._record_task_progress(worker, key)
+                            except Exception:
+                                pass
+                        finally:
+                            # best-effort: with the result in hand a
+                            # cleanup hiccup must not discard it (or
+                            # re-execute the task), and on the failure
+                            # path it must not MASK the execute error;
+                            # cleanup is local-only ops
+                            try:
+                                self._cleanup_task(worker, key, plan_obj,
+                                                   store)
+                            except Exception:
+                                pass
+                    except BaseException as e:
+                        # attribute the failure to the worker the ERROR
+                        # names when it names one (a dead peer PRODUCER
+                        # failing a consumer's pull must not quarantine
+                        # the healthy consumer)
+                        asp.set(error=type(e).__name__)
+                        if self._handle_task_failure(
+                            e, getattr(e, "worker_url", "") or worker.url,
+                            kt, state,
+                        ):
+                            # a departed worker may have taken shipped
+                            # peer-producer plans with it: re-ship them
+                            # onto survivors and rewrite this stage plan's
+                            # pull specs BEFORE the re-dispatch
+                            self._heal_departed_peers(stage_plan, query_id)
+                            continue
+                        raise
+                self._record_worker_success(worker.url)
+                if tr.active:
+                    tsp.set(bytes=table_nbytes(out),
+                            rows=int(out.num_rows))
+                return out
 
     # -- fault tolerance -----------------------------------------------------
     def _execute_with_deadline(self, worker, key) -> Table:
@@ -1690,6 +1841,7 @@ class Coordinator:
     def _record_worker_failure(self, url: str) -> None:
         if url and self._health_tracker().record_failure(url):
             self.faults.bump("workers_quarantined")
+            self._tr().event("worker_quarantined", worker=url)
 
     def _record_worker_success(self, url: str) -> None:
         if self.health is not None and url:
@@ -1748,10 +1900,19 @@ class Coordinator:
                 return False
         if state.attempt >= self._opt_int("max_task_retries"):
             self.faults.bump("retries_exhausted")
+            self._tr().event(
+                "retries_exhausted", stage=key_tuple[1],
+                task=key_tuple[2], error=type(exc).__name__,
+            )
             return False
         if isinstance(exc, TaskTimeoutError):
             self.faults.bump("task_timeouts")
         self.faults.bump("task_retries")
+        self._tr().event(
+            "task_retry", stage=key_tuple[1], task=key_tuple[2],
+            attempt=state.attempt, worker=url,
+            error=type(exc).__name__,
+        )
         if url:
             state.excluded.add(url)
         self._retry_backoff(key_tuple, state.attempt)
@@ -1776,11 +1937,13 @@ class Coordinator:
 
     def _dispatch_task_with_retry(self, stage_plan, query_id, stage_id,
                                   task_number, task_count, ttl=None,
-                                  state=None):
+                                  state=None, trace_parent=None):
         """Dispatch with retry + reroute. Standalone (peer-plane producers:
         ship now, execute at first pull) or as the shared dispatch phase of
         the execute/pull retry loops — ``state`` threads ONE attempt budget
-        across both phases of a task."""
+        across both phases of a task. ``trace_parent``: explicit trace-span
+        parent for callers whose thread has no span stack (streaming
+        pullers)."""
         state = state if state is not None else _RetryState()
         kt = (query_id, stage_id, task_number)
         while True:
@@ -1789,6 +1952,7 @@ class Coordinator:
                 disp = self._dispatch_task(
                     stage_plan, query_id, stage_id, task_number, task_count,
                     ttl=ttl, exclude=state.excluded,
+                    trace_parent=trace_parent,
                 )
             except BaseException as e:
                 if self._handle_task_failure(
@@ -1798,11 +1962,15 @@ class Coordinator:
                 raise
             if state.attempt and disp[0].url not in state.excluded:
                 self.faults.bump("tasks_rerouted")
+                self._tr().event(
+                    "task_rerouted", stage=stage_id, task=task_number,
+                    worker=disp[0].url,
+                )
             return disp
 
     def _pull_task_with_retry(self, stage_plan, query_id, stage_id,
                               task_number, task_count, body, cancel,
-                              ttl=None):
+                              ttl=None, trace_parent=None):
         """Streaming-plane analogue of `_run_stage_task`'s retry loop:
         dispatch + run ``body(worker, key, cancel)`` (a chunk iterator),
         re-dispatching on retryable failures for as long as NOTHING has
@@ -1819,12 +1987,32 @@ class Coordinator:
         state = _RetryState()
         kt = (query_id, stage_id, task_number)
         done = object()  # first-chunk sentinel: body produced nothing
+        tr = self._tr()
+        pull_parent = trace_parent
+        if pull_parent is None and tr.active:
+            pull_parent = tr.reserved_id(("stage", stage_id))
         while True:
             self._check_cancelled()
-            worker, key, plan_obj, store = self._dispatch_task_with_retry(
-                stage_plan, query_id, stage_id, task_number, task_count,
-                ttl=ttl, state=state,
+            # explicit start/end (no context manager): the span covers
+            # the pull's full streaming lifetime across generator
+            # suspensions, ending when the attempt resolves or the
+            # consumer closes the stream
+            pull_span = tr.start_span(
+                "pull", "execute", parent=pull_parent,
+                stage=stage_id, task=task_number, attempt=state.attempt,
             )
+            try:
+                worker, key, plan_obj, store = (
+                    self._dispatch_task_with_retry(
+                        stage_plan, query_id, stage_id, task_number,
+                        task_count, ttl=ttl, state=state,
+                        trace_parent=pull_span.span_id,
+                    )
+                )
+            except BaseException as e:
+                tr.end_span(pull_span.set(error=type(e).__name__))
+                raise
+            pull_span.set(worker=worker.url)
             yielded = False
             try:
                 try:
@@ -1857,8 +2045,10 @@ class Coordinator:
                 # the consumer abandoned the stream (satisfied LIMIT /
                 # sibling failure cancellation) — not a worker fault:
                 # cleanup already ran in the finally; just unwind
+                tr.end_span(pull_span.set(abandoned=True))
                 raise
             except BaseException as e:
+                tr.end_span(pull_span.set(error=type(e).__name__))
                 if cancel is not None and cancel.is_set():
                     # the stream was cancelled (satisfied LIMIT or a
                     # sibling's fatal error): teardown-induced failures
@@ -1875,6 +2065,7 @@ class Coordinator:
                     self._heal_departed_peers(stage_plan, query_id)
                     continue
                 raise
+            tr.end_span(pull_span)
             self._record_worker_success(worker.url)
             return
 
@@ -1927,7 +2118,8 @@ class Coordinator:
         return urls
 
     def _dispatch_task(self, stage_plan, query_id, stage_id, task_number,
-                       task_count, ttl=None, exclude=None):
+                       task_count, ttl=None, exclude=None,
+                       trace_parent=None):
         """Route, task-specialize, ship: -> (worker, key, plan_obj, store).
         ``ttl`` overrides the worker registry's idle-TTL for this entry
         (peer producers live until pulled or swept). ``exclude``: urls a
@@ -1944,32 +2136,71 @@ class Coordinator:
         worker = self.channels.get_worker(url)
         key = TaskKey(query_id, stage_id, task_number)
         store = worker.table_store
-        plan_obj = encode_plan(
-            _task_specialized(stage_plan, task_number), store
-        )
-        ship_kw = {}
-        dispatch_timeout = self._opt_float("dispatch_timeout_s")
-        if dispatch_timeout and self._worker_accepts_timeout(
-            worker, "set_plan"
-        ):
-            # pass only when configured AND the surface declares it:
-            # custom duck-typed workers predating the deadline parameter
-            # keep working (no deadline) instead of dying on a TypeError
-            ship_kw["timeout"] = dispatch_timeout
-        try:
-            worker.set_plan(key, plan_obj, task_count,
-                            config=self.config_options,
-                            headers=self.passthrough_headers,
-                            ttl=ttl, **ship_kw)
-        except BaseException:
-            # a failed ship leaves no registry entry to own the staged
-            # slices — release them here or they leak until process exit
-            from datafusion_distributed_tpu.runtime.codec import (
-                collect_table_ids,
-            )
+        tr = self._tr()
+        with tr.span("dispatch", "dispatch", parent=trace_parent,
+                     stage=stage_id, task=task_number, worker=url) as dsp:
+            with tr.span("encode", "codec", stage=stage_id) as esp:
+                plan_obj = encode_plan(
+                    _task_specialized(stage_plan, task_number), store
+                )
+                if tr.active:
+                    from datafusion_distributed_tpu.runtime.codec import (
+                        collect_table_ids as _ctids,
+                    )
 
-            store.remove(collect_table_ids(plan_obj))
-            raise
+                    # staged bytes: the slices this ship moves into the
+                    # worker's TableStore (in-process: by reference; wire:
+                    # serialized) — `table_nbytes` of each, so the counter
+                    # matches table nbytes by construction
+                    esp.set(bytes=sum(
+                        table_nbytes(store.get(tid))
+                        for tid in _ctids(plan_obj)
+                    ))
+            config = self.config_options
+            if tr.active:
+                # cross-wire trace context: rides the task envelope's
+                # config dict. NEVER a compile-cache input — the worker
+                # strips it before execute_plan, physical.py filters it
+                # from cfg_items (span ids differ per task; keying on
+                # them would force one XLA trace per task). The parent is
+                # the span ABOVE the dispatch (the task attempt / pull),
+                # so worker-side spans slot in as siblings of dispatch
+                # and execute, where they belong on the timeline.
+                ctx = tr.wire_ctx()
+                ctx["parent"] = dsp.parent_id
+                config = {**config, TRACE_CTX_KEY: ctx}
+            ship_kw = {}
+            dispatch_timeout = self._opt_float("dispatch_timeout_s")
+            if dispatch_timeout and self._worker_accepts_timeout(
+                worker, "set_plan"
+            ):
+                # pass only when configured AND the surface declares it:
+                # custom duck-typed workers predating the deadline
+                # parameter keep working (no deadline) instead of dying
+                # on a TypeError
+                ship_kw["timeout"] = dispatch_timeout
+            try:
+                with tr.span("ship", "rpc", worker=url):
+                    # a wire transport returns the framed bytes it put on
+                    # the wire (GrpcWorkerClient.set_plan); in-process
+                    # workers return None — no wire hop to attribute
+                    shipped = worker.set_plan(
+                        key, plan_obj, task_count, config=config,
+                        headers=self.passthrough_headers, ttl=ttl,
+                        **ship_kw,
+                    )
+            except BaseException:
+                # a failed ship leaves no registry entry to own the staged
+                # slices — release them here or they leak until process
+                # exit
+                from datafusion_distributed_tpu.runtime.codec import (
+                    collect_table_ids,
+                )
+
+                store.remove(collect_table_ids(plan_obj))
+                raise
+            if tr.active and isinstance(shipped, int):
+                dsp.set(wire_bytes=shipped)
         return worker, key, plan_obj, store
 
     def _try_dispatch_span(self, stage_plan, query_id, stage_id,
@@ -2061,9 +2292,23 @@ class Coordinator:
         return worker, key, plan_obj, worker.table_store
 
     def _record_task_progress(self, worker, key) -> None:
-        if not self.collect_metrics:
+        tr = self._tr()
+        # tracing reads the progress payload even with metrics collection
+        # off: the worker-side spans ride it, and `collect_metrics=False`
+        # must not silently amputate the cross-wire half of a trace the
+        # user explicitly turned on
+        if not self.collect_metrics and not tr.active:
             return
         progress = worker.task_progress(key) or {}
+        # worker-side spans (decode/execute, runtime/worker.py) ride the
+        # progress payload over BOTH transports; splice them into the
+        # query trace under their propagated wire parent — this is the
+        # cross-wire join making worker time attributable per task
+        spans = progress.pop("spans", None)
+        if spans and tr.active:
+            tr.splice(spans)
+        if not self.collect_metrics:
+            return
         self.metrics[key] = progress
         elapsed = progress.get("elapsed_s")
         if elapsed is not None and self.latency is not None:

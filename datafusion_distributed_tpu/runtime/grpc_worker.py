@@ -316,9 +316,15 @@ class GrpcWorkerClient:
                  config: Optional[dict] = None,
                  headers: Optional[dict] = None,
                  ttl: Optional[float] = None,
-                 timeout: Optional[float] = None) -> None:
+                 timeout: Optional[float] = None) -> int:
         """``timeout``: dispatch deadline, enforced by gRPC itself;
-        DEADLINE_EXCEEDED surfaces as the retryable TaskTimeoutError."""
+        DEADLINE_EXCEEDED surfaces as the retryable TaskTimeoutError.
+
+        -> the framed wire bytes this ship put on the wire (compressed
+        payload + codec framing): returned, not stashed on the client —
+        clients are cached per url and shared across concurrent
+        dispatches, so instance state would attribute one thread's frame
+        size to another's dispatch span (runtime/tracing.py)."""
         import grpc
 
         tids = collect_table_ids(plan_obj)
@@ -376,6 +382,7 @@ class GrpcWorkerClient:
             raise WorkerError.from_dict(msg["error"])
         # local copies served their purpose once serialized
         self.table_store.remove(tids)
+        return len(frame)
 
     def execute_task(self, key: TaskKey,
                      timeout: Optional[float] = None) -> Table:

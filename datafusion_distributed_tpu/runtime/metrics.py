@@ -252,6 +252,7 @@ def explain_analyze(
     store: MetricsStore,
     per_task: bool = False,
     diagnostics: "Optional[list]" = None,
+    trace_store=None,
 ) -> str:
     """Render the plan tree with metrics stitched into each node line.
 
@@ -259,7 +260,11 @@ def explain_analyze(
     a VerifyResult) rendered per node id next to the runtime metrics —
     e.g. a "literal not hoistable — plan will not share compiles" warning
     lands on the exact Filter it applies to. None = run the verifier here
-    so explain_analyze always shows static findings alongside metrics."""
+    so explain_analyze always shows static findings alongside metrics.
+
+    ``trace_store``: the distributed-tracing store whose per-query
+    profile report is appended when the executed query was traced (None =
+    the process-wide default store, runtime/tracing.py)."""
     from datafusion_distributed_tpu.plan.verify import (
         VerifyResult,
         diag_suffix,
@@ -301,6 +306,23 @@ def explain_analyze(
         if schedule:
             lines.append("")
             lines.append(schedule)
+    # distributed-tracing profile fold (runtime/tracing.py): when the
+    # query ran with `SET distributed.tracing` on, append its per-query
+    # profile — top spans by self time, per-stage data-plane bytes/sec,
+    # queue-wait vs execute split, fault events
+    if qid is not None:
+        from datafusion_distributed_tpu.runtime.tracing import (
+            DEFAULT_TRACE_STORE,
+            render_profile,
+        )
+
+        ts = trace_store if trace_store is not None else DEFAULT_TRACE_STORE
+        trace = ts.get(qid)
+        if trace is not None:
+            profile = render_profile(trace)
+            if profile:
+                lines.append("")
+                lines.append(profile)
     return "\n".join(lines)
 
 

@@ -17,8 +17,12 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 
-@dataclass
+@dataclass(frozen=True)
 class SystemMetrics:
+    """Frozen: the sampler thread publishes a new snapshot per tick via a
+    single reference assignment (GIL-atomic), so readers can never observe
+    a half-updated sample — mutation is a bug by construction."""
+
     rss_bytes: int = 0
     cpu_seconds: float = 0.0
     sampled_at: float = 0.0
@@ -42,17 +46,29 @@ def sample_system_metrics() -> SystemMetrics:
 
 class SystemMetricsSampler:
     """Background sampler (the reference samples every 100 ms under the
-    `system-metrics` feature)."""
+    `system-metrics` feature).
+
+    Thread-safety contract: ``latest`` always holds a FROZEN
+    SystemMetrics snapshot, replaced wholesale by the sampler thread —
+    a single reference assignment is atomic under the GIL, so readers on
+    any thread see either the previous complete sample or the next one,
+    never a torn mix. Assertion-backed: the snapshot type is frozen, so
+    an accidental in-place mutation raises instead of racing."""
 
     def __init__(self, interval_s: float = 0.1):
         self.interval = interval_s
         self.latest = sample_system_metrics()
+        assert type(self.latest).__dataclass_params__.frozen, (
+            "SystemMetrics must stay frozen: the cross-thread handoff "
+            "relies on immutable snapshots + atomic reference swap"
+        )
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "SystemMetricsSampler":
         def loop():
             while not self._stop.wait(self.interval):
+                # publish: one atomic reference swap of a frozen snapshot
                 self.latest = sample_system_metrics()
 
         self._thread = threading.Thread(target=loop, daemon=True)
@@ -60,9 +76,12 @@ class SystemMetricsSampler:
         return self
 
     def stop(self) -> None:
+        """Idempotent: stop() on a never-started or already-stopped
+        sampler is a no-op; concurrent/repeated calls join at most once."""
         self._stop.set()
-        if self._thread:
-            self._thread.join(timeout=1.0)
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=1.0)
 
 
 class ObservabilityService:
@@ -79,12 +98,16 @@ class ObservabilityService:
     serving line)."""
 
     def __init__(self, resolver, channels, sample_system: bool = False,
-                 health=None, fault_counters=None, serving=None):
+                 health=None, fault_counters=None, serving=None,
+                 trace_store=None):
         self.resolver = resolver
         self.channels = channels
         self.health = health
         self.fault_counters = fault_counters
         self.serving = serving
+        # distributed-tracing store surfaced by get_trace_summary (None =
+        # the process-wide default, runtime/tracing.py)
+        self.trace_store = trace_store
         self.sampler = SystemMetricsSampler().start() if sample_system else None
 
     def ping(self) -> dict:
@@ -158,15 +181,38 @@ class ObservabilityService:
             return {"error": str(e)}
 
     def get_task_progress(self, keys) -> dict:
-        """TaskKey list -> progress dicts from whichever worker holds each."""
+        """TaskKey list -> progress dicts from whichever worker holds each.
+
+        Degrades per worker like `get_cluster_workers`: a single erroring
+        or departed worker mid-scan must not abort the whole listing —
+        its probe is skipped and the remaining workers still answer (the
+        key is simply absent if no surviving worker holds it)."""
         out = {}
         for key in keys:
             for url in self.resolver.get_urls():
-                p = self.channels.get_worker(url).task_progress(key)
+                try:
+                    p = self.channels.get_worker(url).task_progress(key)
+                except Exception:
+                    continue  # dead/departed worker: try the next one
                 if p is not None:
                     out[key] = {**p, "worker": url}
                     break
         return out
+
+    def get_trace_summary(self) -> dict:
+        """Live aggregate counters of the distributed-tracing subsystem
+        (runtime/tracing.py): traces held/running, span counts by kind,
+        fault events by name, total data-plane bytes attributed. Served
+        from the wired TraceStore (default: the process-wide store)."""
+        from datafusion_distributed_tpu.runtime.tracing import (
+            DEFAULT_TRACE_STORE,
+        )
+
+        store = self.trace_store or DEFAULT_TRACE_STORE
+        try:
+            return store.summary()
+        except Exception as e:
+            return {"error": str(e)}
 
     def system_metrics(self) -> Optional[SystemMetrics]:
         return self.sampler.latest if self.sampler else None

@@ -121,6 +121,10 @@ class QueryHandle:
         # reuses it), so cancel() reaches in-flight dispatches directly
         self._cancel_event = threading.Event()
         self._coordinator = None
+        # the coordinator-internal query id of the MAIN execute (stamped
+        # by the driver) — the key into the distributed-tracing store,
+        # isolating this handle's trace from every concurrent query's
+        self.trace_query_id: Optional[str] = None
 
     # -- inspection ---------------------------------------------------------
     def status(self) -> str:
@@ -168,6 +172,37 @@ class QueryHandle:
         queue immediately; a RUNNING one aborts at its coordinator's next
         dispatch/execute checkpoint (the per-query cancel event)."""
         return self._session._cancel(self)
+
+    # -- distributed tracing -------------------------------------------------
+    def query_trace(self):
+        """This query's QueryTrace (None unless it ran with
+        `SET distributed.tracing` on/sampled)."""
+        from datafusion_distributed_tpu.runtime.tracing import (
+            DEFAULT_TRACE_STORE,
+        )
+
+        if self.trace_query_id is None:
+            return None
+        return DEFAULT_TRACE_STORE.get(self.trace_query_id)
+
+    def trace(self):
+        """Chrome trace-event JSON dict of this query's distributed trace
+        (load in Perfetto / chrome://tracing), or None if untraced."""
+        from datafusion_distributed_tpu.runtime.tracing import (
+            to_chrome_trace,
+        )
+
+        t = self.query_trace()
+        return to_chrome_trace(t) if t is not None else None
+
+    def trace_profile(self) -> str:
+        """Text profile report of this query's trace ('' if untraced)."""
+        from datafusion_distributed_tpu.runtime.tracing import (
+            render_profile,
+        )
+
+        t = self.query_trace()
+        return render_profile(t) if t is not None else ""
 
     # -- session-internal transitions ---------------------------------------
     def _finish(self, state: str, result=None,
@@ -716,6 +751,7 @@ class ServingSession:
         return coord
 
     def _drive(self, h: QueryHandle) -> None:
+        coord = None
         try:
             if h._cancel_event.is_set():
                 raise TaskCancelledError("cancelled before execution")
@@ -729,6 +765,7 @@ class ServingSession:
         except BaseException as e:
             h._finish(FAILED, error=e)
         finally:
+            self._stamp_trace(h, coord)
             self.scheduler.unregister_query(h.query_id)
             wall = h.wall_s()
             if wall is not None and h._state == DONE:
@@ -740,6 +777,26 @@ class ServingSession:
                     self._completed.get(h._state, 0) + 1
                 )
                 self._admit_locked()
+
+    def _stamp_trace(self, h: QueryHandle, coord) -> None:
+        """Bind the handle to its MAIN execute's trace (the last query id
+        the coordinator ran — subquery executes resolved earlier) and
+        annotate the trace root with the serving tier's admission
+        queue-wait, so the profile shows the full submit->result story."""
+        qid = getattr(coord, "last_query_id", None)
+        if qid is None:
+            return
+        h.trace_query_id = qid
+        wait = h.queue_wait_s()
+        if wait is not None:
+            from datafusion_distributed_tpu.runtime.tracing import (
+                DEFAULT_TRACE_STORE,
+            )
+
+            DEFAULT_TRACE_STORE.annotate(
+                qid, admission_wait_s=round(wait, 6),
+                serving_query_id=h.query_id, priority=h.priority,
+            )
 
     # -- cancellation -------------------------------------------------------
     def _cancel(self, h: QueryHandle) -> bool:

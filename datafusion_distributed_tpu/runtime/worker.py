@@ -497,6 +497,14 @@ class Worker:
         # control-plane entry too
         with self._stage_compiles_lock:
             self._sweep_stage_compiles_locked(time.time())
+        # cross-wire trace context (runtime/tracing.py): when the
+        # coordinator ships one, worker-side phases record spans as plain
+        # dicts that ride the task-progress payload back and splice into
+        # the query trace under the propagated parent. Host-side only —
+        # nothing trace-related may enter a jax-traced function
+        # (DFTPU109) or a compile-cache key (execute strips it).
+        tctx = (config or {}).get("trace_ctx")
+        decode_t0 = time.monotonic() if tctx else 0.0
         try:
             plan = decode_plan(plan_obj, self.table_store)
             _check_decoded_plan(plan, plan_obj, self.url, key,
@@ -505,6 +513,16 @@ class Worker:
                 plan = self.on_plan(plan, key)
         except Exception as e:  # structured propagation to the coordinator
             raise wrap_worker_exception(e, self.url, key) from e
+        wire_spans = None
+        if tctx:
+            from datafusion_distributed_tpu.runtime.tracing import (
+                worker_span,
+            )
+
+            wire_spans = [worker_span(
+                "worker_decode", "codec", decode_t0, time.monotonic(),
+                tctx.get("parent"), worker=self.url,
+            )]
         from datafusion_distributed_tpu.runtime.codec import collect_table_ids
         from datafusion_distributed_tpu.runtime.peer import (
             attach_peer_channels,
@@ -522,6 +540,7 @@ class Worker:
         self.registry.put(TaskData(
             key=key, plan=plan, task_count=task_count,
             config=dict(config or {}), headers=dict(headers or {}),
+            metrics={"spans": wire_spans} if wire_spans else {},
             shipped_table_ids=collect_table_ids(plan_obj),
             ttl=ttl,
         ))
@@ -547,16 +566,32 @@ class Worker:
                 task=key,
             )
         data.executed_at = time.time()
+        tctx = (data.config or {}).get("trace_ctx")
+        exec_t0 = time.monotonic() if tctx else 0.0
+        traces_before = 0
+        if tctx:
+            from datafusion_distributed_tpu.plan import physical as _phys
+
+            traces_before = _phys.trace_count()
         try:
             from datafusion_distributed_tpu.plan.physical import execute_plan
             from datafusion_distributed_tpu.runtime.metrics import MetricsStore
 
             store = MetricsStore()
             shared_cache, shared_key = self._stage_compile_cache(key, data)
+            # the wire trace context must NOT reach ExecContext.config or
+            # any compile-cache key: span ids differ per task, and keying
+            # a program on them would force one XLA trace per task
+            # (plan/physical.py filters it from cfg_items as a second
+            # line of defense)
+            exec_config = {
+                k: v for k, v in (data.config or {}).items()
+                if k != "trace_ctx"
+            }
             out = execute_plan(
                 data.plan,
                 DistributedTaskContext(key.task_number, data.task_count),
-                config=data.config or None,
+                config=exec_config or None,
                 metrics_store=store,
                 task_label=f"task{key.task_number}",
                 use_cache=False,  # freshly decoded plans never hit the cache
@@ -573,6 +608,21 @@ class Worker:
         data.finished_at = time.time()
         data.metrics["rows_out"] = int(out.num_rows)
         data.metrics["elapsed_s"] = data.finished_at - data.executed_at
+        if tctx:
+            from datafusion_distributed_tpu.plan import physical as _phys
+            from datafusion_distributed_tpu.runtime.tracing import (
+                worker_span,
+            )
+
+            # compile-cache attribution: new_traces > 0 means this
+            # execute paid a fresh XLA trace (a stage-compile cache miss);
+            # 0 means it reused a shared program (hit)
+            data.metrics.setdefault("spans", []).append(worker_span(
+                "worker_execute", "execute", exec_t0, time.monotonic(),
+                tctx.get("parent"), worker=self.url,
+                rows=data.metrics["rows_out"],
+                new_traces=_phys.trace_count() - traces_before,
+            ))
         return out
 
     def execute_task_stream(self, key: TaskKey, chunk_rows: int = 65536,

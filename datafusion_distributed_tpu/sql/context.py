@@ -221,6 +221,26 @@ class SessionConfig:
                         "0", "false", "off", ""
                     )
                 value = bool(value)
+            elif key == "tracing":
+                # distributed-tracing mode (runtime/tracing.py):
+                # validated at SET time so a typo fails the SET, not the
+                # queries silently running untraced
+                from datafusion_distributed_tpu.runtime.tracing import (
+                    TRACING_MODES,
+                )
+
+                value = str(value).strip().lower()
+                if value not in TRACING_MODES:
+                    raise ValueError(
+                        f"invalid tracing mode {value!r} (expected one "
+                        f"of {TRACING_MODES})"
+                    )
+            elif key == "tracing_sample_rate":
+                value = float(value)
+                if not 0.0 <= value <= 1.0:
+                    raise ValueError(
+                        "tracing_sample_rate must be in [0, 1]"
+                    )
             self.distributed_options[key] = value
         elif scope == "planner":
             if not hasattr(self.planner, key):
@@ -824,6 +844,31 @@ class SessionContext:
                 return None  # DDL/SET-only script
             raise ValueError("no SQL statements in input")
         return result
+
+    def last_trace(self):
+        """Chrome trace-event JSON dict of the most recently completed
+        traced query (load in Perfetto / chrome://tracing), or None when
+        nothing ran with `SET distributed.tracing` on. Coordinated
+        executions record into the process-wide trace store regardless of
+        which coordinator object ran them (runtime/tracing.py)."""
+        from datafusion_distributed_tpu.runtime.tracing import (
+            DEFAULT_TRACE_STORE,
+            to_chrome_trace,
+        )
+
+        trace = DEFAULT_TRACE_STORE.last()
+        return to_chrome_trace(trace) if trace is not None else None
+
+    def last_trace_profile(self) -> str:
+        """Text profile report of the most recent traced query ('' when
+        none) — the explain_analyze trace fold, standalone."""
+        from datafusion_distributed_tpu.runtime.tracing import (
+            DEFAULT_TRACE_STORE,
+            render_profile,
+        )
+
+        trace = DEFAULT_TRACE_STORE.last()
+        return render_profile(trace) if trace is not None else ""
 
     def prepare(self, template: str) -> PreparedStatement:
         """Prepared-statement API: ``ctx.prepare("... where x < $1")``

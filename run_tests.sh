@@ -81,6 +81,18 @@ if ! python -m pytest tests/test_serving.py -q --no-header \
         -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
     FAILED+=("tests/test_serving.py[gate]")
 fi
+# Tracing gate (tests/test_tracing.py): the distributed-tracing
+# subsystem — span-tree shape for distributed TPC-H (worker spans joined
+# via cross-wire context propagation, in-process AND gRPC), retry/heal/
+# cancel events under seeded chaos + membership churn, byte counters
+# matching table nbytes, tracing=off adding zero spans AND zero new XLA
+# traces, >= 95% query-wall coverage, serving-path isolation per query,
+# and the DFTPU109 span-in-traced-code lint rule.
+echo "=== tests/test_tracing.py (distributed-tracing gate)"
+if ! python -m pytest tests/test_tracing.py -q --no-header \
+        -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
+    FAILED+=("tests/test_tracing.py[gate]")
+fi
 # Elasticity gate (tests/test_elasticity.py): dynamic membership —
 # workers joining/leaving/draining MID-QUERY under seeded chaos schedules
 # (DFTPU_CHAOS_SEED above) must keep TPC-H results byte-identical, leak
@@ -97,6 +109,7 @@ for f in tests/test_*.py; do
     [ "$f" = "tests/test_plan_verify.py" ] && continue  # ran above (gate)
     [ "$f" = "tests/test_stage_scheduler.py" ] && continue  # ran above
     [ "$f" = "tests/test_serving.py" ] && continue  # ran above (gate)
+    [ "$f" = "tests/test_tracing.py" ] && continue  # ran above (gate)
     [ "$f" = "tests/test_elasticity.py" ] && continue  # ran above (gate)
     echo "=== $f"
     if ! python -m pytest "$f" -q --no-header -p no:cacheprovider \

@@ -130,3 +130,23 @@ def test_identical_resubmission_budget(tpch_ctx):
     again = ctx.sql(sql).to_pandas()
     assert phys.trace_count() == traces0
     assert first.equals(again)
+
+
+def test_tracing_knob_zero_compiles(tpch_ctx):
+    """ISSUE 9 gate extension: flipping `SET distributed.tracing` must
+    cause ZERO new XLA compiles on resubmission — the knob (and the
+    per-task trace context it ships) must never enter a plan cache or
+    compile-cache key. The coordinated-path variant (trace ctx riding
+    the task envelope) is pinned in tests/test_tracing.py."""
+    ctx, _ = tpch_ctx
+    sql = Q6_TPL.format(**PARAMS_A["q6"])
+    base = ctx.sql(sql).to_pandas()
+    traces0 = phys.trace_count()
+    for mode in ("on", "sampled", "off"):
+        ctx.sql(f"set distributed.tracing = '{mode}'")
+        got = ctx.sql(sql).to_pandas()
+        assert got.equals(base)
+    ctx.config.distributed_options.pop("tracing", None)
+    assert phys.trace_count() == traces0, (
+        "tracing knob flips recompiled — the knob leaked into a cache key"
+    )

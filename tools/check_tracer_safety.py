@@ -28,7 +28,19 @@ plan/verify.py):
   DFTPU103  np-in-trace          np.* call in a trace path
   DFTPU104  unordered-iteration  iterating a set/frozenset expression
   DFTPU105  time-random-in-trace time.*/random.* call in a trace path
+                                 (EXCEPT the monotonic clocks —
+                                 time.monotonic/perf_counter[_ns] report
+                                 as DFTPU109, the tracing-span rule)
   DFTPU106  mutable-default      def f(x=[] / {} / set())
+  DFTPU109  span-in-trace        tracing-span API / time.monotonic /
+                                 time.perf_counter call in a trace path
+                                 (distributed-tracing instrumentation is
+                                 host-side only: a span opened inside a
+                                 jitted function would record trace-time
+                                 once and bake its clock reads into the
+                                 compiled program). Takes precedence
+                                 over DFTPU105 for the monotonic clocks
+                                 — allowlist entries must name DFTPU109
 
 "Trace path" = a function that executes under jax tracing: ``_execute``
 and ``evaluate`` methods in the plan/ops/parallel layers, any function
@@ -351,10 +363,38 @@ class _RuleVisitor(ast.NodeVisitor):
     visit_AsyncFunctionDef = _visit_func
 
     # -- rules --------------------------------------------------------------
+    @staticmethod
+    def _is_tracing_api(name: str) -> bool:
+        """Calls that belong to the distributed-tracing span surface
+        (runtime/tracing.py): any receiver/attribute chain naming a
+        tracer (`self._tracer.span`, `tr.event`, `NULL_TRACER...`), the
+        module-level span constructors, and the monotonic clocks the
+        span layer is built on."""
+        if name in ("time.monotonic", "time.perf_counter",
+                    "time.perf_counter_ns", "time.monotonic_ns"):
+            return True
+        parts = name.split(".")
+        if any("tracer" in p.lower() for p in parts):
+            return True
+        return parts[-1] in ("start_span", "end_span", "worker_span",
+                             "finish_reserved") or (
+            len(parts) > 1 and parts[-1] in ("span", "event")
+            and parts[-2] in ("tr", "tracing")
+        )
+
     def visit_Call(self, node: ast.Call) -> None:
         name = _dotted(node.func)
         if self._in_trace_path():
-            if name in ("float", "int", "bool") and node.args and not (
+            if self._is_tracing_api(name):
+                self._emit(
+                    node, "DFTPU109",
+                    f"{name}() inside a traced function: tracing "
+                    "instrumentation must stay host-side — a span or "
+                    "monotonic-clock read under jit runs once at trace "
+                    "time and bakes that instant into every compiled "
+                    "re-execution (and times nothing)",
+                )
+            elif name in ("float", "int", "bool") and node.args and not (
                 _is_static_arg(node.args[0])
             ):
                 self._emit(
