@@ -396,12 +396,29 @@ def _export_query_trace(ctx, sql, suite, sf, q, platform, fh) -> None:
         with open(path, "w") as tf:
             json.dump(to_chrome_trace(trace), tf)
         cov, _gap = trace_coverage(trace)
+        rates = stage_data_rates(trace)
         stage_gbps = {
             str(sid): round((slot.get("bytes_per_s") or 0.0) / 1e9, 4)
-            for sid, slot in stage_data_rates(trace).items()
+            for sid, slot in rates.items()
         }
+        # one aggregate data-plane rate per query: the BYTES-WEIGHTED mean
+        # of the per-stage rates ("at what rate did the typical byte
+        # move"), over byte-carrying exchange stages — the root consumer
+        # (wall == the whole query) and compile-dominated zero-byte lanes
+        # would only dilute a plain bytes/wall quotient. Emitted as its
+        # own metric line by the parent.
+        carrying = [
+            s for sid, s in rates.items()
+            if sid != -1 and s.get("bytes") and s.get("bytes_per_s")
+        ]
+        tot_bytes = sum(s["bytes"] for s in carrying)
+        dp_gbps = (
+            sum(s["bytes"] * s["bytes_per_s"] for s in carrying)
+            / tot_bytes / 1e9
+        ) if tot_bytes else 0.0
         _emit(fh, event="trace", q=q, platform=platform, path=path,
-              coverage=round(cov, 4), stage_gbps=stage_gbps)
+              coverage=round(cov, 4), stage_gbps=stage_gbps,
+              data_plane_gbps=round(dp_gbps, 4))
     except Exception as e:
         _emit(fh, event="trace_failed", q=q, platform=platform,
               error=f"{type(e).__name__}: {e}"[:200])
@@ -754,6 +771,22 @@ def main() -> None:
                 "unit": "seconds",
                 "vs_baseline": 0.0,
             }), flush=True)
+        # data-plane rate (bench --trace runs): mean per-query aggregate
+        # stage GB/s from the trace byte attribution — the zero-copy
+        # plane's measured rate next to the per-stage breakdown in
+        # BENCH_DETAIL meta.traces
+        traced = [
+            v["data_plane_gbps"]
+            for v in state["meta"].get("traces", {}).values()
+            if v.get("data_plane_gbps")
+        ]
+        if traced:
+            print(json.dumps({
+                "metric": f"{suite}_sf{sf}_data_plane_gbps",
+                "value": round(sum(traced) / len(traced), 4),
+                "unit": "GB/s",
+                "vs_baseline": 0.0,
+            }), flush=True)
         print(json.dumps({
             "metric": f"{suite}_sf{sf}_total_wall_clock_"
                       f"{len(per_query)}q{suffix}",
@@ -856,7 +889,8 @@ def main() -> None:
                 # --trace artifact: Perfetto JSON path + per-stage GB/s
                 # attribution rides into BENCH_DETAIL meta
                 state["meta"].setdefault("traces", {})[ev["q"]] = {
-                    k: ev[k] for k in ("path", "coverage", "stage_gbps")
+                    k: ev[k] for k in
+                    ("path", "coverage", "stage_gbps", "data_plane_gbps")
                     if k in ev}
             elif kind == "done":
                 if ev.get("hbm_gbps") is not None:

@@ -395,6 +395,82 @@ def main() -> None:
     })
     print(json.dumps(results[-1]), flush=True)
 
+    # ---- zero-copy data plane ---------------------------------------------
+    # Stage->stage hop through the worker partition plane: one producer
+    # task hash-fans its output to 4 destinations (the per-dest slice
+    # fan-out), each destination's chunk stream is pulled and reassembled
+    # — the copying plane (eager device slices + scatter concat) vs the
+    # view plane (one destination-major gather, numpy views, view/memcpy
+    # reassembly). Reported: GB/s per arm + the worker store's peak staged
+    # bytes (identity-dedup'd, view-aware accounting).
+    from datafusion_distributed_tpu.ops.table import concat_tables
+    from datafusion_distributed_tpu.plan.physical import MemoryScanExec
+    from datafusion_distributed_tpu.runtime.codec import encode_plan
+    from datafusion_distributed_tpu.runtime.tracing import table_nbytes
+    from datafusion_distributed_tpu.runtime.worker import TaskKey, Worker
+
+    dp_t = arrow_to_table(pa.table({
+        "k": rng.integers(0, 1 << 16, n), "v": rng.normal(size=n),
+    }))
+    dp_bytes = table_nbytes(dp_t)
+    N_DEST = 4
+
+    def dp_arm(zero_copy: bool):
+        # pin the env override per arm: DFTPU_ZERO_COPY takes priority
+        # over task config, and a whole-suite A/B run exporting it must
+        # not silently collapse this comparison into view-vs-view
+        os.environ["DFTPU_ZERO_COPY"] = "1" if zero_copy else "0"
+        w = Worker(url=f"mem://dp-{int(zero_copy)}")
+        cfg = {"zero_copy": zero_copy}
+        best = float("inf")
+        for rep in range(args.repeats + 1):  # rep 0 warms the compile
+            key = TaskKey(f"dp{int(zero_copy)}", 0, rep)
+            plan_obj = encode_plan(
+                MemoryScanExec([dp_t], dp_t.schema()), w.table_store
+            )
+            w.set_plan(key, plan_obj, 1, config=cfg)
+            t0 = time.perf_counter()
+            parts = [[] for _ in range(N_DEST)]
+            for p, piece, _est in w.execute_task_partitions(
+                key, ["k"], N_DEST, 0, N_DEST,
+                per_dest_capacity=n, chunk_rows=65536,
+            ):
+                parts[p].append(piece)
+            outs = [concat_tables(c, capacity=n) for c in parts if c]
+            for o in outs:  # materialize (the consumer scan would)
+                np.asarray(o.columns[0].data)
+            dt = time.perf_counter() - t0
+            if rep:
+                best = min(best, dt)
+        return best, w.table_store.peak_nbytes
+
+    dp_env_saved = os.environ.get("DFTPU_ZERO_COPY")
+    try:
+        t_dp_copy, peak_copy = dp_arm(False)
+        t_dp_view, peak_view = dp_arm(True)
+    finally:
+        if dp_env_saved is None:
+            os.environ.pop("DFTPU_ZERO_COPY", None)
+        else:
+            os.environ["DFTPU_ZERO_COPY"] = dp_env_saved
+    results.append({
+        "bench": "data_plane_copy",
+        "ms": round(t_dp_copy * 1e3, 2),
+        "gbps": round(dp_bytes / t_dp_copy / 1e9, 3),
+        "peak_staged_mb": round(peak_copy / 1e6, 2),
+        "fanout": N_DEST,
+    })
+    print(json.dumps(results[-1]), flush=True)
+    results.append({
+        "bench": "data_plane_view",
+        "ms": round(t_dp_view * 1e3, 2),
+        "gbps": round(dp_bytes / t_dp_view / 1e9, 3),
+        "peak_staged_mb": round(peak_view / 1e6, 2),
+        "fanout": N_DEST,
+        "speedup_vs_copy": round(t_dp_copy / max(t_dp_view, 1e-9), 2),
+    })
+    print(json.dumps(results[-1]), flush=True)
+
     # ---- transport framing ------------------------------------------------
     from datafusion_distributed_tpu.runtime import transport
     from datafusion_distributed_tpu.runtime.codec import encode_table

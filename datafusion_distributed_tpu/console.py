@@ -102,6 +102,17 @@ class Console:
             lines.append(
                 f"  {url:<28} {tasks:>5} {ver:>7} {'draining':>10}"
             )
+        # data-plane staged-byte totals from the worker infos ALREADY
+        # fetched above (get_info carries "store"): no second get_info
+        # fan-out per refresh (ObservabilityService.get_data_plane is the
+        # standalone programmatic surface for the same numbers)
+        dp = {"nbytes": 0, "entries": 0, "views": 0, "peak_nbytes": 0,
+              "dedup_hits": 0}
+        for w in workers:
+            st = w.get("store")
+            if isinstance(st, dict):
+                for k in dp:
+                    dp[k] += int(st.get(k, 0))
         srv = self.obs.get_serving_stats()
         if srv and "error" not in srv:
             comp = srv.get("completed", {})
@@ -118,14 +129,26 @@ class Console:
             )
             budget = srv.get("budget_bytes") or 0
             if budget:
+                # admission ESTIMATE next to the ACTUAL staged bytes from
+                # the workers' TableStore accounting (get_data_plane)
                 line += (
                     f"  {_DIM}footprint "
                     f"{_fmt_bytes(srv.get('in_use_bytes', 0))}/"
-                    f"{_fmt_bytes(budget)}{_RESET}"
+                    f"{_fmt_bytes(budget)} est, "
+                    f"{_fmt_bytes(dp.get('nbytes', 0))} staged{_RESET}"
                 )
             if p99 is not None:
                 line += f"  {_DIM}p99 {p99 * 1e3:.0f}ms{_RESET}"
             lines.append(line)
+        if dp.get("entries") or dp.get("peak_nbytes"):
+            lines.append(
+                f"\n{_BOLD}data plane{_RESET}  staged "
+                f"{_fmt_bytes(dp.get('nbytes', 0))} in "
+                f"{dp.get('entries', 0)} entries "
+                f"({dp.get('views', 0)} views, "
+                f"{dp.get('dedup_hits', 0)} dedup)  "
+                f"{_DIM}peak {_fmt_bytes(dp.get('peak_nbytes', 0))}{_RESET}"
+            )
         ts = self.obs.get_trace_summary()
         if ts and not ts.get("error") and ts.get("traces"):
             line = (

@@ -22,6 +22,7 @@ is a **padded** columnar batch:
 from __future__ import annotations
 
 import itertools
+import os
 from dataclasses import dataclass
 from typing import Any, Callable, Iterable, Optional, Sequence
 
@@ -215,7 +216,7 @@ class Column:
         n = len(values)
         if n > capacity:
             raise ValueError(f"{n} values > capacity {capacity}")
-        buf = np.zeros(capacity, dtype=dtype.np_dtype)
+        np_dtype = np.dtype(dtype.np_dtype)
         vals = np.asarray(values)
         # tpu precision mode stores logical 64-bit ints as int32; narrowing
         # must be loud, never a silent wrap (join keys at huge scale factors
@@ -223,23 +224,33 @@ class Column:
         if (
             n
             and np.issubdtype(vals.dtype, np.integer)
-            and np.issubdtype(buf.dtype, np.integer)
-            and vals.dtype.itemsize > buf.dtype.itemsize
+            and np.issubdtype(np_dtype, np.integer)
+            and vals.dtype.itemsize > np_dtype.itemsize
         ):
-            info = np.iinfo(buf.dtype)
+            info = np.iinfo(np_dtype)
             lo, hi = vals.min(), vals.max()
             if lo < info.min or hi > info.max:
                 raise OverflowError(
-                    f"int values [{lo}, {hi}] exceed {buf.dtype} device "
+                    f"int values [{lo}, {hi}] exceed {np_dtype} device "
                     "storage; run with DFTPU_PRECISION=x64 for 64-bit keys"
                 )
-        buf[:n] = vals
+        if n == capacity and vals.ndim == 1 and vals.dtype == np_dtype:
+            # a buffer that already satisfies the capacity (the wire decode
+            # path when table_caps == live rows) enters the device as-is —
+            # no zero-fill + pad copy; `to_device` hands it over via dlpack
+            # where the backend allows (ownership transfers: the caller
+            # must not mutate it afterwards)
+            data = to_device(np.ascontiguousarray(vals))
+        else:
+            buf = np.zeros(capacity, dtype=np_dtype)
+            buf[:n] = vals
+            data = to_device(buf)
         col_validity = None
         if validity is not None:
             v = np.zeros(capacity, dtype=np.bool_)
             v[:n] = validity
-            col_validity = jnp.asarray(v)
-        return Column(jnp.asarray(buf), col_validity, dtype, dictionary)
+            col_validity = to_device(v)
+        return Column(data, col_validity, dtype, dictionary)
 
     @property
     def capacity(self) -> int:
@@ -499,6 +510,266 @@ def round_up_pow2(n: int, minimum: int = 8) -> int:
     return cap
 
 
+# ---------------------------------------------------------------------------
+# Zero-copy host data plane: view-based staging primitives
+# ---------------------------------------------------------------------------
+#
+# The distributed data plane moves tables between stages as host-side slices
+# (chunk streams, per-destination shuffle partitions, broadcast fan-out).
+# Doing that with eager jax ops costs one device dispatch + buffer copy per
+# slice; these primitives instead rebind staged tables to HOST numpy buffers
+# once (`host_view` — zero-copy on the CPU backend, one unavoidable D2H on a
+# real accelerator), after which every slice is a numpy VIEW (`slice_view`)
+# and contiguous views of one buffer reassemble without a copy
+# (`concat_tables`' host fast path). Buffers are immutable by contract —
+# every consumer shares them by reference.
+
+
+def parse_bool_knob(value) -> bool:
+    """One parser for boolean `SET distributed.*` knobs ("off"/"false"/
+    "0"/"" are false, everything else truthy) — SET-time validation
+    (sql/context.py) and runtime interpretation share it so the accepted
+    spellings cannot drift apart."""
+    if isinstance(value, str):
+        return value.strip().lower() not in ("0", "false", "off", "")
+    return bool(value)
+
+
+def zero_copy_enabled(config: Optional[dict] = None) -> bool:
+    """Effective `SET distributed.zero_copy` (default ON). The env override
+    ``DFTPU_ZERO_COPY`` wins over session config — the A/B escape hatch for
+    whole-suite comparison runs without touching session options."""
+    env = os.environ.get("DFTPU_ZERO_COPY")
+    if env is not None:
+        return parse_bool_knob(env)
+    return parse_bool_knob((config or {}).get("zero_copy", True))
+
+
+def to_device(arr) -> jnp.ndarray:
+    """Host buffer -> device array. The dlpack import
+    (`jax.dlpack.from_dlpack`) is the zero-copy entry on backends whose
+    runtime can adopt an Arrow-layout host buffer — but it is OPT-IN
+    (``DFTPU_DLPACK=1``): on this jax/CPU build the import both copies AND
+    commits the result to one device, and a committed leaf breaks the
+    in-mesh tier's contract that scan inputs are uncommitted (shard_map
+    re-places them freely). The default `jnp.asarray` stays uncommitted and
+    costs the same single H2D copy. Callers hand over OWNERSHIP of the
+    buffer either way: it must not be mutated afterwards."""
+    if (
+        isinstance(arr, np.ndarray)
+        and arr.flags.c_contiguous
+        and os.environ.get("DFTPU_DLPACK") == "1"
+    ):
+        try:
+            import jax.dlpack as _jdl
+
+            return _jdl.from_dlpack(arr)
+        except Exception:
+            pass
+    return jnp.asarray(arr)
+
+
+def is_host_backed(table: Table) -> bool:
+    """True when every buffer is a host numpy array and num_rows is
+    concrete — the staging representation the view-based data plane can
+    slice and reassemble without device dispatches or copies."""
+    if isinstance(table.num_rows, jax.core.Tracer):
+        return False
+    for c in table.columns:
+        if not isinstance(c.data, np.ndarray):
+            return False
+        if c.validity is not None and not isinstance(c.validity, np.ndarray):
+            return False
+    return True
+
+
+def host_view(table: Table) -> Table:
+    """Rebind a table's buffers to host numpy arrays WITHOUT copying where
+    the backend allows (a jax CPU array shares its buffer with numpy —
+    `np.asarray` returns a readonly view; an accelerator pays its one
+    unavoidable D2H here, once, instead of per slice)."""
+    if isinstance(table.num_rows, jax.core.Tracer):
+        raise ValueError("host_view of a traced table")
+    if is_host_backed(table):
+        return table
+    cols = tuple(
+        Column(
+            np.asarray(c.data),
+            np.asarray(c.validity) if c.validity is not None else None,
+            c.dtype,
+            c.dictionary,
+        )
+        for c in table.columns
+    )
+    return Table(table.names, cols, np.int32(int(table.num_rows)))
+
+
+def slice_view(table: Table, lo: int, count: int) -> Table:
+    """Zero-copy row-range view [lo, lo+count) of a table: numpy views of
+    the same buffers, capacity == count exactly (no pad copy). Device-backed
+    tables are host-rebound first (free on CPU); traced tables fall back to
+    the copying `slice_rows`."""
+    if not is_host_backed(table):
+        if isinstance(table.num_rows, jax.core.Tracer):
+            return table.slice_rows(lo, count)
+        table = host_view(table)
+    n = int(table.num_rows)
+    lo = max(0, min(lo, n))
+    count = max(0, min(count, n - lo))
+    cols = tuple(
+        Column(
+            c.data[lo:lo + count],
+            c.validity[lo:lo + count] if c.validity is not None else None,
+            c.dtype,
+            c.dictionary,
+        )
+        for c in table.columns
+    )
+    return Table(table.names, cols, np.int32(count))
+
+
+def _base_buffer(arr: np.ndarray):
+    """Walk the numpy view chain to the owning object (an ndarray, or the
+    memoryview a jax CPU buffer exports)."""
+    base = arr
+    while isinstance(base, np.ndarray) and base.base is not None:
+        base = base.base
+    return base
+
+
+def _buffer_ptr(arr: np.ndarray) -> int:
+    return arr.__array_interface__["data"][0]
+
+
+def _buffer_extent(base) -> Optional[tuple[int, int]]:
+    """(start pointer, nbytes) of an owning buffer, or None if unknowable."""
+    if isinstance(base, np.ndarray):
+        return _buffer_ptr(base), int(base.nbytes)
+    if isinstance(base, memoryview):
+        flat = np.frombuffer(base, dtype=np.uint8)
+        return _buffer_ptr(flat), int(flat.nbytes)
+    return None
+
+
+def _merge_views(arrs: list, want_len: int):
+    """Exact-length contiguous 1-D views that abut in ONE base buffer ->
+    a single view of length ``want_len`` over that buffer (reading past the
+    last view only if the base has the room), else None."""
+    nz = [a for a in arrs if len(a)]
+    if not nz:
+        return None
+    base = _base_buffer(nz[0])
+    start = _buffer_ptr(nz[0])
+    end = start + nz[0].nbytes
+    for a in nz[1:]:
+        if _base_buffer(a) is not base or _buffer_ptr(a) != end:
+            return None
+        end += a.nbytes
+    itemsize = nz[0].itemsize
+    have = (end - start) // itemsize
+    if want_len > have:
+        extent = _buffer_extent(base)
+        if extent is None:
+            return None
+        b_start, b_nbytes = extent
+        if start + want_len * itemsize > b_start + b_nbytes:
+            return None  # base buffer too short for the requested capacity
+    return np.lib.stride_tricks.as_strided(
+        nz[0], shape=(want_len,), strides=nz[0].strides
+    )
+
+
+def _concat_host(tables: Sequence[Table], names, total_cap: int):
+    """Host (numpy) concat fast path: one memcpy per column at memory
+    bandwidth instead of one eager device scatter per input — and when the
+    inputs are contiguous views of ONE base buffer (the chunk streams of the
+    zero-copy data plane), NO copy at all: the result is a view of the base.
+    Returns None when any input is device-backed (the caller's jax path
+    handles those)."""
+    for t in tables:
+        if not is_host_backed(t):
+            return None
+    ns = [int(t.num_rows) for t in tables]
+    total = sum(ns)
+    ncols = len(names)
+    unified = [
+        unify_dictionaries([t.columns[ci].dictionary for t in tables])
+        for ci in range(ncols)
+    ]
+    view = _concat_contiguous(tables, names, ns, unified, total_cap)
+    if view is not None:
+        return view
+    out_cols = []
+    for ci in range(ncols):
+        cols = [t.columns[ci] for t in tables]
+        dtype = cols[0].dtype
+        d, luts = unified[ci]
+        has_validity = any(c.validity is not None for c in cols)
+        data = np.zeros(total_cap, dtype=dtype.np_dtype)
+        validity = (
+            np.zeros(total_cap, dtype=np.bool_) if has_validity else None
+        )
+        off = 0
+        for t, c, lut, n in zip(tables, cols, luts, ns):
+            if n:
+                vals = c.data[:n]
+                if lut is not None:
+                    lut = np.asarray(lut)
+                    if len(lut) == 0:
+                        vals = np.zeros(n, dtype=data.dtype)
+                    else:
+                        vals = lut[np.clip(vals, 0, len(lut) - 1)]
+                data[off:off + n] = vals
+                if has_validity:
+                    validity[off:off + n] = (
+                        c.validity[:n] if c.validity is not None else True
+                    )
+            off += n
+        # same pad semantics as the device path: zeros (data) / False
+        # (validity) beyond the live rows
+        out_cols.append(Column(data, validity, dtype, d))
+    return Table(tuple(names), tuple(out_cols), np.int32(total))
+
+
+def _concat_contiguous(tables, names, ns, unified, total_cap: int):
+    """Pure-view reassembly: every column of every chunk is an exact-length
+    contiguous view, consecutive chunks abut in the same base buffer, no
+    dictionary re-encode is needed, and the base can honor the requested
+    capacity — then concat is a VIEW of the base buffer (rows past num_rows
+    are garbage by the Table contract)."""
+    total = sum(ns)
+    if total == 0:
+        return None
+    for _d, luts in unified:
+        if any(lut is not None for lut in luts):
+            return None
+    out_cols = []
+    for ci in range(len(names)):
+        cols = [t.columns[ci] for t in tables]
+        if len({c.validity is not None for c in cols}) > 1:
+            return None
+        for c, n in zip(cols, ns):
+            if len(c.data) != n or not c.data.flags.c_contiguous:
+                return None  # not an exact-length contiguous view
+            if c.validity is not None and (
+                len(c.validity) != n or not c.validity.flags.c_contiguous
+            ):
+                return None
+        merged = _merge_views([c.data for c in cols], total_cap)
+        if merged is None:
+            return None
+        merged_validity = None
+        if cols[0].validity is not None:
+            merged_validity = _merge_views(
+                [c.validity for c in cols], total_cap
+            )
+            if merged_validity is None:
+                return None
+        d, _ = unified[ci]
+        out_cols.append(Column(merged, merged_validity, cols[0].dtype, d))
+    return Table(tuple(names), tuple(out_cols), np.int32(total))
+
+
 def concat_tables(tables: Sequence[Table], capacity: Optional[int] = None) -> Table:
     """Concatenate same-schema tables into one padded table (jit-safe when
     ``capacity`` is given; rows are packed via cumulative offsets)."""
@@ -523,6 +794,12 @@ def concat_tables(tables: Sequence[Table], capacity: Optional[int] = None) -> Ta
         total = int(sum(int(n) for n in concrete))
         if total > total_cap:
             raise ValueError(f"concat overflow: {total} rows > capacity {total_cap}")
+        # zero-copy data plane: host-backed inputs (chunk views, staged
+        # slices) concat in numpy — one memcpy per column, or NO copy when
+        # the chunks are contiguous views of one base buffer
+        host = _concat_host(tables, names, total_cap)
+        if host is not None:
+            return host
         # Meshes-as-workers: inputs committed to DIFFERENT device sets
         # (slices pulled from two worker-owned meshes) cannot feed one op;
         # rebase through host first — the DCN hop a real multi-host

@@ -14,7 +14,7 @@ analogue, `src/protobuf/user_codec.rs`).
 
 from __future__ import annotations
 
-import io
+import threading
 import uuid
 from typing import Any, Callable, Optional
 
@@ -61,28 +61,287 @@ def register_codec(kind: str, encode: Callable, decode: Callable) -> None:
     _USER_CODECS[kind] = (encode, decode)
 
 
+def _table_nbytes(table) -> int:
+    from datafusion_distributed_tpu.runtime.tracing import table_nbytes
+
+    return table_nbytes(table)
+
+
+class _EntryMeta:
+    """Accounting record of one store entry. ``base`` is None for an entry
+    that OWNS its buffers (counted once in the store's byte total) and the
+    owning entry's id for a view/alias (shares buffers, counted zero);
+    ``refs`` counts the aliases of an owning entry."""
+
+    __slots__ = ("nbytes", "base", "refs")
+
+    def __init__(self, nbytes: int, base: Optional[str] = None):
+        self.nbytes = int(nbytes)
+        self.base = base
+        self.refs = 0
+
+
+class _TableDict(dict):
+    """tid -> Table mapping of a TableStore. Legacy call sites mutate it
+    directly (`store.tables[tid] = t` on the wire receive path,
+    `.clear()` on cluster teardown), so the mapping itself routes every
+    mutation through the store's byte accounting — the two can never
+    disagree."""
+
+    __slots__ = ("_store",)
+
+    def __init__(self, store: "TableStore"):
+        super().__init__()
+        self._store = store
+
+    def __setitem__(self, tid, table):
+        with self._store._lock:
+            self._store._release_locked(tid)
+            self._store._insert_locked(tid, table)
+
+    def __delitem__(self, tid):
+        with self._store._lock:
+            if not dict.__contains__(self, tid):
+                raise KeyError(tid)
+            self._store._release_locked(tid)
+
+    def pop(self, tid, *default):
+        with self._store._lock:
+            if dict.__contains__(self, tid):
+                val = dict.__getitem__(self, tid)
+                self._store._release_locked(tid)
+                return val
+        if default:
+            return default[0]
+        raise KeyError(tid)
+
+    def clear(self):
+        with self._store._lock:
+            for tid in list(dict.keys(self)):
+                self._store._release_locked(tid)
+
+    def update(self, *args, **kwargs):
+        # route through __setitem__ so every inserted entry is accounted
+        for k, v in dict(*args, **kwargs).items():
+            self[k] = v
+
+    def __ior__(self, other):
+        self.update(other)
+        return self
+
+    def setdefault(self, tid, default=None):
+        with self._store._lock:
+            if dict.__contains__(self, tid):
+                return dict.__getitem__(self, tid)
+            self._store._insert_locked(tid, default)
+            return default
+
+    def popitem(self):
+        with self._store._lock:
+            tid = next(reversed(self), None)
+            if tid is None:
+                raise KeyError("popitem(): dictionary is empty")
+            val = dict.__getitem__(self, tid)
+            self._store._release_locked(tid)
+            return tid, val
+
+
 class TableStore:
-    """Shipment store: table id -> Table. In-process peers share it by
-    reference; cross-host transport serializes entries with encode_table.
-    Callers release shipped entries when their task completes (drop-driven
-    cleanup, like the reference's partition-drop accounting)."""
+    """Shipment store: table id -> staged Table — the buffer-owning,
+    byte-accounted heart of the zero-copy data plane.
+
+    In-process peers share entries by reference; cross-host transport
+    serializes them with encode_table. Callers release shipped entries when
+    their task completes (drop-driven cleanup, like the reference's
+    partition-drop accounting).
+
+    Zero-copy semantics:
+
+    - ``put`` DEDUPLICATES by table identity: staging the same Table object
+      again (broadcast fan-out — one entry per consumer task; retry
+      re-ships of unchanged slices) registers an alias that shares the
+      buffers and counts ZERO additional bytes. Releasing the owning entry
+      while aliases remain promotes an alias (refcounted release, never a
+      copy).
+    - ``put_view``/``get_slice`` expose row-range VIEWS of a staged entry
+      (numpy views of the same buffers via ops.table.slice_view) so
+      per-destination slices and chunk streams reference one staged buffer.
+    - Thread-safe: serving-tier threads and stage-DAG fan-out threads
+      mutate one worker store concurrently; every mutation (including the
+      legacy direct `tables[tid] = t` writes) runs under one lock.
+    - Byte-accounted: ``nbytes()``/``stats()`` report live owned bytes,
+      entry/view counts and the high-water mark — the observability
+      service's actual-staged-bytes surface, and the recorded entry sizes
+      (`entry_nbytes`) are what dispatch encode spans attribute, so store
+      accounting and trace bytes can never disagree."""
 
     def __init__(self) -> None:
-        self.tables: dict[str, Table] = {}
+        self._lock = threading.RLock()
+        self.tables: _TableDict = _TableDict(self)
+        self._meta: dict[str, _EntryMeta] = {}
+        self._by_identity: dict[int, str] = {}
+        self._owned_nbytes = 0
+        self.peak_nbytes = 0
+        self.put_count = 0
+        self.dedup_hits = 0
 
+    # -- accounting core (callers hold self._lock) ---------------------------
+    def _insert_locked(self, tid: str, table: Table,
+                       base: Optional[str] = None,
+                       nbytes: Optional[int] = None) -> str:
+        meta = _EntryMeta(
+            _table_nbytes(table) if nbytes is None else nbytes, base=base
+        )
+        dict.__setitem__(self.tables, tid, table)
+        self._meta[tid] = meta
+        if base is None:
+            self._by_identity[id(table)] = tid
+            self._owned_nbytes += meta.nbytes
+            self.peak_nbytes = max(self.peak_nbytes, self._owned_nbytes)
+        else:
+            b = self._meta.get(base)
+            if b is not None:
+                b.refs += 1
+        return tid
+
+    def _release_locked(self, tid: str) -> None:
+        meta = self._meta.pop(tid, None)
+        table = None
+        if dict.__contains__(self.tables, tid):
+            table = dict.__getitem__(self.tables, tid)
+            dict.__delitem__(self.tables, tid)
+        if meta is None:
+            return
+        if meta.base is not None:
+            b = self._meta.get(meta.base)
+            if b is not None:
+                b.refs = max(b.refs - 1, 0)
+            return
+        self._owned_nbytes -= meta.nbytes
+        if table is not None and self._by_identity.get(id(table)) == tid:
+            del self._by_identity[id(table)]
+        if meta.refs > 0:
+            # views/aliases still reference the buffers: promote the first
+            # one to owner so shared staged bytes stay accounted until the
+            # LAST reference drops (refcounted release, not a copy). A
+            # promoted slice-view accounts its own logical bytes — a
+            # deliberate undercount of the full base buffer it pins.
+            heir = next(
+                (t2 for t2, m2 in self._meta.items() if m2.base == tid),
+                None,
+            )
+            if heir is not None:
+                hm = self._meta[heir]
+                hm.base = None
+                hm.refs = 0
+                for m2 in self._meta.values():
+                    if m2 is not hm and m2.base == tid:
+                        m2.base = heir
+                        hm.refs += 1
+                ht = dict.__getitem__(self.tables, heir)
+                self._by_identity.setdefault(id(ht), heir)
+                self._owned_nbytes += hm.nbytes
+                self.peak_nbytes = max(
+                    self.peak_nbytes, self._owned_nbytes
+                )
+
+    def _canonical(self, tid: str) -> str:
+        m = self._meta.get(tid)
+        while m is not None and m.base is not None:
+            tid = m.base
+            m = self._meta.get(tid)
+        return tid
+
+    # -- public surface ------------------------------------------------------
     def put(self, table: Table) -> str:
         tid = uuid.uuid4().hex
+        with self._lock:
+            self.put_count += 1
+            canon = self._by_identity.get(id(table))
+            if canon is not None and dict.get(self.tables, canon) is table:
+                # identity dedup: the SAME staged object (broadcast
+                # fan-out, retry re-ship) becomes a zero-byte alias
+                self.dedup_hits += 1
+                self._insert_locked(tid, table, base=canon,
+                                    nbytes=self._meta[canon].nbytes)
+            else:
+                self._insert_locked(tid, table)
+        return tid
+
+    def put_as(self, tid: str, table: Table) -> str:
+        """Stage under a caller-chosen id (the wire receive path — the
+        shipping side minted the id and the plan references it)."""
         self.tables[tid] = table
         return tid
 
+    def put_view(self, base_tid: str, table: Optional[Table] = None,
+                 lo: int = 0, count: Optional[int] = None) -> str:
+        """Register a zero-copy VIEW of an existing entry as its own id:
+        shares the base buffers (zero owned bytes; the base stays pinned by
+        refcount until the last view drops). ``table`` may be a view the
+        caller already built over the entry's buffers; otherwise rows
+        [lo, lo+count) are sliced here via `get_slice`."""
+        with self._lock:
+            if table is None:
+                base_table = self.get(base_tid)
+                if count is None:
+                    count = int(base_table.num_rows) - lo
+                table = self.get_slice(base_tid, lo, count)
+            canon = self._canonical(base_tid)
+            if canon not in self._meta:
+                raise CodecError(
+                    f"table {base_tid} not in shipment store"
+                )
+            tid = uuid.uuid4().hex
+            self.put_count += 1
+            self._insert_locked(tid, table, base=canon)
+        return tid
+
     def get(self, tid: str) -> Table:
-        if tid not in self.tables:
-            raise CodecError(f"table {tid} not in shipment store")
-        return self.tables[tid]
+        with self._lock:
+            if not dict.__contains__(self.tables, tid):
+                raise CodecError(f"table {tid} not in shipment store")
+            return dict.__getitem__(self.tables, tid)
+
+    def get_slice(self, tid: str, lo: int, count: int) -> Table:
+        """Zero-copy row-range view of a staged entry (not registered —
+        use `put_view` to give the view its own id/lifetime)."""
+        from datafusion_distributed_tpu.ops.table import slice_view
+
+        return slice_view(self.get(tid), lo, count)
 
     def remove(self, tids) -> None:
-        for tid in tids:
-            self.tables.pop(tid, None)
+        with self._lock:
+            for tid in tids:
+                self._release_locked(tid)
+
+    # -- accounting surface --------------------------------------------------
+    def nbytes(self) -> int:
+        """Live owned bytes (shared buffers counted once)."""
+        with self._lock:
+            return self._owned_nbytes
+
+    def entry_nbytes(self, tid: str) -> int:
+        """The recorded logical size of one entry — what a dispatch encode
+        span attributes for this table id (always the size recorded at
+        put time, so spans and store accounting cannot disagree)."""
+        with self._lock:
+            m = self._meta.get(tid)
+            return m.nbytes if m is not None else 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            views = sum(
+                1 for m in self._meta.values() if m.base is not None
+            )
+            return {
+                "entries": len(self._meta),
+                "nbytes": self._owned_nbytes,
+                "views": views,
+                "peak_nbytes": self.peak_nbytes,
+                "puts": self.put_count,
+                "dedup_hits": self.dedup_hits,
+            }
 
 
 def collect_table_ids(plan_obj: dict) -> list[str]:
@@ -577,27 +836,37 @@ def decode_plan(o: dict, store: TableStore) -> ExecutionPlan:
 # ---------------------------------------------------------------------------
 
 
-def encode_table(table: Table) -> bytes:
-    """Table -> Arrow IPC bytes (the Flight data-plane payload analogue):
-    dictionary-GC'd string columns + logical-dtype metadata (the wire
-    shape of io/parquet.table_to_arrow)."""
+def encode_table(table: Table) -> memoryview:
+    """Table -> Arrow IPC payload (the Flight data-plane analogue):
+    dictionary-GC'd string columns + logical-dtype metadata (the wire shape
+    of io/parquet.table_to_arrow). Writes through `pa.BufferOutputStream`
+    and returns a memoryview over the resulting Arrow buffer — the old
+    `BytesIO` + `getvalue()` shape duplicated the whole payload at peak
+    (one copy in the stream, a second in getvalue). Consumers (transport
+    framing, compression, len) all speak the buffer protocol."""
     import pyarrow as pa
 
     from datafusion_distributed_tpu.io.parquet import table_to_arrow
 
     arrow = table_to_arrow(table, dictionary_gc=True,
                            logical_metadata=True)
-    sink = io.BytesIO()
+    sink = pa.BufferOutputStream()
     with pa.ipc.new_stream(sink, arrow.schema) as w:
         w.write_table(arrow)
-    return sink.getvalue()
+    # getvalue() on a BufferOutputStream is zero-copy (an Arrow buffer);
+    # the memoryview keeps it alive and exposes the buffer protocol
+    return memoryview(sink.getvalue())
 
 
-def decode_table(data: bytes, capacity: Optional[int] = None) -> Table:
+def decode_table(data, capacity: Optional[int] = None) -> Table:
+    """Arrow IPC payload -> Table. Reads through `pa.BufferReader` (no
+    BytesIO staging copy); ``capacity`` passes through to the column build,
+    where a buffer that already satisfies it skips the zero-fill + pad copy
+    (Column.from_numpy fast path)."""
     import pyarrow as pa
 
     from datafusion_distributed_tpu.io.parquet import arrow_to_table
 
-    with pa.ipc.open_stream(io.BytesIO(data)) as r:
+    with pa.ipc.open_stream(pa.BufferReader(data)) as r:
         arrow = r.read_all()
     return arrow_to_table(arrow, capacity=capacity)

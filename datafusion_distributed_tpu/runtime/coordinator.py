@@ -717,6 +717,13 @@ class Coordinator:
         self._note_membership(urls)
         return max(len(urls), 1)
 
+    def _zero_copy(self) -> bool:
+        """`SET distributed.zero_copy` (default on): the view-based data
+        plane — host-view regroup/chunking and buffer-sharing staging."""
+        from datafusion_distributed_tpu.ops.table import zero_copy_enabled
+
+        return zero_copy_enabled(self.config_options)
+
     def _materialize_exchanges_sequential(
         self, plan: ExecutionPlan, query_id: str
     ) -> ExecutionPlan:
@@ -1512,7 +1519,8 @@ class Coordinator:
         producer outputs into consumer slices."""
         t = self._consumer_task_count(exchange, outputs)
         slices = _shuffle_regroup(
-            outputs, exchange.key_names, t, exchange.per_dest_capacity
+            outputs, exchange.key_names, t, exchange.per_dest_capacity,
+            zero_copy=self._zero_copy(),
         )
         return MemoryScanExec(slices, producer.schema())
 
@@ -1546,18 +1554,30 @@ class Coordinator:
                         key, chunk_rows=chunk_rows, cancel=cancel
                     )
                 else:  # transport without a streaming surface
+                    from datafusion_distributed_tpu.ops.table import (
+                        host_view,
+                        slice_view,
+                    )
                     from datafusion_distributed_tpu.planner.statistics import (  # noqa: E501
                         row_width,
                     )
 
                     out = worker.execute_task(key)
+                    zc = self._zero_copy()
+                    if zc:
+                        # chunks below are zero-copy views of one host
+                        # rebind instead of per-chunk device slices
+                        out = host_view(out)
                     width = row_width(out.schema())
                     n = int(out.num_rows)
                     for lo in range(0, max(n, 1), chunk_rows):
                         if cancel.is_set():
                             return
                         c = min(chunk_rows, n - lo)
-                        yield out.slice_rows(lo, c), c * width
+                        yield (
+                            slice_view(out, lo, c) if zc
+                            else out.slice_rows(lo, c)
+                        ), c * width
 
             def pull(cancel):
                 # `xfer` binds when the transfer span opens below, before
@@ -2150,10 +2170,11 @@ class Coordinator:
 
                     # staged bytes: the slices this ship moves into the
                     # worker's TableStore (in-process: by reference; wire:
-                    # serialized) — `table_nbytes` of each, so the counter
-                    # matches table nbytes by construction
+                    # serialized) — the store's RECORDED entry sizes, so
+                    # encode spans and store accounting can never disagree
+                    # (entry_nbytes is table_nbytes captured at put time)
                     esp.set(bytes=sum(
-                        table_nbytes(store.get(tid))
+                        store.entry_nbytes(tid)
                         for tid in _ctids(plan_obj)
                     ))
             config = self.config_options
@@ -2632,7 +2653,8 @@ class AdaptiveCoordinator(Coordinator):
         for sid in sorted(pend):
             (ex, outputs, scan) = pend[sid]
             scan.tasks[:] = _shuffle_regroup(
-                outputs, ex.key_names, t, ex.per_dest_capacity
+                outputs, ex.key_names, t, ex.per_dest_capacity,
+                zero_copy=self._zero_copy(),
             )
             self.task_count_decisions.append((sid, ex.num_tasks, t))
 
@@ -2805,12 +2827,31 @@ def _task_specialized(plan: ExecutionPlan, task_number: int) -> ExecutionPlan:
 
 
 def _shuffle_regroup(
-    outputs: Sequence[Table], key_names, num_tasks: int, per_dest_capacity: int
+    outputs: Sequence[Table], key_names, num_tasks: int,
+    per_dest_capacity: int, zero_copy: bool = True, exact: bool = False,
 ) -> list[Table]:
     """Host-side hash regroup between stages. Uses the SAME hash as the
     in-mesh kernel so a query may mix mesh-internal and cross-mesh shuffles
-    and keys still co-locate. Prefers the native (C++) data plane for the
-    hash + CSR bucket build (native/), falling back to device ops."""
+    and keys still co-locate.
+
+    ``zero_copy`` (the view-based data plane, default on): each producer
+    output is hash-bucketed with ONE stable destination-major gather into a
+    single host buffer, and every per-destination slice is a zero-copy VIEW
+    of it — instead of one eager device gather (and a full-capacity copy)
+    per destination. ``exact`` skips the per-destination capacity padding
+    (the peer partition plane, where slices only feed chunk streams);
+    without it the returned slices keep the legacy
+    ``len(outputs) * per_dest_capacity`` padded shape that consumer stage
+    plans (and their compiled-program caches) key on.
+
+    The copying fallback prefers the native (C++) data plane for the hash +
+    CSR bucket build (native/), falling back to device ops."""
+    if zero_copy:
+        host = _shuffle_regroup_host(
+            outputs, key_names, num_tasks, per_dest_capacity, exact
+        )
+        if host is not None:
+            return host
     from datafusion_distributed_tpu import native
 
     buckets: list[list[Table]] = [[] for _ in range(num_tasks)]
@@ -2845,6 +2886,82 @@ def _shuffle_regroup(
     cap = max(len(outputs), 1) * per_dest_capacity
     for j in range(num_tasks):
         slices.append(concat_tables(buckets[j], capacity=cap))
+    return slices
+
+
+def _shuffle_regroup_host(
+    outputs: Sequence[Table], key_names, num_tasks: int,
+    per_dest_capacity: int, exact: bool,
+) -> Optional[list[Table]]:
+    """View-based regroup: per producer output, hash the keys (same native/
+    device hash as the copying path), stable-sort row indices by
+    destination, gather ONCE per column into a destination-major host
+    buffer, and hand out per-destination row-range views of it. Row order
+    within each destination matches the copying path exactly (stable sort
+    == original order within a bucket), so results stay byte-identical.
+    Returns None when an output is traced (concat under trace) — the
+    copying path handles that."""
+    import jax
+
+    from datafusion_distributed_tpu import native
+    from datafusion_distributed_tpu.ops.table import (
+        Column,
+        host_view,
+        slice_view,
+    )
+
+    for out in outputs:
+        if isinstance(out.num_rows, jax.core.Tracer):
+            return None
+    buckets: list[list[Table]] = [[] for _ in range(num_tasks)]
+    for out in outputs:
+        host = host_view(out)
+        n = int(host.num_rows)
+        np_cols = [np.asarray(host.column(k).data) for k in key_names]
+        np_valids = [
+            np.asarray(v) if (v := host.column(k).validity) is not None
+            else None
+            for k in key_names
+        ]
+        if native.available():
+            dtypes = [host.column(k).dtype for k in key_names]
+            h = np.asarray(native.hash_rows(np_cols, np_valids, dtypes))
+        else:
+            h = np.asarray(hash_columns(np_cols, np_valids))
+        dest = (h[:n] % np.uint32(num_tasks)).astype(np.int64)
+        order = np.argsort(dest, kind="stable")
+        counts = np.bincount(dest, minlength=num_tasks)
+        starts = np.concatenate(([0], np.cumsum(counts)))
+        # ONE destination-major gather per column; every per-destination
+        # slice below is a view of this buffer
+        gathered = Table(
+            host.names,
+            tuple(
+                Column(
+                    np.asarray(c.data[:n])[order],
+                    np.asarray(c.validity[:n])[order]
+                    if c.validity is not None else None,
+                    c.dtype, c.dictionary,
+                )
+                for c in host.columns
+            ),
+            np.int32(n),
+        )
+        for j in range(num_tasks):
+            buckets[j].append(
+                slice_view(gathered, int(starts[j]), int(counts[j]))
+            )
+    cap = max(len(outputs), 1) * per_dest_capacity
+    slices = []
+    for j in range(num_tasks):
+        if exact and len(buckets[j]) == 1:
+            slices.append(buckets[j][0])
+            continue
+        rows = sum(int(b.num_rows) for b in buckets[j])
+        slices.append(concat_tables(
+            buckets[j],
+            capacity=(max(rows, 1) if exact else cap),
+        ))
     return slices
 
 

@@ -97,10 +97,11 @@ def _handlers(worker: Worker):
         try:
             # materialize shipped table slices into the worker's store at
             # their ORIGINAL padded capacities (see the client-side comment
-            # on table_caps: re-padding would change the plan fingerprint)
+            # on table_caps: re-padding would change the plan fingerprint);
+            # put_as routes through the store's byte accounting
             for tid, raw in blobs.items():
-                worker.table_store.tables[tid] = decode_table(
-                    raw, capacity=caps.get(tid)
+                worker.table_store.put_as(
+                    tid, decode_table(raw, capacity=caps.get(tid))
                 )
             worker.set_plan(key, header["plan"], header["task_count"],
                             config=header.get("config"),
@@ -185,11 +186,30 @@ def _handlers(worker: Worker):
                 return
             if chunk_rows > 0:
                 yield b"H" + json.dumps({"progress": progress}).encode()
+                from datafusion_distributed_tpu.ops.table import (
+                    host_view,
+                    slice_view,
+                    zero_copy_enabled,
+                )
+
+                # honor the session's `SET distributed.zero_copy` (the
+                # coordinator ships it in the task config; the entry is
+                # still registered — this handler's finally invalidates)
+                data = worker.registry.get(key)
+                zc = zero_copy_enabled(
+                    data.config if data is not None else None
+                )
+                if zc:
+                    # one host rebind; chunks are views and encode_table
+                    # reads them without a device slice per chunk
+                    out = host_view(out)
                 n = int(out.num_rows)
                 for lo in range(0, max(n, 1), chunk_rows):
                     if not context.is_active():  # cancelled: stop producing
                         return
-                    piece = out.slice_rows(lo, min(chunk_rows, n - lo))
+                    count = min(chunk_rows, n - lo)
+                    piece = (slice_view(out, lo, count) if zc
+                             else out.slice_rows(lo, count))
                     yield b"T" + transport.pack_frame(
                         {}, {"table": encode_table(piece)}, codec=codec
                     )

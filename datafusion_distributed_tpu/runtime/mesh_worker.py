@@ -144,6 +144,16 @@ def execute_stage_span_on_mesh(
     hits."""
     leaves = plan.collect(lambda n: not n.children())
     stacked: dict = {}
+
+    def _stack(*xs):
+        # host-backed leaves (the zero-copy plane's peer pulls arrive as
+        # numpy views) stack ON THE HOST: their buffers then enter the
+        # device exactly once, at the device_put below, instead of paying
+        # a per-slice H2D for the stack plus a D2H for the re-stage
+        if all(isinstance(x, (np.ndarray, np.generic)) for x in xs):
+            return np.stack(xs)
+        return jnp.stack(xs)
+
     for leaf in leaves:
         if not hasattr(leaf, "load"):
             continue
@@ -152,20 +162,23 @@ def execute_stage_span_on_mesh(
             for i in range(span_width)
         ]
         per_task = _repad_uniform(per_task)
-        stacked[leaf.node_id] = jax.tree.map(
-            lambda *xs: jnp.stack(xs), *per_task
-        )
+        stacked[leaf.node_id] = jax.tree.map(_stack, *per_task)
 
     # Inputs pulled from OTHER meshes arrive committed to foreign devices
     # (the in-process bypass shares buffers); stage them onto THIS mesh
     # explicitly, through host — exactly the DCN hop a real multi-host
-    # deployment pays here.
+    # deployment pays here. Host-resident (numpy) buffers skip the
+    # round-trip and enter via device_put directly (on CPU jax shares the
+    # buffer through the dlpack/Arrow-layout import — see
+    # ops.table.to_device for the column-level dlpack path).
     from jax.sharding import NamedSharding
 
     sharding = NamedSharding(mesh, P(AXIS))
     stacked = {
         nid: jax.tree.map(
-            lambda x: jax.device_put(np.asarray(x), sharding), t
+            lambda x: jax.device_put(
+                x if isinstance(x, np.ndarray) else np.asarray(x), sharding
+            ), t
         )
         for nid, t in stacked.items()
     }

@@ -169,6 +169,31 @@ class ObservabilityService:
             return {}
         return self.fault_counters.as_dict()
 
+    def get_data_plane(self) -> dict:
+        """Per-worker TableStore accounting (the zero-copy data plane's
+        staged-bytes surface): ACTUAL staged bytes / entry / view counts
+        and high-water marks, from each worker's `get_info()["store"]`
+        (the gRPC client forwards the server's numbers). This is the
+        complement to the serving tier's admission ESTIMATE — what is
+        really held, not what was predicted. Degrades per worker like
+        `get_cluster_workers`."""
+        workers: dict = {}
+        totals = {"nbytes": 0, "entries": 0, "views": 0, "peak_nbytes": 0,
+                  "dedup_hits": 0}
+        for url in self.resolver.get_urls():
+            try:
+                info = self.channels.get_worker(url).get_info()
+            except Exception as e:
+                workers[url] = {"error": str(e)}
+                continue
+            stats = info.get("store")
+            if not isinstance(stats, dict):
+                continue
+            workers[url] = stats
+            for k in totals:
+                totals[k] += int(stats.get(k, 0))
+        return {**totals, "workers": workers}
+
     def get_serving_stats(self) -> dict:
         """Multi-query serving tier counters (empty without a wired
         ServingSession): active/queued query counts, admitted totals,

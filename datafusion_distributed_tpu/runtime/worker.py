@@ -163,6 +163,11 @@ class TaskData:
     # cancelled/errored partition stream cannot leak TableStore entries on
     # a long-lived worker (ADVICE r4)
     shipped_table_ids: list = field(default_factory=list)
+    # store ids of the STAGED partition slices (zero-copy accounting of
+    # the peer partition plane): released with the entry like shipped ids,
+    # and replaced wholesale when the partition spec changes (a re-spec
+    # must not pin the previous regrouped buffer)
+    staged_partition_ids: list = field(default_factory=list)
     # per-entry idle TTL override (None = the registry default). Peer-plane
     # producers ship at plan time but are first PULLED when their consumer
     # stage finally runs — on a deep plan under load that gap exceeded the
@@ -366,8 +371,9 @@ class Worker:
 
     def _on_task_evict(self, data: TaskData) -> None:
         """Registry-exit hook (invalidate, TTL expiry, sweep): release the
-        task's shipped table slices."""
+        task's shipped table slices and its staged partition slices."""
         self.table_store.remove(data.shipped_table_ids)
+        self.table_store.remove(data.staged_partition_ids)
 
     @classmethod
     def _sweep_stage_compiles_locked(cls, now: float) -> None:
@@ -630,10 +636,24 @@ class Worker:
         """Streaming data plane: execute once, then yield the output as
         (chunk Table, est_bytes) row-slices. A set ``cancel`` event stops
         slicing — un-yielded rows never cross the wire (the reference's
-        dropped-stream early exit, `impl_execute_task.rs:97-112`)."""
+        dropped-stream early exit, `impl_execute_task.rs:97-112`).
+
+        Zero-copy plane (default): the output is rebound to host buffers
+        ONCE and every chunk is a view of it — no per-chunk device slice
+        copies (`SET distributed.zero_copy = off` restores the copying
+        slicer)."""
+        from datafusion_distributed_tpu.ops.table import (
+            host_view,
+            slice_view,
+            zero_copy_enabled,
+        )
         from datafusion_distributed_tpu.planner.statistics import row_width
 
+        data = self.registry.get(key)
+        zc = zero_copy_enabled(data.config if data is not None else None)
         out = self.execute_task(key)
+        if zc:
+            out = host_view(out)
         n = int(out.num_rows)
         width = row_width(out.schema())
         if n == 0:
@@ -643,7 +663,10 @@ class Worker:
             if cancel is not None and cancel.is_set():
                 return
             count = min(chunk_rows, n - lo)
-            yield out.slice_rows(lo, count), count * width
+            yield (
+                slice_view(out, lo, count) if zc
+                else out.slice_rows(lo, count)
+            ), count * width
 
     def execute_task_partitions(
         self,
@@ -680,7 +703,18 @@ class Worker:
         spec = (tuple(key_names), int(num_partitions))
         with data.lock:
             if data.partition_slices is None or data.partition_spec != spec:
+                from datafusion_distributed_tpu.ops.table import (
+                    host_view,
+                    zero_copy_enabled,
+                )
+
+                zc = zero_copy_enabled(data.config)
                 out = self.execute_task(key)
+                if zc:
+                    # rebind to host buffers ONCE (free on CPU, the one
+                    # unavoidable D2H elsewhere); all partition slices and
+                    # chunk yields below are views of this buffer
+                    out = host_view(out)
                 if not key_names:
                     # replicate mode (peer broadcast / gather): the FULL
                     # output serves under every virtual partition id — the
@@ -700,19 +734,30 @@ class Worker:
 
                     cap = per_dest_capacity or max(int(out.capacity), 8)
                     data.partition_slices = _shuffle_regroup(
-                        [out], key_names, num_partitions, cap
+                        [out], key_names, num_partitions, cap,
+                        zero_copy=zc, exact=zc,
                     )
                 data.partition_spec = spec
                 data.partitions_served = set()
                 data.partitions_remaining = num_partitions
+                # staged-byte accounting on EITHER plane (the copying
+                # plane's padded slices are real allocations too); on the
+                # view plane these are views/aliases of one buffer
+                self._stage_partition_slices(key, data)
             # a concurrent stream finishing its range must not yank the
             # slices out from under this one: hold our own reference
             slices = data.partition_slices
+        from datafusion_distributed_tpu.ops.table import (
+            is_host_backed,
+            slice_view,
+        )
+
         try:
             for p in range(part_lo, min(part_hi, num_partitions)):
                 piece = slices[p]
                 n = int(piece.num_rows)
                 width = row_width(piece.schema())
+                view = is_host_backed(piece)
                 if n == 0:
                     yield p, piece.slice_rows(0, 0), 0
                 else:
@@ -720,7 +765,10 @@ class Worker:
                         if cancel is not None and cancel.is_set():
                             return
                         count = min(chunk_rows, n - lo)
-                        yield p, piece.slice_rows(lo, count), count * width
+                        yield p, (
+                            slice_view(piece, lo, count) if view
+                            else piece.slice_rows(lo, count)
+                        ), count * width
                 with data.lock:
                     if p not in data.partitions_served:
                         data.partitions_served.add(p)
@@ -754,6 +802,27 @@ class Worker:
                 self._stash_final_progress(key)
                 self.registry.invalidate(key)
 
+    def _stage_partition_slices(self, key: TaskKey, data: TaskData) -> None:
+        """Register the partitioned output's slices in the table store so
+        the worker's staged-byte accounting covers the peer data plane
+        (before this, partition slices lived only on the TaskData —
+        invisible to `nbytes`/observability). Slices are views of ONE
+        regrouped buffer (or the same replicated output object), so
+        identity dedup/view registration counts the buffer once. Released
+        by the registry-exit hook like shipped slices; a racing eviction
+        (query-end sweep vs a late pull) is healed by the re-check."""
+        if data.staged_partition_ids:
+            # re-partition under a NEW (keys, P) spec: the previous
+            # regrouped buffer's ids must not stay pinned/double-counted
+            self.table_store.remove(data.staged_partition_ids)
+        staged = [self.table_store.put(s) for s in data.partition_slices]
+        data.staged_partition_ids = staged
+        if self.registry.get(key) is not data:
+            # evicted while we staged: nobody will fire the exit hook for
+            # these ids anymore — release them here (idempotent)
+            self.table_store.remove(staged)
+            data.staged_partition_ids = []
+
     def partitions_remaining(self, key: TaskKey) -> Optional[int]:
         data = self.registry.get(key)
         return None if data is None else data.partitions_remaining
@@ -781,7 +850,11 @@ class Worker:
     def get_info(self) -> dict:
         return {"url": self.url, "version": self.version,
                 "tasks_cached": len(self.registry),
-                "peer_capable": self.peer_capable}
+                "peer_capable": self.peer_capable,
+                # staged-byte accounting (zero-copy data plane): actual
+                # staged bytes/entries/views + peak, per worker — the
+                # observability service's data-plane surface
+                "store": self.table_store.stats()}
 
     def task_progress(self, key: TaskKey) -> Optional[dict]:
         data = self.registry.get(key)

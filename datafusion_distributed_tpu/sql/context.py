@@ -215,12 +215,16 @@ class SessionConfig:
                         "serving_stage_slots must be >= 0 (0 = auto: "
                         "the worker count)"
                     )
-            elif key == "fair_share":
-                if isinstance(value, str):
-                    value = value.strip().lower() not in (
-                        "0", "false", "off", ""
-                    )
-                value = bool(value)
+            elif key in ("fair_share", "zero_copy"):
+                # boolean knobs: fair_share (serving scheduler policy),
+                # zero_copy (view-based data plane — `off` restores the
+                # copying plane everywhere). One shared parser so SET-time
+                # coercion and runtime reads can't drift.
+                from datafusion_distributed_tpu.ops.table import (
+                    parse_bool_knob,
+                )
+
+                value = parse_bool_knob(value)
             elif key == "tracing":
                 # distributed-tracing mode (runtime/tracing.py):
                 # validated at SET time so a typo fails the SET, not the

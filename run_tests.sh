@@ -104,6 +104,18 @@ if ! python -m pytest tests/test_elasticity.py -q --no-header \
         -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
     FAILED+=("tests/test_elasticity.py[gate]")
 fi
+# Zero-copy data-plane gate (tests/test_data_plane.py): buffer identity
+# across put/get/view-slice on the in-process plane, refcounted release
+# (partition drop + query-end sweep, incl. under chaos retries), TPC-H
+# q5/q9 byte-identical between the view and copying planes, a peak-
+# staged-bytes bound under the chaos retry schedule, and the >= 2x
+# view-vs-copy chunk-plane rate bound (the micro_bench data_plane case's
+# acceptance number).
+echo "=== tests/test_data_plane.py (zero-copy data-plane gate)"
+if ! python -m pytest tests/test_data_plane.py -q --no-header \
+        -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
+    FAILED+=("tests/test_data_plane.py[gate]")
+fi
 for f in tests/test_*.py; do
     [ "$f" = "tests/test_recompile_budget.py" ] && continue  # ran above
     [ "$f" = "tests/test_plan_verify.py" ] && continue  # ran above (gate)
@@ -111,6 +123,7 @@ for f in tests/test_*.py; do
     [ "$f" = "tests/test_serving.py" ] && continue  # ran above (gate)
     [ "$f" = "tests/test_tracing.py" ] && continue  # ran above (gate)
     [ "$f" = "tests/test_elasticity.py" ] && continue  # ran above (gate)
+    [ "$f" = "tests/test_data_plane.py" ] && continue  # ran above (gate)
     echo "=== $f"
     if ! python -m pytest "$f" -q --no-header -p no:cacheprovider \
             "${MARKER_ARGS[@]}" "$@"; then
