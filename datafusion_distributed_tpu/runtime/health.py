@@ -60,9 +60,12 @@ class HealthTracker:
         self.policy = policy or HealthPolicy()
         self._clock = clock
         self._lock = threading.Lock()
-        self._workers: dict[str, _WorkerState] = {}
+        self._workers: dict[str, _WorkerState] = {}  # guarded-by: _lock
 
-    def _state(self, url: str) -> _WorkerState:
+    def _state_locked(self, url: str) -> _WorkerState:
+        # DFTPU201 fix (naming): caller holds `_lock` — the *_locked
+        # suffix is the convention the concurrency lint enforces for
+        # helpers that mutate guarded state on the caller's lock
         s = self._workers.get(url)
         if s is None:
             s = self._workers[url] = _WorkerState()
@@ -70,7 +73,7 @@ class HealthTracker:
 
     def record_success(self, url: str) -> None:
         with self._lock:
-            s = self._state(url)
+            s = self._state_locked(url)
             s.total_successes += 1
             s.consecutive_failures = 0
             s.trips = 0
@@ -80,7 +83,7 @@ class HealthTracker:
         """-> True when this failure TRIPPED the breaker (closed/half-open ->
         open); the caller counts quarantine events off that edge."""
         with self._lock:
-            s = self._state(url)
+            s = self._state_locked(url)
             s.total_failures += 1
             s.consecutive_failures += 1
             if s.state == HALF_OPEN:

@@ -42,23 +42,27 @@ class MetricsStore:
     by every in-flight query's coordinator, so span recording, the
     running-query pin set, and LRU eviction all serialize on one lock."""
 
-    per_task: dict = field(default_factory=dict)
+    per_task: dict = field(default_factory=dict)  # guarded-by: _lock
     #: query_id -> {stage_id: {"submit_s","start_s","end_s","wall_s",
     #:                          "queue_s","plane"}} (LRU-ordered: a touch
     #: moves the query to the end; eviction pops from the front)
-    stage_spans: dict = field(default_factory=dict)
+    stage_spans: dict = field(default_factory=dict)  # guarded-by: _lock
     #: query_id -> total query wall seconds
-    query_walls: dict = field(default_factory=dict)
+    query_walls: dict = field(default_factory=dict)  # guarded-by: _lock
 
     def __post_init__(self):
         import threading
 
         self._lock = threading.Lock()
         #: queries currently executing — exempt from LRU eviction
-        self._running: set = set()
+        self._running: set = set()  # guarded-by: _lock
 
     def insert(self, task_label: str, node_metrics: dict) -> None:
-        self.per_task[task_label] = node_metrics
+        # DFTPU201 fix: concurrent task threads insert into one shared
+        # store under the serving tier; an unlocked dict write raced the
+        # snapshot reads below
+        with self._lock:
+            self.per_task[task_label] = node_metrics
 
     # -- query lifetime (eviction pinning) ----------------------------------
     def begin_query(self, query_id: str) -> None:
@@ -198,8 +202,10 @@ class MetricsStore:
 
     def aggregated(self) -> dict:
         """node_id -> {metric: summed value across tasks}."""
+        with self._lock:
+            per_task = dict(self.per_task)
         out: dict = {}
-        for metrics in self.per_task.values():
+        for metrics in per_task.values():
             for nid, mm in metrics.items():
                 slot = out.setdefault(nid, {})
                 for name, v in mm.items():
@@ -208,8 +214,10 @@ class MetricsStore:
 
     def per_task_view(self) -> dict:
         """node_id -> {metric_taskN: value} (PerTask format)."""
+        with self._lock:
+            per_task = dict(self.per_task)
         out: dict = {}
-        for label, metrics in sorted(self.per_task.items()):
+        for label, metrics in sorted(per_task.items()):
             for nid, mm in metrics.items():
                 slot = out.setdefault(nid, {})
                 for name, v in mm.items():
@@ -227,7 +235,7 @@ class FaultCounters:
         import threading
 
         self._lock = threading.Lock()
-        self._counts: dict[str, int] = {}
+        self._counts: dict[str, int] = {}  # guarded-by: _lock
 
     def bump(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -350,10 +358,10 @@ class LatencySketch:
         self.gamma = gamma
         self.min_value = min_value
         self._log_gamma = math.log(gamma)
-        self.buckets: dict[int, int] = {}
-        self.count = 0
-        self.min: Optional[float] = None
-        self.max: Optional[float] = None
+        self.buckets: dict[int, int] = {}  # guarded-by: _lock
+        self.count = 0  # guarded-by: _lock
+        self.min: Optional[float] = None  # guarded-by: _lock
+        self.max: Optional[float] = None  # guarded-by: _lock
         # the serving tier shares ONE sketch across every concurrent
         # query's coordinator + driver threads: the read-modify-write on
         # buckets/count must serialize or updates are silently lost

@@ -276,28 +276,28 @@ class GlobalStageScheduler:
         self.seed = int(seed)
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
-        self._pending: list[_StageJob] = []
-        self._pass: dict[str, float] = {}
-        self._prio: dict[str, int] = {}
-        self._weight: dict[str, float] = {}
-        self._qseq: dict[str, int] = {}
-        self._qseq_next = 0
+        self._pending: list[_StageJob] = []  # guarded-by: _lock
+        self._pass: dict[str, float] = {}  # guarded-by: _lock
+        self._prio: dict[str, int] = {}  # guarded-by: _lock
+        self._weight: dict[str, float] = {}  # guarded-by: _lock
+        self._qseq: dict[str, int] = {}  # guarded-by: _lock
+        self._qseq_next = 0  # guarded-by: _lock
         #: per-query in-flight stage count + mean stage wall (EMA): the
         #: provisional-charge inputs
-        self._running_stages: dict[str, int] = {}
-        self._mean_wall: dict[str, float] = {}
+        self._running_stages: dict[str, int] = {}  # guarded-by: _lock
+        self._mean_wall: dict[str, float] = {}  # guarded-by: _lock
         #: qids registered implicitly by submit() (direct coordinator
         #: use, no ServingSession driving unregister): reaped when their
         #: last job drains, so a long-lived scheduler does not grow
         #: per-query state for every ad-hoc query it ever served
-        self._adhoc: set = set()
-        self._seq = 0
-        self._closed = False
+        self._adhoc: set = set()  # guarded-by: _lock
+        self._seq = 0  # guarded-by: _lock
+        self._closed = False  # guarded-by: _lock
         #: pick order, for tests/introspection: (qid, job seq) per slot
         #: grant, appended under the lock
-        self.schedule_log: list[tuple] = []
-        self._in_flight = 0
-        self.peak_in_flight = 0
+        self.schedule_log: list[tuple] = []  # guarded-by: _lock
+        self._in_flight = 0  # guarded-by: _lock
+        self.peak_in_flight = 0  # guarded-by: _lock
         self._threads = [
             threading.Thread(target=self._loop, daemon=True,
                              name=f"dftpu-serve-{i}")
@@ -597,12 +597,13 @@ class ServingSession:
             seed=seed,
         )
         self._lock = threading.Lock()
-        self._queued: list[QueryHandle] = []  # arrival order preserved
-        self._running: dict[str, QueryHandle] = {}
-        self._drivers: dict[str, threading.Thread] = {}
-        self._admitted_total = 0
-        self._completed = {DONE: 0, FAILED: 0, CANCELLED: 0}
-        self._closed = False
+        # arrival order preserved
+        self._queued: list[QueryHandle] = []  # guarded-by: _lock
+        self._running: dict[str, QueryHandle] = {}  # guarded-by: _lock
+        self._drivers: dict[str, threading.Thread] = {}  # guarded-by: _lock
+        self._admitted_total = 0  # guarded-by: _lock
+        self._completed = {DONE: 0, FAILED: 0, CANCELLED: 0}  # guarded-by: _lock
+        self._closed = False  # guarded-by: _lock
 
     # -- option plumbing ----------------------------------------------------
     def _opt(self, name: str, default):

@@ -32,9 +32,9 @@ class StreamBudget:
 
     def __init__(self, budget_bytes: int):
         self.budget = max(int(budget_bytes), 1)
-        self._in_flight = 0
-        self.peak_in_flight = 0
         self._cv = threading.Condition()
+        self._in_flight = 0  # guarded-by: _cv
+        self.peak_in_flight = 0  # guarded-by: _cv
 
     def acquire(self, nbytes: int, cancel: threading.Event) -> bool:
         with self._cv:

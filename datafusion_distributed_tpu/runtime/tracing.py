@@ -221,13 +221,13 @@ class QueryTrace:
         self.finished = False
         # ring buffers: deque(maxlen=...) drops the OLDEST on overflow;
         # `dropped` counts evictions so exports can say "N spans dropped"
-        self.spans: deque = deque(maxlen=span_cap)
-        self.events: deque = deque(maxlen=event_cap)
-        self.dropped = 0
-        self.events_dropped = 0
+        self.spans: deque = deque(maxlen=span_cap)  # guarded-by: _lock
+        self.events: deque = deque(maxlen=event_cap)  # guarded-by: _lock
+        self.dropped = 0  # guarded-by: _lock
+        self.events_dropped = 0  # guarded-by: _lock
         self._lock = threading.Lock()
-        self._next_id = 0
-        self._reserved: dict = {}
+        self._next_id = 0  # guarded-by: _lock
+        self._reserved: dict = {}  # guarded-by: _lock
         self.root_id: Optional[int] = None
         # summary tally memo, filled by TraceStore._tally once finished
         self._tally_cache: Optional[tuple] = None
@@ -442,10 +442,11 @@ class TraceStore:
                  span_cap: int = _SPAN_CAP):
         self.query_cap = query_cap
         self.span_cap = span_cap
-        self._traces: dict = {}   # insertion order == LRU order
-        self._running: set = set()
+        # insertion order == LRU order
+        self._traces: dict = {}  # guarded-by: _lock
+        self._running: set = set()  # guarded-by: _lock
         self._lock = threading.Lock()
-        self._started_total = 0
+        self._started_total = 0  # guarded-by: _lock
 
     # -- lifecycle ----------------------------------------------------------
     def begin(self, query_id: str, mode: str,

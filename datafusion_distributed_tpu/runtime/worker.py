@@ -222,7 +222,7 @@ class TaskRegistry:
     def __init__(self, ttl_seconds: float = 600.0,
                  on_evict: Optional[Callable[[TaskData], None]] = None):
         self.ttl = ttl_seconds
-        self._entries: dict[TaskKey, tuple[float, TaskData]] = {}
+        self._entries: dict[TaskKey, tuple[float, TaskData]] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         # fired (outside hot paths, under the registry lock) for EVERY entry
         # leaving the registry — invalidate, TTL expiry, or sweep — so owners
@@ -231,7 +231,7 @@ class TaskRegistry:
 
     def put(self, data: TaskData) -> None:
         with self._lock:
-            self._evict()
+            self._evict_locked()
             # replacement evicts the displaced entry (releases its shipped
             # slices — table ids are unique per encode, so the new entry's
             # slices are untouched): a re-ship of the same key (retry to
@@ -247,7 +247,7 @@ class TaskRegistry:
 
     def get(self, key: TaskKey) -> Optional[TaskData]:
         with self._lock:
-            self._evict()
+            self._evict_locked()
             hit = self._entries.get(key)
             if hit is None:
                 return None
@@ -278,7 +278,10 @@ class TaskRegistry:
             for _, data in entries:
                 self._fire_evict(data)
 
-    def _evict(self) -> None:
+    def _evict_locked(self) -> None:
+        # DFTPU201/203 fix: caller holds `_lock` (the *_locked-suffix
+        # convention the concurrency lint enforces; the old name implied
+        # a self-locking method)
         now = time.time()
         dead = [
             k for k, (ts, d) in self._entries.items()
@@ -337,7 +340,7 @@ class Worker:
         # entry (pinning decoded tables until the TTL sweep) after the
         # coordinator rerouted — see set_plan's timeout path
         self._abandoned_lock = threading.Lock()
-        self._abandoned_plans: set = set()
+        self._abandoned_plans: set = set()  # guarded-by: _abandoned_lock
 
     # stage-shared compiled programs (slot key -> (last_touch, execute_plan
     # shared cache)): every task of a stage decodes its own plan copy, but
@@ -364,7 +367,7 @@ class Worker:
     # per-STAGE slots now, hence larger than the old per-query cap of 8);
     # dict order still tracks recency-of-USE so eviction takes cold slots
     # first.
-    _stage_compiles: dict = {}
+    _stage_compiles: dict = {}  # guarded-by: _stage_compiles_lock
     _stage_compiles_lock = threading.Lock()
     _STAGE_COMPILE_SLOT_CAP = 64
     _STAGE_COMPILE_TTL_S = 600.0

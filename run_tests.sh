@@ -37,6 +37,17 @@ if ! python tools/check_tracer_safety.py; then
     echo "justification)"
     FAILED+=("tools/check_tracer_safety.py[lint-gate]")
 fi
+# Concurrency-safety lint gate (tools/check_concurrency.py): pure-AST,
+# sub-second — guarded-by discipline (DFTPU201-205) and the static
+# lock-ordering graph (DFTPU206/207) over the whole package, before any
+# XLA compile is paid. Stale allowlist entries fail the gate too.
+echo "=== tools/check_concurrency.py (concurrency-safety lint gate)"
+if ! python tools/check_concurrency.py; then
+    echo "LINT FAILED: concurrency-safety violations (see above;"
+    echo "intentional exceptions go in tools/concurrency_allowlist.txt"
+    echo "with a justification)"
+    FAILED+=("tools/check_concurrency.py[lint-gate]")
+fi
 # Static-verifier gate SECOND (tests/test_plan_verify.py): the seeded
 # malformed-plan classes must each be rejected with their DFTPU0xx code,
 # and the snapshot-suite/inlined clean sweep must verify with zero errors
@@ -64,10 +75,17 @@ fi
 # vs sequential stage scheduling must stay byte-identical (incl. under a
 # seeded chaos schedule), the overlap factor must exceed 1.0 on bushy
 # plans, and a fatal error must cancel + release in-flight siblings.
-echo "=== tests/test_stage_scheduler.py (stage-DAG scheduler gate)"
-if ! python -m pytest tests/test_stage_scheduler.py -q --no-header \
+# INSTRUMENTED (race-harness gate, runtime/lockcheck.py): this gate and
+# the serving + data-plane gates below export DFTPU_LOCK_CHECK=1, so
+# every seeded chaos/churn schedule doubles as a deadlock/race harness —
+# per-thread acquisition stacks, observed-vs-static lock-order
+# assertion (a cycle raises with both stacks instead of hanging), and
+# same results byte-identical under instrumentation.
+echo "=== tests/test_stage_scheduler.py (stage-DAG scheduler gate, DFTPU_LOCK_CHECK=1)"
+if ! env DFTPU_LOCK_CHECK=1 python -m pytest tests/test_stage_scheduler.py \
+        -q --no-header \
         -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
-    FAILED+=("tests/test_stage_scheduler.py[gate]")
+    FAILED+=("tests/test_stage_scheduler.py[gate+lockcheck]")
 fi
 # Serving gate (tests/test_serving.py): the multi-query tier —
 # N concurrent clients over one cluster must produce byte-identical
@@ -76,10 +94,13 @@ fi
 # global cross-query scheduler must respect its slot bound and fair-share
 # policy, and prepared-statement serving must perform zero new XLA
 # traces across parameter variations (the recompile gate's serving arm).
-echo "=== tests/test_serving.py (multi-query serving gate)"
-if ! python -m pytest tests/test_serving.py -q --no-header \
+# Runs under DFTPU_LOCK_CHECK=1 (see the race-harness note above): the
+# 8-thread mixed run is the widest cross-thread schedule in the suite.
+echo "=== tests/test_serving.py (multi-query serving gate, DFTPU_LOCK_CHECK=1)"
+if ! env DFTPU_LOCK_CHECK=1 python -m pytest tests/test_serving.py \
+        -q --no-header \
         -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
-    FAILED+=("tests/test_serving.py[gate]")
+    FAILED+=("tests/test_serving.py[gate+lockcheck]")
 fi
 # Tracing gate (tests/test_tracing.py): the distributed-tracing
 # subsystem — span-tree shape for distributed TPC-H (worker spans joined
@@ -111,10 +132,13 @@ fi
 # staged-bytes bound under the chaos retry schedule, and the >= 2x
 # view-vs-copy chunk-plane rate bound (the micro_bench data_plane case's
 # acceptance number).
-echo "=== tests/test_data_plane.py (zero-copy data-plane gate)"
-if ! python -m pytest tests/test_data_plane.py -q --no-header \
+# Runs under DFTPU_LOCK_CHECK=1: the 8-thread churn run exercises the
+# TableStore/TaskRegistry lock pairs the static graph predicts.
+echo "=== tests/test_data_plane.py (zero-copy data-plane gate, DFTPU_LOCK_CHECK=1)"
+if ! env DFTPU_LOCK_CHECK=1 python -m pytest tests/test_data_plane.py \
+        -q --no-header \
         -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
-    FAILED+=("tests/test_data_plane.py[gate]")
+    FAILED+=("tests/test_data_plane.py[gate+lockcheck]")
 fi
 for f in tests/test_*.py; do
     [ "$f" = "tests/test_recompile_budget.py" ] && continue  # ran above

@@ -22,6 +22,18 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+# Instrumented deadlock/race harness (runtime/lockcheck.py) for the
+# heavily-threaded suites: when a pytest invocation TARGETS the serving /
+# stage-scheduler / data-plane files, export DFTPU_LOCK_CHECK=1 before
+# the package import below installs its lock factories — their seeded
+# chaos/churn schedules then double as a race harness (observed
+# lock-order asserted against tools/check_concurrency.py's static graph;
+# a cycle raises with both acquisition stacks instead of hanging).
+# setdefault: DFTPU_LOCK_CHECK=0 still opts a run out explicitly.
+_LOCKCHECK_SUITES = ("test_serving", "test_stage_scheduler",
+                     "test_data_plane")
+if any(s in a for a in sys.argv for s in _LOCKCHECK_SUITES):
+    os.environ.setdefault("DFTPU_LOCK_CHECK", "1")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # single-core box: give mesh collectives starvation headroom (shared

@@ -1,0 +1,615 @@
+"""Concurrency-safety gates: the static analyzer
+(tools/check_concurrency.py) and the runtime lock-order harness
+(runtime/lockcheck.py).
+
+Static half — seeded-violation fixtures prove every DFTPU201-207 code
+fires (and that the disciplined variant of the same code does NOT), the
+package-wide run is clean, and the allowlist keeps its contract
+(mandatory justification, suppression, stale entries are errors — shared
+with the tracer-safety gate via tools/lint_common.py).
+
+Dynamic half — a deliberate lock-inversion pair proves the instrumented
+checker reports the cycle with BOTH acquisition stacks instead of
+deadlocking, same-thread re-entry of a plain Lock raises immediately,
+and the package-install path (DFTPU_LOCK_CHECK=1 at import) wraps
+package-created locks under their static-graph names.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO_ROOT, "tools", "check_concurrency.py")
+TRACER_TOOL = os.path.join(REPO_ROOT, "tools", "check_tracer_safety.py")
+
+from datafusion_distributed_tpu.runtime import lockcheck  # noqa: E402
+
+
+def run_tool(args, allowlist=None):
+    cmd = [sys.executable, TOOL]
+    if allowlist is not None:
+        cmd += ["--allowlist", str(allowlist)]
+    cmd += [str(a) for a in args]
+    return subprocess.run(cmd, capture_output=True, text=True,
+                          cwd=REPO_ROOT)
+
+
+def lint_source(tmp_path, source, name="fixture.py"):
+    """Lint one seeded-violation file with an EMPTY allowlist; -> the
+    parsed --json document."""
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    empty = tmp_path / "empty_allowlist.txt"
+    empty.write_text("")
+    r = run_tool(["--json", f], allowlist=empty)
+    assert r.stdout, r.stderr
+    return json.loads(r.stdout), r.returncode
+
+
+def codes_by_qualname(doc):
+    return {
+        (v["rule"], v["qualname"]) for v in doc["violations"]
+    }
+
+
+# ---------------------------------------------------------------------------
+# seeded violations: every code fires; the disciplined variant does not
+# ---------------------------------------------------------------------------
+
+
+def test_dftpu201_unguarded_write_and_mutation(tmp_path):
+    doc, rc = lint_source(tmp_path, """
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}  # guarded-by: _lock
+
+            def good(self, k, v):
+                with self._lock:
+                    self._items[k] = v
+
+            def bad_write(self, k, v):
+                self._items[k] = v
+
+            def bad_mutation(self):
+                self._items.clear()
+
+            def bad_del(self, k):
+                del self._items[k]
+
+            def _sweep_locked(self):
+                self._items.clear()
+        """)
+    hits = codes_by_qualname(doc)
+    assert ("DFTPU201", "Store.bad_write") in hits
+    assert ("DFTPU201", "Store.bad_mutation") in hits
+    assert ("DFTPU201", "Store.bad_del") in hits
+    # discipline is NOT flagged: locked writes, __init__, *_locked helper
+    assert not any(q.startswith("Store.good") for _r, q in hits)
+    assert not any("__init__" in q for _r, q in hits)
+    assert not any("_sweep_locked" in q for _r, q in hits)
+    assert rc == 1
+
+
+def test_dftpu201_guarded_by_class_map(tmp_path):
+    doc, _rc = lint_source(tmp_path, """
+        import threading
+
+        class Mapped:
+            _GUARDED_BY = {"_cache": "_lock"}
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cache = {}
+
+            def bad(self):
+                self._cache["k"] = 1
+
+            def good(self):
+                with self._lock:
+                    self._cache["k"] = 1
+        """)
+    hits = codes_by_qualname(doc)
+    assert ("DFTPU201", "Mapped.bad") in hits
+    assert ("DFTPU201", "Mapped.good") not in hits
+
+
+def test_condition_alias_counts_as_the_lock(tmp_path):
+    doc, rc = lint_source(tmp_path, """
+        import threading
+
+        class CVed:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+                self._q = []  # guarded-by: _lock
+
+            def put(self, x):
+                with self._cv:
+                    self._q.append(x)
+                    self._cv.notify()
+        """)
+    assert doc["violations"] == []
+    assert rc == 0
+
+
+def test_dftpu202_locked_method_reacquires(tmp_path):
+    doc, _rc = lint_source(tmp_path, """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0  # guarded-by: _lock
+
+            def _bump_locked(self):
+                with self._lock:
+                    self._n += 1
+        """)
+    assert ("DFTPU202", "S._bump_locked") in codes_by_qualname(doc)
+
+
+def test_dftpu203_unlocked_helper_call(tmp_path):
+    doc, _rc = lint_source(tmp_path, """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0  # guarded-by: _lock
+
+            def _bump_locked(self):
+                self._n += 1
+
+            def bad(self):
+                self._bump_locked()
+
+            def good(self):
+                with self._lock:
+                    self._bump_locked()
+        """)
+    hits = codes_by_qualname(doc)
+    assert ("DFTPU203", "S.bad") in hits
+    assert ("DFTPU203", "S.good") not in hits
+
+
+def test_dftpu204_guarded_container_escape(tmp_path):
+    doc, _rc = lint_source(tmp_path, """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}  # guarded-by: _lock
+
+            def bad(self):
+                with self._lock:
+                    return self._items
+
+            def good(self):
+                with self._lock:
+                    return dict(self._items)
+        """)
+    hits = codes_by_qualname(doc)
+    assert ("DFTPU204", "S.bad") in hits
+    assert ("DFTPU204", "S.good") not in hits
+
+
+def test_dftpu205_blocking_while_locked(tmp_path):
+    doc, _rc = lint_source(tmp_path, """
+        import threading
+        import time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad_sleep(self):
+                with self._lock:
+                    time.sleep(0.5)
+
+            def bad_rpc(self, worker, key, obj):
+                with self._lock:
+                    worker.set_plan(key, obj, 1)
+
+            def good(self):
+                with self._lock:
+                    pass
+                time.sleep(0.5)
+        """)
+    hits = codes_by_qualname(doc)
+    assert ("DFTPU205", "S.bad_sleep") in hits
+    assert ("DFTPU205", "S.bad_rpc") in hits
+    assert ("DFTPU205", "S.good") not in hits
+
+
+def test_cv_wait_on_held_condition_not_blocking(tmp_path):
+    doc, rc = lint_source(tmp_path, """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._q = []  # guarded-by: _cv
+
+            def take(self):
+                with self._cv:
+                    while not self._q:
+                        self._cv.wait(timeout=0.05)
+                    return self._q.pop()
+        """)
+    assert not any(r == "DFTPU205" for r, _q in codes_by_qualname(doc))
+
+
+def test_dftpu206_lock_order_cycle(tmp_path):
+    doc, rc = lint_source(tmp_path, """
+        import threading
+
+        A_LOCK = threading.Lock()
+        B_LOCK = threading.Lock()
+
+        def forward():
+            with A_LOCK:
+                with B_LOCK:
+                    pass
+
+        def backward():
+            with B_LOCK:
+                with A_LOCK:
+                    pass
+        """)
+    rules = [v["rule"] for v in doc["violations"]]
+    assert "DFTPU206" in rules
+    cyc = next(v for v in doc["violations"] if v["rule"] == "DFTPU206")
+    assert "A_LOCK" in cyc["message"] and "B_LOCK" in cyc["message"]
+    # the graph rides the JSON for the runtime checker
+    edges = {(e["src"], e["dst"]) for e in doc["lock_graph"]["edges"]}
+    assert len(edges) == 2
+
+
+def test_dftpu207_same_lock_reentry(tmp_path):
+    doc, _rc = lint_source(tmp_path, """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+
+            def lexical(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+        """)
+    hits = codes_by_qualname(doc)
+    assert ("DFTPU207", "S.outer") in hits
+    assert ("DFTPU207", "S.lexical") in hits
+
+
+def test_rlock_reentry_not_flagged(tmp_path):
+    doc, rc = lint_source(tmp_path, """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+        """)
+    assert not any(r == "DFTPU207" for r, _q in codes_by_qualname(doc))
+    assert rc == 0
+
+
+def test_cross_class_edge_resolution(tmp_path):
+    """`self.attr.method()` under a held lock resolves the attribute's
+    class (constructor assignment) and imports its acquisitions."""
+    doc, _rc = lint_source(tmp_path, """
+        import threading
+
+        class Inner:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def poke(self):
+                with self._lock:
+                    pass
+
+        class Outer:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.inner = Inner()
+
+            def call_under_lock(self):
+                with self._lock:
+                    self.inner.poke()
+        """)
+    edges = {(e["src"], e["dst"]) for e in doc["lock_graph"]["edges"]}
+    assert ("Outer._lock", "Inner._lock") in edges
+
+
+# ---------------------------------------------------------------------------
+# package-wide run + allowlist/JSON contract
+# ---------------------------------------------------------------------------
+
+
+def test_package_wide_clean():
+    """The gate's exact invocation: zero unallowlisted findings, zero
+    stale allowlist entries, sub-second enough to run before any XLA
+    compile."""
+    r = run_tool([])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "concurrency-safety lint clean" in r.stdout
+
+
+def test_package_json_exposes_the_static_graph():
+    r = run_tool(["--json"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["violations"] == []
+    assert doc["stale_allowlist"] == []
+    edges = {(e["src"], e["dst"]) for e in doc["lock_graph"]["edges"]}
+    # the serving tier's signature nesting: admitting a query registers
+    # it with the global scheduler under the session lock
+    assert ("ServingSession._lock", "GlobalStageScheduler._lock") in edges
+    # the declarative model is published for every annotated class
+    for cls in ("TableStore", "GlobalStageScheduler", "ServingSession",
+                "HealthTracker", "MetricsStore", "TraceStore",
+                "FaultCounters", "LatencySketch"):
+        assert cls in doc["guarded_classes"], cls
+
+
+def test_allowlist_requires_justification(tmp_path):
+    f = tmp_path / "fixture.py"
+    f.write_text("x = 1\n")
+    bad = tmp_path / "allow.txt"
+    bad.write_text("a.py::DFTPU201::f\n")  # no justification comment
+    r = run_tool([f], allowlist=bad)
+    assert r.returncode == 2
+    assert "justification" in r.stderr
+
+
+def test_allowlist_malformed_entry(tmp_path):
+    f = tmp_path / "fixture.py"
+    f.write_text("x = 1\n")
+    bad = tmp_path / "allow.txt"
+    bad.write_text("a.py::DFTPU201  # missing qualname part\n")
+    r = run_tool([f], allowlist=bad)
+    assert r.returncode == 2
+
+
+def test_allowlist_suppresses_matching_finding(tmp_path):
+    f = tmp_path / "fixture.py"
+    f.write_text(textwrap.dedent("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0  # guarded-by: _lock
+
+            def bad(self):
+                self._n = 1
+        """))
+    rel = os.path.relpath(str(f), REPO_ROOT).replace(os.sep, "/")
+    allow = tmp_path / "allow.txt"
+    allow.write_text(f"{rel}::DFTPU201::S.bad  # seeded, intentional\n")
+    r = run_tool([f], allowlist=allow)
+    assert r.returncode == 0, r.stdout
+    assert "1 allowlisted" in r.stdout
+
+
+def test_stale_allowlist_entry_fails_gate(tmp_path):
+    """A stale entry is an ERROR on the full-package run (it can mask a
+    future regression under the same key) — for BOTH lint gates, via the
+    shared loader."""
+    for tool, src in ((TOOL, "concurrency_allowlist.txt"),
+                      (TRACER_TOOL, "tracer_safety_allowlist.txt")):
+        live = open(os.path.join(REPO_ROOT, "tools", src)).read()
+        allow = tmp_path / f"stale_{src}"
+        allow.write_text(
+            live + "\nno/such/file.py::DFTPU999::ghost  # stale entry\n"
+        )
+        r = subprocess.run(
+            [sys.executable, tool, "--allowlist", str(allow)],
+            capture_output=True, text=True, cwd=REPO_ROOT,
+        )
+        assert r.returncode == 1, (tool, r.stdout)
+        assert "stale allowlist entry" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# dynamic harness (runtime/lockcheck.py)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _fresh_lockcheck():
+    lockcheck.reset()
+    yield
+    lockcheck.reset()
+
+
+def test_dynamic_lock_inversion_reports_cycle_with_both_stacks():
+    """The deliberate inversion pair: thread 1 takes A then B, thread 2
+    takes B then A. The checker must RAISE (not deadlock) and the error
+    must carry both acquisition stacks."""
+    a = lockcheck.wrap_lock(name="Inv._a")
+    b = lockcheck.wrap_lock(name="Inv._b")
+    errors = []
+
+    def forward():
+        with a:
+            time.sleep(0.05)
+            with b:
+                pass
+
+    def backward():
+        time.sleep(0.02)
+        try:
+            with b:
+                time.sleep(0.05)
+                with a:
+                    pass
+        except lockcheck.LockOrderViolation as e:
+            errors.append(str(e))
+
+    t1 = threading.Thread(target=forward)
+    t2 = threading.Thread(target=backward)
+    t1.start(); t2.start()
+    t1.join(timeout=10); t2.join(timeout=10)
+    assert errors, "inversion not detected"
+    msg = errors[0]
+    assert "Inv._a" in msg and "Inv._b" in msg
+    assert "this acquisition" in msg and "prior acquisition" in msg
+    # both stacks name this test file (real tracebacks, not placeholders)
+    assert msg.count(os.path.basename(__file__)) >= 2
+
+
+def test_dynamic_recurring_inversion_keeps_raising():
+    """A cycle-closing edge is never recorded, so the SAME inversion
+    raises on every recurrence — it must not enter the known-edge fast
+    path and proceed into the real deadlock on the second hit."""
+    a = lockcheck.wrap_lock(name="Rec._a")
+    b = lockcheck.wrap_lock(name="Rec._b")
+    with a:
+        with b:
+            pass
+    for _ in range(2):
+        with b:
+            with pytest.raises(lockcheck.LockOrderViolation):
+                a.acquire()
+
+
+def test_dynamic_same_lock_reentry_raises_instead_of_hanging():
+    c = lockcheck.wrap_lock(name="Re._c", kind="lock")
+    with c:
+        with pytest.raises(lockcheck.LockReentryError):
+            c.acquire()
+
+
+def test_dynamic_rlock_reentry_is_fine():
+    r = lockcheck.wrap_lock(name="Re._r", kind="rlock")
+    with r:
+        with r:
+            pass
+    assert lockcheck.report(include_static=False)["observed_edges"] == []
+
+
+def test_observed_edge_merges_against_static_graph():
+    """An observed nesting the static analyzer predicted is marked
+    `static`; an order it never saw is marked `new` — the merged-artifact
+    contract."""
+    sess = lockcheck.wrap_lock(name="ServingSession._lock")
+    sched = lockcheck.wrap_lock(name="GlobalStageScheduler._lock")
+    novel = lockcheck.wrap_lock(name="NoSuchClass._lock")
+    with sess:
+        with sched:
+            pass
+    with sched:
+        with novel:
+            pass
+    rep = lockcheck.report(include_static=True)
+    assert rep["static_edges"], "static graph failed to load"
+    by_edge = {(e["src"], e["dst"]): e["status"]
+               for e in rep["observed_edges"]}
+    assert by_edge[("ServingSession._lock",
+                    "GlobalStageScheduler._lock")] == "static"
+    assert by_edge[("GlobalStageScheduler._lock",
+                    "NoSuchClass._lock")] == "new"
+
+
+def test_hold_time_outlier_recorded():
+    slow = lockcheck.wrap_lock(name="Slow._lock")
+    with slow:
+        time.sleep(lockcheck._HOLD_OUTLIER_S + 0.05)
+    rep = lockcheck.report(include_static=False)
+    assert any(o["lock"] == "Slow._lock" for o in rep["hold_outliers"])
+
+
+def test_note_blocking_records_lock_while_compiling(monkeypatch):
+    monkeypatch.setattr(lockcheck, "_installed", True)
+    held = lockcheck.wrap_lock(name="Compiler._lock")
+    with held:
+        lockcheck.note_blocking("xla_compile")
+    rep = lockcheck.report(include_static=False)
+    assert any(
+        e["kind"] == "lock_while_xla_compile"
+        and "Compiler._lock" in e["locks_held"]
+        for e in rep["events"]
+    )
+
+
+def test_install_at_package_init_names_package_locks():
+    """DFTPU_LOCK_CHECK=1 at import wraps locks created by the package
+    under their static-graph identities, and an inversion between them is
+    reported with both stacks (subprocess: the install patches
+    threading.* process-wide)."""
+    script = textwrap.dedent("""
+        import threading, time
+        import datafusion_distributed_tpu  # installs the harness
+        from datafusion_distributed_tpu.runtime import lockcheck
+        assert lockcheck.enabled()
+
+        from datafusion_distributed_tpu.runtime.metrics import (
+            FaultCounters, MetricsStore,
+        )
+
+        ms, fc = MetricsStore(), FaultCounters()
+        assert ms._lock.name == "MetricsStore._lock", ms._lock
+        assert fc._lock.name == "FaultCounters._lock", fc._lock
+
+        def forward():
+            with ms._lock:
+                time.sleep(0.05)
+                with fc._lock:
+                    pass
+
+        hit = []
+        def backward():
+            time.sleep(0.02)
+            try:
+                with fc._lock:
+                    time.sleep(0.05)
+                    with ms._lock:
+                        pass
+            except lockcheck.LockOrderViolation as e:
+                hit.append(str(e))
+
+        t1 = threading.Thread(target=forward)
+        t2 = threading.Thread(target=backward)
+        t1.start(); t2.start()
+        t1.join(10); t2.join(10)
+        assert hit, "inversion not detected under installed harness"
+        assert "MetricsStore._lock" in hit[0]
+        assert "FaultCounters._lock" in hit[0]
+        assert "prior acquisition" in hit[0]
+        print("INSTALL_HARNESS_OK")
+        """)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", DFTPU_LOCK_CHECK="1")
+    r = subprocess.run([sys.executable, "-c", script],
+                       capture_output=True, text=True, cwd=REPO_ROOT,
+                       env=env, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "INSTALL_HARNESS_OK" in r.stdout

@@ -1,0 +1,1045 @@
+#!/usr/bin/env python
+"""Concurrency-safety lint for datafusion_distributed_tpu.
+
+A pure-AST analyzer (stdlib only — no jax import, no device, no network,
+sub-second) for the failure modes PRs 4-8 made possible: the runtime is
+now heavily concurrent (stage-DAG fan-out threads, the multi-query
+serving tier, a shared TableStore) and protected by ~40 ad-hoc
+``threading.Lock``/``RLock``/``Condition`` sites whose conventions
+nothing enforced. The Rust reference gets this safety from ``Send``/
+``Sync`` at the type level (SURVEY §L0); this tool is the Python-side
+equivalent: a declarative concurrency model plus a lint that holds the
+code to it.
+
+The declarative model: a threaded class declares which lock guards each
+shared field, either with a trailing comment on the field's init ::
+
+    self._pending = []  # guarded-by: _lock
+
+or with a class-level map (for dataclasses / lazily-created fields) ::
+
+    _GUARDED_BY = {"_span_shipped": "_span_lock"}
+
+``threading.Condition(self._lock)`` aliases are resolved — holding the
+condition IS holding the lock. Construction (``__init__``/
+``__post_init__``/``__new__``) is exempt (happens-before publication),
+and the ``*_locked``-suffix method convention means "caller holds the
+lock".
+
+Rule codes (DFTPU2xx; DFTPU0xx is the plan verifier's, DFTPU1xx the
+tracer-safety lint's):
+
+  DFTPU201  unguarded-write      write / augmented write / del /
+                                 container mutation of a declared
+                                 guarded field outside a ``with
+                                 self._lock`` block or a ``*_locked``
+                                 method
+  DFTPU202  locked-reacquire     a ``*_locked`` method acquiring its own
+                                 class's lock (the suffix PROMISES the
+                                 caller holds it; acquiring again
+                                 deadlocks a plain Lock)
+  DFTPU203  unlocked-helper-call calling a ``*_locked`` helper with no
+                                 lock held on the calling path
+  DFTPU204  guarded-escape       ``return``/``yield`` of a direct
+                                 reference to a guarded MUTABLE
+                                 container (hand out a snapshot copy;
+                                 the reference escapes the lock)
+  DFTPU205  blocking-while-locked a blocking call — RPC dispatch
+                                 (set_plan / set_stage_plan /
+                                 execute_task*), cf.wait / Future
+                                 .result, Event.wait, time.sleep, XLA
+                                 compile entry points — while holding a
+                                 lock
+  DFTPU206  lock-order-cycle     a cycle in the static nested-
+                                 acquisition graph (built from ``with``
+                                 nesting and cross-class calls): a
+                                 potential deadlock
+  DFTPU207  same-lock-reentry    re-acquiring a NON-reentrant Lock
+                                 already held on the same path (lexical
+                                 nesting or a transitive call) — a
+                                 guaranteed self-deadlock
+
+The nested-acquisition graph this tool builds is also the contract the
+runtime checker (datafusion_distributed_tpu/runtime/lockcheck.py,
+``DFTPU_LOCK_CHECK=1``) asserts OBSERVED acquisition order against;
+``--json`` includes it under ``lock_graph``.
+
+Intentional exceptions live in tools/concurrency_allowlist.txt as
+``path::RULE::qualname  # one-line justification``; the gate fails on
+any finding not covered there AND on any stale entry. Exit code 0 =
+clean, 1 = violations/stale entries, 2 = usage error.
+
+Usage:
+  python tools/check_concurrency.py                # lint the package
+  python tools/check_concurrency.py FILE [FILE..]  # lint specific files
+  python tools/check_concurrency.py --json         # machine-readable
+  python tools/check_concurrency.py --allowlist F  # alternate allowlist
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from lint_common import (  # noqa: E402
+    Finding,
+    apply_allowlist,
+    load_allowlist,
+    report_text,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = "datafusion_distributed_tpu"
+DEFAULT_ALLOWLIST = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "concurrency_allowlist.txt"
+)
+
+GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_]\w*)")
+
+#: threading factory -> lock kind. "lock" is the only NON-reentrant kind
+#: (DFTPU207); Condition carries its wrapped lock's kind via aliasing.
+_LOCK_FACTORIES = {"Lock": "lock", "RLock": "rlock",
+                   "Condition": "condition"}
+#: methods that run happens-before publication of self
+_INIT_METHODS = {"__init__", "__post_init__", "__new__", "__init_subclass__"}
+#: container-mutating method names (rule 201's "container mutation")
+_MUTATORS = {
+    "append", "appendleft", "extend", "insert", "add", "discard",
+    "remove", "pop", "popleft", "popitem", "clear", "update",
+    "setdefault", "sort", "reverse",
+}
+#: calls that construct a mutable container (rule 204 typing + aliasing)
+_MUTABLE_CTORS = {"list", "dict", "set", "deque", "defaultdict",
+                  "OrderedDict", "Counter"}
+#: dotted names (exact) that block (rule 205)
+_BLOCKING_EXACT = {
+    "time.sleep", "cf.wait", "futures.wait", "concurrent.futures.wait",
+}
+#: last-attribute names that block regardless of receiver (rule 205):
+#: the worker RPC dispatch surface + XLA compile entry points
+_BLOCKING_TAIL = {
+    "set_plan", "set_stage_plan", "execute_task", "execute_task_stream",
+    "execute_task_partitions", "execute_plan", "block_until_ready",
+}
+#: receiver hints for ``.wait()`` / ``.result()`` blocking calls — an
+#: ``Event.wait`` or ``Future.result`` under a lock stalls every other
+#: holder; a Condition's own ``.wait`` RELEASES the lock and is excluded
+#: by comparing against the held with-expressions
+_WAIT_RECEIVER_HINTS = ("event", "done", "cancel", "stop", "future", "fut")
+#: identifier fragments that make a non-Call ``with`` expression count as
+#: a lock acquisition
+_LOCKISH_FRAGMENTS = ("lock", "_cv", "cond", "mutex", "sem", "gate")
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: list = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_mutable_init(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        name = _dotted(node.func).split(".")[-1]
+        return name in _MUTABLE_CTORS
+    return False
+
+
+def _ann_names(node) -> list:
+    """All identifiers inside an annotation node (handles string
+    annotations like 'TableStore' and Optional[X] nesting)."""
+    out: list = []
+    if node is None:
+        return out
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return re.findall(r"[A-Za-z_]\w*", node.value)
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            out.append(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            out.append(sub.attr)
+        elif isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            out.extend(re.findall(r"[A-Za-z_]\w*", sub.value))
+    return out
+
+
+class ClassInfo:
+    def __init__(self, name: str, module: str) -> None:
+        self.name = name
+        self.module = module  # repo-relative path
+        self.guarded: dict = {}        # field -> lock attr (canonical)
+        self.locks: dict = {}          # lock attr -> kind
+        self.aliases: dict = {}        # condition attr -> wrapped lock attr
+        self.mutable_fields: set = set()
+        self.attr_type_raw: dict = {}  # attr -> candidate class-name str
+        self.attr_types: dict = {}     # attr -> ClassInfo (resolved)
+        self.methods: dict = {}        # name -> FuncRecord
+
+    def canon_lock(self, attr: str) -> str:
+        seen = set()
+        while attr in self.aliases and attr not in seen:
+            seen.add(attr)
+            attr = self.aliases[attr]
+        return attr
+
+    def lock_id(self, attr: str) -> str:
+        return f"{self.name}.{self.canon_lock(attr)}"
+
+    def lock_kind(self, attr: str) -> str:
+        return self.locks.get(self.canon_lock(attr), "unknown")
+
+
+class FuncRecord:
+    def __init__(self, qualname: str, cls, module: str) -> None:
+        self.qualname = qualname
+        self.cls = cls  # ClassInfo or None
+        self.module = module
+        #: lock ids this function acquires directly via ``with``
+        self.acquires: set = set()
+        #: calls made: (held_lock_id_or_None, func_dotted, lineno)
+        self.calls: list = []
+        #: transitively acquired lock ids (fixpoint-filled)
+        self.closure: set = set()
+
+
+class Analysis:
+    def __init__(self) -> None:
+        self.classes: dict = {}        # name -> ClassInfo
+        self.module_locks: dict = {}   # (module, name) -> kind
+        self.module_types: dict = {}   # (module, name) -> class name str
+        self.module_funcs: dict = {}   # (module, name) -> FuncRecord
+        self.findings: list = []
+        #: (src_id, dst_id) -> (path, line, qualname) first site
+        self.edges: dict = {}
+        #: lock id -> kind
+        self.lock_kinds: dict = {}
+
+
+# ---------------------------------------------------------------------------
+# pass 1: class / lock / guarded-field indexing
+# ---------------------------------------------------------------------------
+
+
+def _index_module(tree: ast.Module, relpath: str, lines: list,
+                  an: Analysis) -> None:
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            _index_class(node, relpath, lines, an)
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 and (
+            isinstance(node.targets[0], ast.Name)
+        ):
+            name = node.targets[0].id
+            kind = _lock_call_kind(node.value)
+            if kind is not None:
+                an.module_locks[(relpath, name)] = kind
+                an.lock_kinds[f"{relpath}:{name}"] = kind
+            elif isinstance(node.value, ast.Call):
+                cname = _dotted(node.value.func).split(".")[-1]
+                if cname and cname[0].isupper():
+                    an.module_types[(relpath, name)] = cname
+
+
+def _lock_call_kind(value: ast.AST):
+    """'lock'/'rlock'/'condition' when ``value`` constructs one (directly
+    or via dataclasses.field(default_factory=threading.Lock))."""
+    if not isinstance(value, ast.Call):
+        return None
+    tail = _dotted(value.func).split(".")[-1]
+    if tail in _LOCK_FACTORIES:
+        return _LOCK_FACTORIES[tail]
+    if tail == "field":
+        for kw in value.keywords:
+            if kw.arg == "default_factory":
+                t2 = _dotted(kw.value).split(".")[-1]
+                if t2 in _LOCK_FACTORIES:
+                    return _LOCK_FACTORIES[t2]
+    return None
+
+
+def _index_class(cnode: ast.ClassDef, relpath: str, lines: list,
+                 an: Analysis) -> None:
+    ci = an.classes.setdefault(cnode.name, ClassInfo(cnode.name, relpath))
+
+    def guarded_comment(lineno: int):
+        if 1 <= lineno <= len(lines):
+            m = GUARDED_RE.search(lines[lineno - 1])
+            if m:
+                return m.group(1)
+        return None
+
+    def note_self_assign(target: ast.AST, value, lineno: int,
+                         annotation=None, in_init: bool = False,
+                         func_args=None) -> None:
+        if not (isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"):
+            return
+        attr = target.attr
+        kind = _lock_call_kind(value) if value is not None else None
+        if kind is not None:
+            ci.locks[attr] = kind
+            if kind == "condition" and isinstance(value, ast.Call) and (
+                value.args
+            ):
+                wrapped = value.args[0]
+                if isinstance(wrapped, ast.Attribute) and isinstance(
+                    wrapped.value, ast.Name
+                ) and wrapped.value.id == "self":
+                    ci.aliases[attr] = wrapped.attr
+            return
+        g = guarded_comment(lineno)
+        if g is not None:
+            ci.guarded[attr] = g
+            if value is not None and _is_mutable_init(value):
+                ci.mutable_fields.add(attr)
+        # attr type: self.X = ClassName(...) / annotated / self.X = param
+        cand = None
+        if isinstance(value, ast.Call):
+            n = _dotted(value.func).split(".")[-1]
+            if n and n[0].isupper():
+                cand = n
+        elif isinstance(value, ast.Name) and func_args is not None:
+            ann = func_args.get(value.id)
+            for n in _ann_names(ann):
+                if n and n[0].isupper():
+                    cand = n
+                    break
+        if cand is None and annotation is not None:
+            for n in _ann_names(annotation):
+                if n and n[0].isupper() and n not in (
+                    "Optional", "None", "Dict", "List", "Set", "Tuple",
+                    "Callable", "Any",
+                ):
+                    cand = n
+                    break
+        if cand is not None:
+            ci.attr_type_raw.setdefault(attr, cand)
+
+    # class-level statements
+    for stmt in cnode.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and (
+            isinstance(stmt.targets[0], ast.Name)
+        ):
+            name = stmt.targets[0].id
+            if name == "_GUARDED_BY" and isinstance(stmt.value, ast.Dict):
+                for k, v in zip(stmt.value.keys, stmt.value.values):
+                    if isinstance(k, ast.Constant) and isinstance(
+                        v, ast.Constant
+                    ):
+                        ci.guarded[str(k.value)] = str(v.value)
+                continue
+            kind = _lock_call_kind(stmt.value)
+            if kind is not None:
+                ci.locks[name] = kind
+                continue
+            g = guarded_comment(stmt.lineno)
+            if g is not None:
+                ci.guarded[name] = g
+                if _is_mutable_init(stmt.value):
+                    ci.mutable_fields.add(name)
+        elif isinstance(stmt, ast.AnnAssign) and isinstance(
+            stmt.target, ast.Name
+        ):
+            # dataclass field
+            name = stmt.target.id
+            kind = _lock_call_kind(stmt.value) if stmt.value else None
+            if kind is None and any(
+                n in _LOCK_FACTORIES for n in _ann_names(stmt.annotation)
+            ):
+                for n in _ann_names(stmt.annotation):
+                    if n in _LOCK_FACTORIES:
+                        kind = _LOCK_FACTORIES[n]
+                        break
+            if kind is not None:
+                ci.locks[name] = kind
+                continue
+            g = guarded_comment(stmt.lineno)
+            if g is not None:
+                ci.guarded[name] = g
+                if stmt.value is not None and (
+                    _is_mutable_init(stmt.value)
+                    or (_lock_call_kind(stmt.value) is None
+                        and isinstance(stmt.value, ast.Call)
+                        and _dotted(stmt.value.func).split(".")[-1]
+                        == "field")
+                ):
+                    # field(default_factory=dict/list/set)
+                    if isinstance(stmt.value, ast.Call):
+                        for kw in stmt.value.keywords:
+                            if kw.arg == "default_factory" and _dotted(
+                                kw.value
+                            ).split(".")[-1] in _MUTABLE_CTORS:
+                                ci.mutable_fields.add(name)
+                    else:
+                        ci.mutable_fields.add(name)
+            for n in _ann_names(stmt.annotation):
+                if n and n[0].isupper() and n not in (
+                    "Optional", "Callable", "Any",
+                ):
+                    ci.attr_type_raw.setdefault(name, n)
+                    break
+
+    # method bodies: lock creation, guarded comments, attr types
+    for stmt in cnode.body:
+        if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        in_init = stmt.name in _INIT_METHODS
+        func_args = {
+            a.arg: a.annotation
+            for a in (list(stmt.args.posonlyargs) + list(stmt.args.args)
+                      + list(stmt.args.kwonlyargs))
+        }
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Assign):
+                for t in sub.targets:
+                    note_self_assign(t, sub.value, sub.lineno,
+                                     in_init=in_init, func_args=func_args)
+            elif isinstance(sub, ast.AnnAssign):
+                note_self_assign(sub.target, sub.value, sub.lineno,
+                                 annotation=sub.annotation,
+                                 in_init=in_init, func_args=func_args)
+
+
+# ---------------------------------------------------------------------------
+# pass 2: rules + graph
+# ---------------------------------------------------------------------------
+
+
+class _Held:
+    __slots__ = ("ident", "kind", "text")
+
+    def __init__(self, ident: str, kind: str, text: str) -> None:
+        self.ident = ident   # canonical lock id, or "" for unresolved
+        self.kind = kind
+        self.text = text     # the with-expression's dotted/source text
+
+
+class _ModuleChecker:
+    def __init__(self, relpath: str, an: Analysis, findings: list) -> None:
+        self.relpath = relpath
+        self.an = an
+        self.findings = findings
+        self.cls: "ClassInfo | None" = None
+        self.func_stack: list = []       # function name parts
+        self.func_rec: "FuncRecord | None" = None
+        self.held: list = []             # _Held, innermost last
+
+    # -- helpers ------------------------------------------------------------
+    def _qual(self) -> str:
+        return ".".join(
+            ([self.cls.name] if self.cls else []) + self.func_stack
+        ) or "<module>"
+
+    def _emit(self, node, rule: str, message: str) -> None:
+        self.findings.append(Finding(
+            self.relpath, getattr(node, "lineno", 0), rule, self._qual(),
+            message,
+        ))
+
+    def _in_exempt_func(self) -> bool:
+        return any(
+            f in _INIT_METHODS or f.endswith("_locked")
+            for f in self.func_stack
+        )
+
+    def _caller_holds_by_convention(self) -> bool:
+        return any(f.endswith("_locked") for f in self.func_stack)
+
+    def _held_ids(self) -> set:
+        ids = {h.ident for h in self.held if h.ident}
+        if self._caller_holds_by_convention() and self.cls is not None:
+            # a *_locked method runs with its class's lock held; with
+            # exactly one lock on the class the identity is unambiguous
+            canon = {self.cls.canon_lock(a) for a in self.cls.locks}
+            if len(canon) == 1:
+                ids.add(f"{self.cls.name}.{next(iter(canon))}")
+        return ids
+
+    def _resolve_lock_expr(self, expr: ast.AST) -> "_Held | None":
+        """Lock identity/kind of a with-context expression (None = not
+        lock-like)."""
+        if isinstance(expr, ast.Call):
+            return None
+        text = _dotted(expr)
+        if isinstance(expr, ast.Subscript):
+            base = _dotted(expr.value)
+            key = ""
+            if isinstance(expr.slice, ast.Constant):
+                key = str(expr.slice.value)
+            text = f"{base}[{key}]"
+        if not text:
+            return None
+        lowered = text.lower()
+        parts = text.split(".")
+        ident, kind = "", "unknown"
+        cls = self.cls
+        if parts[0] == "self" and cls is not None and len(parts) == 2:
+            attr = parts[1]
+            if attr in cls.locks or attr in cls.aliases:
+                ident = cls.lock_id(attr)
+                kind = cls.lock_kind(attr)
+        elif parts[0] == "self" and cls is not None and len(parts) == 3:
+            # with self.<attr>.<lockattr>: resolve <attr>'s class
+            target = cls.attr_types.get(parts[1])
+            if target is not None and (
+                parts[2] in target.locks or parts[2] in target.aliases
+            ):
+                ident = target.lock_id(parts[2])
+                kind = target.lock_kind(parts[2])
+        elif len(parts) == 1:
+            key = (self.relpath, parts[0])
+            if key in self.an.module_locks:
+                ident = f"{self.relpath}:{parts[0]}"
+                kind = self.an.module_locks[key]
+        elif len(parts) >= 2:
+            # Class.lockattr (possibly module-prefixed: _w.Worker._lock)
+            target = self.an.classes.get(parts[-2])
+            if target is not None and (
+                parts[-1] in target.locks or parts[-1] in target.aliases
+            ):
+                ident = target.lock_id(parts[-1])
+                kind = target.lock_kind(parts[-1])
+        if not ident and not any(
+            frag in lowered for frag in _LOCKISH_FRAGMENTS
+        ):
+            return None
+        if ident:
+            self.an.lock_kinds.setdefault(ident, kind)
+        return _Held(ident, kind, text)
+
+    def _attr_class(self, name: str) -> "ClassInfo | None":
+        cls = self.cls
+        if cls is not None:
+            t = cls.attr_types.get(name)
+            if t is not None:
+                return t
+        cname = self.an.module_types.get((self.relpath, name))
+        if cname is not None:
+            return self.an.classes.get(cname)
+        return None
+
+    # -- module entry -------------------------------------------------------
+    def run(self, tree: ast.Module) -> None:
+        self._stmts(tree.body)
+
+    def _stmts(self, stmts) -> None:
+        for s in stmts:
+            self._stmt(s)
+
+    def _stmt(self, node) -> None:
+        if isinstance(node, ast.ClassDef):
+            prev_cls, prev_stack = self.cls, self.func_stack
+            self.cls = self.an.classes.get(node.name, None)
+            self.func_stack = []
+            self._stmts(node.body)
+            self.cls, self.func_stack = prev_cls, prev_stack
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            prev_rec, prev_held = self.func_rec, self.held
+            self.func_stack.append(node.name)
+            # nested defs execute later: lock state does not carry in
+            self.held = []
+            qual = self._qual()
+            rec = FuncRecord(qual, self.cls, self.relpath)
+            self.func_rec = rec
+            if self.cls is not None and len(self.func_stack) == 1:
+                self.cls.methods[node.name] = rec
+            elif self.cls is None and len(self.func_stack) == 1:
+                self.an.module_funcs[(self.relpath, node.name)] = rec
+            if node.name.endswith("_locked"):
+                self._check_202(node)
+            self._stmts(node.body)
+            self.func_stack.pop()
+            self.func_rec, self.held = prev_rec, prev_held
+            return
+        if isinstance(node, ast.With) or isinstance(node, ast.AsyncWith):
+            pushed = 0
+            for item in node.items:
+                h = self._resolve_lock_expr(item.context_expr)
+                if h is None:
+                    self._exprs(item.context_expr)
+                    continue
+                self._acquire(h, node)
+                self.held.append(h)
+                pushed += 1
+            self._stmts(node.body)
+            for _ in range(pushed):
+                self.held.pop()
+            return
+        if isinstance(node, (ast.If,)):
+            self._exprs(node.test)
+            self._stmts(node.body)
+            self._stmts(node.orelse)
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            self._exprs(node.iter)
+            self._check_write_target(node.target, node)
+            self._stmts(node.body)
+            self._stmts(node.orelse)
+            return
+        if isinstance(node, ast.While):
+            self._exprs(node.test)
+            self._stmts(node.body)
+            self._stmts(node.orelse)
+            return
+        if isinstance(node, ast.Try):
+            self._stmts(node.body)
+            for h in node.handlers:
+                self._stmts(h.body)
+            self._stmts(node.orelse)
+            self._stmts(node.finalbody)
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                self._check_write_target(t, node)
+            if getattr(node, "value", None) is not None:
+                self._exprs(node.value)
+            return
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                self._check_write_target(t, node)
+            return
+        if isinstance(node, (ast.Return, ast.Expr)):
+            val = node.value
+            if isinstance(node, ast.Expr) and isinstance(val, (ast.Yield,
+                                                               ast.YieldFrom)):
+                val = val.value
+                self._check_204(val, node)
+                if val is not None:
+                    self._exprs(val)
+                return
+            if isinstance(node, ast.Return):
+                self._check_204(val, node)
+            if val is not None:
+                self._exprs(val)
+            return
+        # default: visit expressions of the statement
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._exprs(child)
+            elif isinstance(child, ast.stmt):
+                self._stmt(child)
+
+    # -- expression walking (calls) ----------------------------------------
+    def _exprs(self, expr) -> None:
+        if expr is None:
+            return
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call):
+                self._check_call(sub)
+
+    # -- rule 201 -----------------------------------------------------------
+    def _guard_of(self, attr: str):
+        cls = self.cls
+        if cls is None or attr not in cls.guarded:
+            return None
+        return f"{cls.name}.{cls.canon_lock(cls.guarded[attr])}"
+
+    def _check_write_target(self, target, node) -> None:
+        # self.F = / self.F op= / del self.F / self.F[k] =
+        attr = None
+        if isinstance(target, ast.Attribute) and isinstance(
+            target.value, ast.Name
+        ) and target.value.id == "self":
+            attr = target.attr
+        elif isinstance(target, ast.Subscript):
+            base = target.value
+            if isinstance(base, ast.Attribute) and isinstance(
+                base.value, ast.Name
+            ) and base.value.id == "self":
+                attr = base.attr
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._check_write_target(elt, node)
+            return
+        if attr is None:
+            return
+        need = self._guard_of(attr)
+        if need is None or self._in_exempt_func():
+            return
+        if need not in self._held_ids():
+            self._emit(
+                node, "DFTPU201",
+                f"write to guarded field self.{attr} without holding "
+                f"{need.split('.')[-1]} (declared `guarded-by`); wrap in "
+                f"`with self.{need.split('.')[-1]}:` or move into a "
+                "*_locked helper",
+            )
+
+    # -- rule 202 -----------------------------------------------------------
+    def _check_202(self, fnode) -> None:
+        cls = self.cls
+        if cls is None:
+            return
+        own = {f"{cls.name}.{cls.canon_lock(a)}" for a in cls.locks}
+        for sub in ast.walk(fnode):
+            if isinstance(sub, (ast.With, ast.AsyncWith)):
+                for item in sub.items:
+                    h = self._resolve_lock_expr_in(item.context_expr, cls)
+                    if h is not None and h.ident in own:
+                        self.findings.append(Finding(
+                            self.relpath, sub.lineno, "DFTPU202",
+                            f"{cls.name}.{fnode.name}",
+                            f"*_locked method acquires {h.text} itself: "
+                            "the suffix promises the CALLER holds the "
+                            "lock; acquiring again self-deadlocks a "
+                            "plain Lock",
+                        ))
+
+    def _resolve_lock_expr_in(self, expr, cls):
+        prev, self.cls = self.cls, cls
+        try:
+            return self._resolve_lock_expr(expr)
+        finally:
+            self.cls = prev
+
+    # -- rule 203 / 205 / graph (calls) -------------------------------------
+    def _check_call(self, node: ast.Call) -> None:
+        name = _dotted(node.func)
+        tail = name.split(".")[-1] if name else ""
+        held = self.held[-1] if self.held else None
+        # record for the cross-class graph
+        if self.func_rec is not None and name:
+            self.func_rec.calls.append(
+                (held.ident if held and held.ident else None, name,
+                 node.lineno)
+            )
+        # 201: container mutation through self.F.<mutator>(...)
+        if tail in _MUTATORS and isinstance(node.func, ast.Attribute):
+            recv = node.func.value
+            if isinstance(recv, ast.Attribute) and isinstance(
+                recv.value, ast.Name
+            ) and recv.value.id == "self":
+                need = self._guard_of(recv.attr)
+                if need is not None and not self._in_exempt_func() and (
+                    need not in self._held_ids()
+                ):
+                    self._emit(
+                        node, "DFTPU201",
+                        f"mutation self.{recv.attr}.{tail}() of a guarded "
+                        f"container without holding "
+                        f"{need.split('.')[-1]} (declared `guarded-by`)",
+                    )
+        # 203: *_locked helper call without the lock
+        if tail.endswith("_locked") and not self._in_exempt_func():
+            if not self._held_ids() and not self.held:
+                self._emit(
+                    node, "DFTPU203",
+                    f"call to {name}() with no lock held on this path: "
+                    "the *_locked suffix means the callee expects its "
+                    "lock already held",
+                )
+        # 205: blocking call while holding a lock
+        if self.held:
+            blocking = None
+            if name in _BLOCKING_EXACT:
+                blocking = name
+            elif tail in _BLOCKING_TAIL:
+                blocking = name
+            elif tail == "wait" and "." in name:
+                recv_text = name.rsplit(".", 1)[0]
+                if all(h.text != recv_text for h in self.held) and any(
+                    hint in recv_text.lower()
+                    for hint in _WAIT_RECEIVER_HINTS
+                ):
+                    blocking = name
+            elif tail == "result" and "." in name:
+                recv_text = name.rsplit(".", 1)[0].lower()
+                if any(h in recv_text for h in ("fut", "future")):
+                    blocking = name
+            if blocking is not None:
+                locks = ", ".join(
+                    h.ident or h.text for h in self.held
+                )
+                self._emit(
+                    node, "DFTPU205",
+                    f"blocking call {blocking}() while holding {locks}: "
+                    "every other thread contending that lock stalls "
+                    "behind this RPC/wait/compile; move the slow work "
+                    "outside the critical section",
+                )
+
+    # -- rule 204 -----------------------------------------------------------
+    def _check_204(self, val, node) -> None:
+        if val is None or self.cls is None:
+            return
+        vals = val.elts if isinstance(val, ast.Tuple) else [val]
+        for v in vals:
+            if isinstance(v, ast.Attribute) and isinstance(
+                v.value, ast.Name
+            ) and v.value.id == "self":
+                attr = v.attr
+                if attr in self.cls.guarded and (
+                    attr in self.cls.mutable_fields
+                ):
+                    self._emit(
+                        node, "DFTPU204",
+                        f"returns/yields a direct reference to guarded "
+                        f"mutable container self.{attr}: the reference "
+                        "escapes the lock and callers iterate/mutate it "
+                        "unprotected; hand out a snapshot copy "
+                        f"(e.g. dict(self.{attr}) / list(self.{attr}))",
+                    )
+
+    # -- graph edges --------------------------------------------------------
+    def _acquire(self, h: _Held, node) -> None:
+        if not h.ident:
+            return
+        held_ids = [x for x in self.held if x.ident]
+        if held_ids:
+            src = held_ids[-1].ident
+            if src != h.ident:
+                self.an.edges.setdefault(
+                    (src, h.ident),
+                    (self.relpath, node.lineno, self._qual()),
+                )
+            elif self.an.lock_kinds.get(h.ident) == "lock":
+                self._emit(
+                    node, "DFTPU207",
+                    f"re-acquires non-reentrant {h.text} already held on "
+                    "this path: guaranteed self-deadlock",
+                )
+        if self.func_rec is not None:
+            self.func_rec.acquires.add(h.ident)
+
+
+# ---------------------------------------------------------------------------
+# pass 3: cross-class call closure -> edges, cycles, re-entry
+# ---------------------------------------------------------------------------
+
+
+def _resolve_call(name: str, rec: FuncRecord, an: Analysis):
+    """-> FuncRecord of the callee, or None."""
+    parts = name.split(".")
+    cls = rec.cls
+    if parts[0] == "self" and cls is not None:
+        if len(parts) == 2:
+            return cls.methods.get(parts[1])
+        if len(parts) == 3:
+            target = cls.attr_types.get(parts[1])
+            if target is not None:
+                return target.methods.get(parts[2])
+        return None
+    if len(parts) == 1:
+        hit = an.module_funcs.get((rec.module, parts[0]))
+        if hit is not None:
+            return hit
+        target = an.classes.get(parts[0])
+        if target is not None:  # ClassName(...) -> __init__
+            return target.methods.get("__init__")
+        return None
+    # X.m where X is a module-level instance, or Class.m
+    target = None
+    cname = an.module_types.get((rec.module, parts[-2]))
+    if cname is not None:
+        target = an.classes.get(cname)
+    if target is None:
+        target = an.classes.get(parts[-2])
+    if target is not None:
+        return target.methods.get(parts[-1])
+    return None
+
+
+def _close_graph(an: Analysis, findings: list) -> None:
+    recs: list = []
+    for ci in an.classes.values():
+        recs.extend(ci.methods.values())
+    recs.extend(an.module_funcs.values())
+    for rec in recs:
+        rec.closure = set(rec.acquires)
+    changed = True
+    rounds = 0
+    while changed and rounds < 50:
+        changed = False
+        rounds += 1
+        for rec in recs:
+            for _held, name, _ln in rec.calls:
+                callee = _resolve_call(name, rec, an)
+                if callee is None:
+                    continue
+                add = callee.closure - rec.closure
+                if add:
+                    rec.closure |= add
+                    changed = True
+    # call-derived edges + transitive same-lock re-entry
+    for rec in recs:
+        for held, name, lineno in rec.calls:
+            if held is None:
+                continue
+            callee = _resolve_call(name, rec, an)
+            if callee is None:
+                continue
+            for dst in sorted(callee.closure):
+                if dst == held:
+                    if an.lock_kinds.get(held) == "lock":
+                        findings.append(Finding(
+                            rec.module, lineno, "DFTPU207", rec.qualname,
+                            f"holds {held} while calling {name}(), which "
+                            f"(transitively) re-acquires {held}: "
+                            "guaranteed self-deadlock on a "
+                            "non-reentrant Lock",
+                        ))
+                    continue
+                an.edges.setdefault(
+                    (held, dst), (rec.module, lineno, rec.qualname)
+                )
+
+
+def _find_cycles(an: Analysis, findings: list) -> None:
+    adj: dict = {}
+    for (src, dst) in an.edges:
+        adj.setdefault(src, set()).add(dst)
+    seen_cycles: set = set()
+    for start in sorted(adj):
+        # DFS from each node looking for a path back to it
+        stack = [(start, [start])]
+        visited: set = set()
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(adj.get(node, ())):
+                if nxt == start and len(path) > 1:
+                    cyc = tuple(path)
+                    canon = min(
+                        tuple(cyc[i:] + cyc[:i]) for i in range(len(cyc))
+                    )
+                    if canon in seen_cycles:
+                        continue
+                    seen_cycles.add(canon)
+                    edges = list(zip(path, path[1:] + [start]))
+                    sites = [
+                        f"{a}->{b} ({an.edges[(a, b)][0]}:"
+                        f"{an.edges[(a, b)][1]})"
+                        for a, b in edges if (a, b) in an.edges
+                    ]
+                    first = an.edges[edges[0]]
+                    findings.append(Finding(
+                        first[0], first[1], "DFTPU206", first[2],
+                        "lock-ordering cycle (potential deadlock): "
+                        + "  ".join(sites),
+                    ))
+                elif nxt not in path and nxt not in visited:
+                    visited.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def _package_files() -> list:
+    out: list = []
+    pkg_root = os.path.join(REPO_ROOT, PACKAGE)
+    for dirpath, _dirs, files in os.walk(pkg_root):
+        for f in sorted(files):
+            if f.endswith(".py"):
+                out.append(os.path.join(dirpath, f))
+    return sorted(out)
+
+
+def analyze(files: list) -> tuple:
+    """-> (findings, Analysis). Pure function, importable by the runtime
+    lock checker (runtime/lockcheck.py loads the static graph this way)."""
+    an = Analysis()
+    parsed: list = []
+    findings: list = []
+    for path in files:
+        relpath = os.path.relpath(
+            os.path.abspath(path), REPO_ROOT
+        ).replace(os.sep, "/")
+        with open(path, "r", encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            findings.append(Finding(relpath, e.lineno or 0, "DFTPU200",
+                                    "<module>", f"syntax error: {e.msg}"))
+            continue
+        lines = src.splitlines()
+        _index_module(tree, relpath, lines, an)
+        parsed.append((tree, relpath))
+    # resolve attr candidate types now every class is indexed
+    for ci in an.classes.values():
+        for attr, cand in ci.attr_type_raw.items():
+            hit = an.classes.get(cand)
+            if hit is not None:
+                ci.attr_types[attr] = hit
+    for tree, relpath in parsed:
+        _ModuleChecker(relpath, an, findings).run(tree)
+    _close_graph(an, findings)
+    _find_cycles(an, findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, an
+
+
+def build_lock_graph(files=None) -> dict:
+    """Static nested-acquisition graph as {(src, dst): (path, line,
+    qualname)} — the contract runtime/lockcheck.py asserts observed
+    acquisition order against."""
+    _findings, an = analyze(files or _package_files())
+    return dict(an.edges)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("files", nargs="*",
+                    help="files to lint (default: the whole package)")
+    ap.add_argument("--allowlist", default=DEFAULT_ALLOWLIST)
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings + lock graph as JSON")
+    args = ap.parse_args(argv)
+
+    files = args.files or _package_files()
+    for f in files:
+        if not os.path.exists(f):
+            print(f"no such file: {f}", file=sys.stderr)
+            return 2
+    findings, an = analyze(files)
+    allow = load_allowlist(args.allowlist)
+    violations, allowed, stale = apply_allowlist(
+        findings, allow, check_stale=not args.files
+    )
+
+    if args.json:
+        # stdout is the JSON document, nothing else; verdict = exit code
+        print(json.dumps({
+            "violations": [f.__dict__ for f in violations],
+            "allowed": [f.__dict__ for f in allowed],
+            "stale_allowlist": [list(k) for k in stale],
+            "lock_graph": {
+                "nodes": sorted({n for e in an.edges for n in e}),
+                "edges": [
+                    {"src": s, "dst": d, "path": p, "line": ln,
+                     "qualname": q}
+                    for (s, d), (p, ln, q) in sorted(an.edges.items())
+                ],
+            },
+            "guarded_classes": {
+                ci.name: dict(sorted(ci.guarded.items()))
+                for ci in sorted(an.classes.values(),
+                                 key=lambda c: c.name)
+                if ci.guarded
+            },
+        }, indent=2))
+        return 1 if (violations or stale) else 0
+    return report_text(violations, allowed, stale, args.allowlist,
+                       REPO_ROOT, "concurrency-safety", len(files))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

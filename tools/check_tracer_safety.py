@@ -50,8 +50,11 @@ they call.
 
 Intentional exceptions live in tools/tracer_safety_allowlist.txt as
 ``path::RULE::qualname  # one-line justification``; the gate fails on any
-finding not covered there and reports stale allowlist entries. Exit code
-0 = clean, 1 = violations, 2 = usage error.
+finding not covered there AND on any stale allowlist entry (an entry
+matching no finding is dead weight that can mask a future regression
+under the same key — tools/lint_common.py, shared with the concurrency
+gate). Exit code 0 = clean, 1 = violations/stale entries, 2 = usage
+error.
 
 Usage:
   python tools/check_tracer_safety.py                # lint the package
@@ -67,7 +70,15 @@ import ast
 import json
 import os
 import sys
-from dataclasses import dataclass
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from lint_common import (  # noqa: E402
+    Finding,
+    apply_allowlist,
+    load_allowlist,
+    report_text,
+)
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PACKAGE = "datafusion_distributed_tpu"
@@ -115,23 +126,6 @@ STATIC_ATTRS = {
     "shape", "ndim", "size", "capacity", "num_slots", "out_capacity",
     "fetch", "skip", "value", "task_index", "task_count", "node_id",
 }
-
-
-@dataclass(frozen=True)
-class Finding:
-    path: str  # repo-relative
-    line: int
-    rule: str
-    qualname: str
-    message: str
-
-    @property
-    def key(self) -> tuple:
-        return (self.path, self.rule, self.qualname)
-
-    def render(self) -> str:
-        return (f"{self.path}:{self.line}: {self.rule} [{self.qualname}] "
-                f"{self.message}")
 
 
 # ---------------------------------------------------------------------------
@@ -495,43 +489,6 @@ def _lint_file(path: str, findings: list) -> None:
                 ))
 
 
-# ---------------------------------------------------------------------------
-# allowlist
-# ---------------------------------------------------------------------------
-
-
-def load_allowlist(path: str) -> dict:
-    """-> {(path, rule, qualname): justification}."""
-    out: dict = {}
-    if not os.path.exists(path):
-        return out
-    with open(path, "r", encoding="utf-8") as f:
-        for lineno, raw in enumerate(f, start=1):
-            line = raw.split("#", 1)[0].strip()
-            justification = (
-                raw.split("#", 1)[1].strip() if "#" in raw else ""
-            )
-            if not line:
-                continue
-            parts = line.split("::")
-            if len(parts) != 3:
-                print(
-                    f"{path}:{lineno}: malformed allowlist entry {raw!r} "
-                    "(expected path::RULE::qualname  # justification)",
-                    file=sys.stderr,
-                )
-                raise SystemExit(2)
-            if not justification:
-                print(
-                    f"{path}:{lineno}: allowlist entry without a "
-                    "justification comment",
-                    file=sys.stderr,
-                )
-                raise SystemExit(2)
-            out[tuple(p.strip() for p in parts)] = justification
-    return out
-
-
 def _package_files() -> list:
     out: list = []
     pkg_root = os.path.join(REPO_ROOT, PACKAGE)
@@ -561,10 +518,9 @@ def main(argv=None) -> int:
         _lint_file(os.path.abspath(f), findings)
 
     allow = load_allowlist(args.allowlist)
-    violations = [f for f in findings if f.key not in allow]
-    allowed = [f for f in findings if f.key in allow]
-    used_keys = {f.key for f in allowed}
-    stale = [k for k in allow if k not in used_keys] if not args.files else []
+    violations, allowed, stale = apply_allowlist(
+        findings, allow, check_stale=not args.files
+    )
 
     if args.json:
         # stdout is the JSON document, nothing else — machine consumers
@@ -574,21 +530,9 @@ def main(argv=None) -> int:
             "allowed": [f.__dict__ for f in allowed],
             "stale_allowlist": [list(k) for k in stale],
         }, indent=2))
-        return 1 if violations else 0
-    for f in violations:
-        print(f.render())
-    if allowed:
-        print(f"({len(allowed)} allowlisted finding(s) suppressed; "
-              f"see {os.path.relpath(args.allowlist, REPO_ROOT)})")
-    for k in stale:
-        print(f"stale allowlist entry (no longer matches): "
-              f"{'::'.join(k)}")
-    if violations:
-        print(f"LINT FAILED: {len(violations)} tracer-safety violation(s)")
-        return 1
-    print(f"tracer-safety lint clean "
-          f"({len(files)} file(s), {len(allowed)} allowlisted)")
-    return 0
+        return 1 if (violations or stale) else 0
+    return report_text(violations, allowed, stale, args.allowlist,
+                       REPO_ROOT, "tracer-safety", len(files))
 
 
 if __name__ == "__main__":

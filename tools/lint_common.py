@@ -1,0 +1,109 @@
+"""Shared helpers for the repo's AST lint gates (tracer safety +
+concurrency safety).
+
+Both tools share one allowlist format::
+
+    path::RULE::qualname  # one-line justification (required)
+
+An entry suppresses every finding of that rule in that function. Entries
+are LIVE state, not history: an entry whose ``path::RULE::qualname`` no
+longer matches any finding is dead weight that can silently mask a future
+regression under the same key, so both gates treat stale entries as
+ERRORS (exit 1), not warnings.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str  # repo-relative
+    line: int
+    rule: str
+    qualname: str
+    message: str
+
+    @property
+    def key(self) -> tuple:
+        return (self.path, self.rule, self.qualname)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: {self.rule} [{self.qualname}] "
+                f"{self.message}")
+
+
+def load_allowlist(path: str) -> dict:
+    """-> {(path, rule, qualname): justification}. Exits 2 on a malformed
+    entry or a missing justification — an unexplained suppression is a
+    usage error, not a policy decision."""
+    out: dict = {}
+    if not os.path.exists(path):
+        return out
+    with open(path, "r", encoding="utf-8") as f:
+        for lineno, raw in enumerate(f, start=1):
+            line = raw.split("#", 1)[0].strip()
+            justification = (
+                raw.split("#", 1)[1].strip() if "#" in raw else ""
+            )
+            if not line:
+                continue
+            parts = line.split("::")
+            if len(parts) != 3:
+                print(
+                    f"{path}:{lineno}: malformed allowlist entry {raw!r} "
+                    "(expected path::RULE::qualname  # justification)",
+                    file=sys.stderr,
+                )
+                raise SystemExit(2)
+            if not justification:
+                print(
+                    f"{path}:{lineno}: allowlist entry without a "
+                    "justification comment",
+                    file=sys.stderr,
+                )
+                raise SystemExit(2)
+            out[tuple(p.strip() for p in parts)] = justification
+    return out
+
+
+def apply_allowlist(findings: list, allow: dict,
+                    check_stale: bool = True) -> tuple:
+    """Split ``findings`` against ``allow``;
+    -> (violations, allowed, stale_keys). ``check_stale=False`` (partial
+    runs over explicit files) skips staleness — an entry for an unlinted
+    file is not stale, merely out of scope."""
+    violations = [f for f in findings if f.key not in allow]
+    allowed = [f for f in findings if f.key in allow]
+    used = {f.key for f in allowed}
+    stale = [k for k in allow if k not in used] if check_stale else []
+    return violations, allowed, stale
+
+
+def report_text(violations: list, allowed: list, stale: list,
+                allowlist_path: str, repo_root: str, label: str,
+                n_files: int) -> int:
+    """Print the human report shared by both gates; -> exit code."""
+    for f in violations:
+        print(f.render())
+    if allowed:
+        print(f"({len(allowed)} allowlisted finding(s) suppressed; "
+              f"see {os.path.relpath(allowlist_path, repo_root)})")
+    for k in stale:
+        print(f"stale allowlist entry (matches no finding — remove it): "
+              f"{'::'.join(k)}")
+    if violations or stale:
+        why = []
+        if violations:
+            why.append(f"{len(violations)} {label} violation(s)")
+        if stale:
+            why.append(f"{len(stale)} stale allowlist entr"
+                       + ("y" if len(stale) == 1 else "ies"))
+        print("LINT FAILED: " + ", ".join(why))
+        return 1
+    print(f"{label} lint clean "
+          f"({n_files} file(s), {len(allowed)} allowlisted)")
+    return 0
