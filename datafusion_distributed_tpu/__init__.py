@@ -21,6 +21,16 @@ import os as _os
 
 import jax as _jax
 
+# Runtime lock-order / race harness (runtime/lockcheck.py): installed
+# FIRST under DFTPU_LOCK_CHECK=1, before any submodule import, so every
+# lock the package creates — module-level, class-level and per-instance —
+# is wrapped. Observed acquisition order is asserted against the static
+# graph (tools/check_concurrency.py); see README "Concurrency model".
+if _os.environ.get("DFTPU_LOCK_CHECK", "0") not in ("", "0"):
+    from datafusion_distributed_tpu.runtime import lockcheck as _lockcheck
+
+    _lockcheck.install()
+
 # Precision policy: 32-bit TPU-native compute by default; DFTPU_PRECISION=x64
 # restores exact f64/i64 (see precision.py for the full rationale).
 from datafusion_distributed_tpu import precision  # noqa: F401

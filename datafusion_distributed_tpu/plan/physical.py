@@ -639,6 +639,15 @@ def execute_plan(
         prepare_plan,
     )
 
+    # lock-while-compiling witness (runtime/lockcheck.py, opt-in via
+    # DFTPU_LOCK_CHECK=1): entering the XLA trace/compile/execute entry
+    # point with an engine lock held stalls every contender for seconds —
+    # the harness records it; no-op (one module-attr read) when off
+    from datafusion_distributed_tpu.runtime import lockcheck as _lockcheck
+
+    if _lockcheck.enabled():
+        _lockcheck.note_blocking("xla_compile")
+
     task = task or DistributedTaskContext()
     # content-address the program: literal-hoisted plan + structural
     # fingerprint (None -> legacy object-identity keying). The hoisted

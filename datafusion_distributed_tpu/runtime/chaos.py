@@ -173,17 +173,17 @@ class FaultPlan:
         #: both modes: they bound total injected damage, not per-query
         #: schedules.
         self.query_scoped = bool(query_scoped)
-        self.fired: list[dict] = []
+        self.fired: list[dict] = []  # guarded-by: _lock
         self._lock = threading.Lock()
         #: (spec_idx, query_scope, site, stage, task) -> call count (the
         #: nth-call input of the hash, so repeated attempts of one task
         #: re-roll; query_scope is "" unless query_scoped)
-        self._calls: dict[tuple, int] = {}
-        self._per_stage: dict[tuple, int] = {}
-        self._totals: dict[int, int] = {}
+        self._calls: dict[tuple, int] = {}  # guarded-by: _lock
+        self._per_stage: dict[tuple, int] = {}  # guarded-by: _lock
+        self._totals: dict[int, int] = {}  # guarded-by: _lock
         #: event idx -> matching-call count / fired flag
-        self._member_calls: dict[int, int] = {}
-        self._member_fired: set = set()
+        self._member_calls: dict[int, int] = {}  # guarded-by: _lock
+        self._member_fired: set = set()  # guarded-by: _lock
 
     def membership_due(self, site: str, url: str, key) -> list:
         """Membership events whose trigger this call just satisfied (each
@@ -446,23 +446,31 @@ class ChaosCluster:
 
     inner: "object"
     plan: FaultPlan
-    _proxies: dict = field(default_factory=dict)
+    _proxies: dict = field(default_factory=dict)  # guarded-by: _proxy_lock
+    # DFTPU201 fix: stage fan-out threads resolve workers concurrently
+    # with chaos membership events popping proxies from worker-call
+    # threads; the bare check-then-insert could mint two proxies for one
+    # url (splitting the fault plan's nth-call view of that worker) or
+    # resurrect a departed worker's proxy mid-pop
+    _proxy_lock: threading.Lock = field(default_factory=threading.Lock)
 
     def get_urls(self) -> list[str]:
         return self.inner.get_urls()
 
     def get_worker(self, url: str) -> ChaosWorker:
-        if url not in self._proxies:
-            self._proxies[url] = ChaosWorker(
-                self.inner.get_worker(url), self.plan, cluster=self
-            )
-        return self._proxies[url]
+        with self._proxy_lock:
+            if url not in self._proxies:
+                self._proxies[url] = ChaosWorker(
+                    self.inner.get_worker(url), self.plan, cluster=self
+                )
+            return self._proxies[url]
 
     # -- membership events ----------------------------------------------------
     def apply_membership(self, ev: MembershipEvent) -> None:
         if ev.action == "leave":
             self.inner.remove_worker(ev.url, release=ev.release)
-            self._proxies.pop(ev.url, None)
+            with self._proxy_lock:
+                self._proxies.pop(ev.url, None)
         elif ev.action == "join":
             self.inner.add_worker(ev.url)
         else:  # drain

@@ -177,13 +177,16 @@ class TableStore:
 
     def __init__(self) -> None:
         self._lock = threading.RLock()
+        # every mutation of `tables` routes through _TableDict, which
+        # takes this store's lock itself — the guarded fields below are
+        # the accounting the _locked helpers keep in sync with it
         self.tables: _TableDict = _TableDict(self)
-        self._meta: dict[str, _EntryMeta] = {}
-        self._by_identity: dict[int, str] = {}
-        self._owned_nbytes = 0
-        self.peak_nbytes = 0
-        self.put_count = 0
-        self.dedup_hits = 0
+        self._meta: dict[str, _EntryMeta] = {}  # guarded-by: _lock
+        self._by_identity: dict[int, str] = {}  # guarded-by: _lock
+        self._owned_nbytes = 0  # guarded-by: _lock
+        self.peak_nbytes = 0  # guarded-by: _lock
+        self.put_count = 0  # guarded-by: _lock
+        self.dedup_hits = 0  # guarded-by: _lock
 
     # -- accounting core (callers hold self._lock) ---------------------------
     def _insert_locked(self, tid: str, table: Table,

@@ -186,10 +186,10 @@ class DynamicCluster(WorkerResolver, ChannelResolver):
     def __init__(self, num_workers: int = 0, ttl_seconds: float = 600.0,
                  worker_factory: Optional[Callable[[str], Worker]] = None):
         self._lock = threading.RLock()
-        self._epoch = 0
-        self._active: dict[str, Worker] = {}
-        self._draining: dict[str, Worker] = {}
-        self._departed: set[str] = set()
+        self._epoch = 0  # guarded-by: _lock
+        self._active: dict[str, Worker] = {}  # guarded-by: _lock
+        self._draining: dict[str, Worker] = {}  # guarded-by: _lock
+        self._departed: set[str] = set()  # guarded-by: _lock
         self._ttl = ttl_seconds
         self._factory = worker_factory or (
             lambda url: Worker(url, ttl_seconds)
@@ -381,6 +381,20 @@ class Coordinator:
     # `SET distributed.tracing` is off — the always-cheap-when-off path)
     trace_store: "object" = None
 
+    #: declarative concurrency model (tools/check_concurrency.py): these
+    #: per-execute caches are shared by sibling-stage fan-out threads and
+    #: every write outside execute's fresh-reset must hold the named
+    #: lock. (`metrics`/`stream_metrics`/`_peer_shipped` are deliberately
+    #: NOT declared: they are keyed per task and rely on GIL-atomic
+    #: single-op dict/list mutation, snapshotted via list() in C —
+    #: see sweep_query.)
+    _GUARDED_BY = {
+        "_span_shipped": "_span_lock",
+        "_span_ok_cache": "_span_lock",
+        "_peer_url_map": "_peer_heal_lock",
+        "_peer_stale": "_peer_heal_lock",
+    }
+
     def _tr(self):
         """The current query's tracer (NULL_TRACER outside execute or with
         tracing off): one unconditional accessor so every instrumentation
@@ -568,14 +582,16 @@ class Coordinator:
         ]:
             self.stream_metrics.pop(key, None)
         spans = getattr(self, "_span_shipped", None)
-        if spans:
-            with self._span_lock:
-                for k in [k for k in spans if k[0] == query_id]:
-                    spans.pop(k, None)
         ok = getattr(self, "_span_ok_cache", None)
-        if ok:
-            for k in [k for k in ok if k[0] == query_id]:
-                ok.pop(k, None)
+        if spans or ok:
+            with self._span_lock:
+                for k in [k for k in (spans or ()) if k[0] == query_id]:
+                    spans.pop(k, None)
+                # DFTPU201 fix: the ok-cache shares the span lock with
+                # the shipment map — sweeping it unlocked raced
+                # _try_dispatch_span's check-then-insert
+                for k in [k for k in (ok or ()) if k[0] == query_id]:
+                    ok.pop(k, None)
 
     def _check_worker_versions(self) -> None:
         from datafusion_distributed_tpu.runtime.errors import WorkerError
@@ -1237,11 +1253,14 @@ class Coordinator:
                 lambda n: isinstance(n, PeerShuffleScanExec)
             )
 
-        lock = getattr(self, "_peer_heal_lock", None)
-        if lock is None:
-            lock = self._peer_heal_lock = threading.Lock()
+        if getattr(self, "_peer_heal_lock", None) is None:
+            # direct-call safety (tests invoke the heal without execute)
+            self._peer_heal_lock = threading.Lock()
         healed = 0
-        with lock:
+        # acquired by its field name, not a local alias: the concurrency
+        # lint resolves `with self._peer_heal_lock` as holding the lock
+        # that guards _peer_url_map/_peer_stale (DFTPU201)
+        with self._peer_heal_lock:
             # url_map/stale accumulate ACROSS heal passes for the query
             # (direct-call safety: tests invoke the heal without execute)
             url_map = getattr(self, "_peer_url_map", None)
@@ -2260,25 +2279,32 @@ class Coordinator:
             span_specialized,
         )
 
-        span_ok = getattr(self, "_span_ok_cache", None)
-        if span_ok is None:
-            span_ok = self._span_ok_cache = {}
+        if not hasattr(self, "_span_lock"):
+            # direct-call safety (tests invoke without execute): bare
+            # writes here happen-before any sibling-stage thread shares
+            # this coordinator (allowlisted DFTPU201, like execute's
+            # fresh per-query resets)
+            import threading as _threading
+
+            self._span_lock = _threading.Lock()
+            self._span_shipped = {}
+            self._span_ok_cache = {}
         # keyed by (query, stage): per-task prepared plans are transient
         # objects (id() recycles within a query) but share one structure
         ok_key = (query_id, stage_id)
-        ok = span_ok.get(ok_key)
-        if ok is None:
-            ok = span_ok[ok_key] = span_specializable(stage_plan)
+        with self._span_lock:
+            # DFTPU201 fix: sibling-stage threads share this cache —
+            # the check-then-insert ran unlocked before this lint
+            ok = self._span_ok_cache.get(ok_key)
+            if ok is None:
+                ok = self._span_ok_cache[ok_key] = span_specializable(
+                    stage_plan
+                )
         if not ok:
             return None
         span = task_number // span_w
         key = TaskKey(query_id, stage_id, task_number)
         lo, hi = span * span_w, min((span + 1) * span_w, task_count)
-        if not hasattr(self, "_span_shipped"):  # direct-call safety
-            import threading as _threading
-
-            self._span_shipped = {}
-            self._span_lock = _threading.Lock()
         ship_key = (query_id, stage_id, lo)
         with self._span_lock:
             hit = self._span_shipped.get(ship_key)
@@ -2369,6 +2395,12 @@ class AdaptiveCoordinator(Coordinator):
     sizing, available to e.g. pre-compile or pre-provision the consumer)
     rather than wall-clock overlap; stages whose producers finish before
     the threshold fall back to exact statistics."""
+
+    #: declarative concurrency model: the co-shuffled-group barrier state
+    #: mutates from sibling stage-DAG threads (see _finish_shuffle); the
+    #: read-only group topology maps (_group_of/_group_members/
+    #: _group_heads) are written once in execute before any fan-out
+    _GUARDED_BY = {"_group_pending": "_group_lock"}
 
     #: compute_based_task_count divisor (prepare_dynamic_plan.rs:60-69 uses
     #: cpu_cost / bytes_per_partition_per_second; here exact bytes / this)

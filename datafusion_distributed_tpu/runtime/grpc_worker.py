@@ -583,7 +583,7 @@ class GrpcPeerResolver:
     def __init__(self) -> None:
         import threading
 
-        self._clients: dict[str, GrpcWorkerClient] = {}
+        self._clients: dict[str, GrpcWorkerClient] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def get_worker(self, url: str) -> GrpcWorkerClient:
@@ -606,21 +606,23 @@ class GrpcCluster:
     route to it."""
 
     def __init__(self, num_workers: int, ttl_seconds: float = 600.0):
-        self.servers = []
-        self.urls = []
-        self.local_workers: list[Worker] = []  # test introspection
-        self._clients: dict[str, GrpcWorkerClient] = {}
+        self.servers = []  # guarded-by: _lock
+        self.urls = []  # guarded-by: _lock
+        # test introspection
+        self.local_workers: list[Worker] = []  # guarded-by: _lock
+        self._clients: dict[str, GrpcWorkerClient] = {}  # guarded-by: _lock
         self._peer_resolver = GrpcPeerResolver()
         self._ttl = ttl_seconds
-        self._epoch = 0
-        self._by_url: dict[str, tuple] = {}  # url -> (server, Worker)
+        self._epoch = 0  # guarded-by: _lock
+        # url -> (server, Worker)
+        self._by_url: dict[str, tuple] = {}  # guarded-by: _lock
         # requested label -> bound url: a membership schedule names a
         # joiner by label ("grpc://w-new") but the real endpoint is the
         # bound localhost port; later leave/drain events for the label
         # must resolve to the server they spawned
-        self._aliases: dict[str, str] = {}
-        self._draining: list[str] = []
-        self._departed: set = set()
+        self._aliases: dict[str, str] = {}  # guarded-by: _lock
+        self._draining: list[str] = []  # guarded-by: _lock
+        self._departed: set = set()  # guarded-by: _lock
         # chaos membership events mutate from worker-call threads while
         # coordinator pool threads read urls/epoch — same guarantee as
         # DynamicCluster's RLock (a reader never sees a torn url-set/epoch
