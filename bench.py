@@ -520,6 +520,7 @@ def _serving_bench() -> None:
     clients = int(os.environ.get("BENCH_SERVING_CLIENTS", "8"))
     iters = int(os.environ.get("BENCH_SERVING_ITERS", "2"))
     delay_ms = float(os.environ.get("BENCH_SERVING_DELAY_MS", "80"))
+    straggler_ms = float(os.environ.get("BENCH_STRAGGLER_MS", "800"))
     workers = 4
 
     t0 = time.perf_counter()
@@ -578,8 +579,94 @@ def _serving_bench() -> None:
             "errors": len(res["errors"]),
         }
 
+    # ---- injected-straggler arm (the ROADMAP serving-hardening gate):
+    # ONE seeded sticky-slow worker (chaos kind="straggler") on top of
+    # the uniform delay, all-cheap clients (no q21 — the tail must be
+    # straggler-driven, not heavy-query-driven), hedging off vs on.
+    # Hedging speculatively re-dispatches any attempt outliving
+    # max(sketch-p99, hedge_floor_s) to a healthy worker; the floor sits
+    # above a normal task's injected wall and far below the straggler's,
+    # so exactly the straggler-routed attempts hedge.
+    def run_straggler_arm(hedge: bool) -> dict:
+        from datafusion_distributed_tpu.runtime.serving import (
+            percentile_ms,
+            run_closed_loop,
+        )
+
+        opts = ctx.config.distributed_options
+        prev = {k: opts.get(k) for k in ("hedging", "hedge_floor_s",
+                                         "hedge_budget")}
+        opts["hedging"] = hedge
+        opts["hedge_floor_s"] = max(1.5 * delay_ms, 50.0) / 1e3
+        opts["hedge_budget"] = workers
+        try:
+            specs = [FaultSpec(site="execute", kind="straggler",
+                               delay_s=straggler_ms / 1e3,
+                               workers=["worker-0"], rate=1.0)]
+            if delay_ms > 0:
+                specs.append(FaultSpec(site="execute", kind="delay",
+                                       delay_s=delay_ms / 1e3, rate=1.0))
+            srv = ServingSession(
+                ctx,
+                cluster=wrap_cluster(
+                    InMemoryCluster(workers),
+                    FaultPlan(1, specs, query_scoped=True),
+                ),
+                num_tasks=workers, max_concurrent_queries=clients,
+                fair_share=True,
+            )
+            res = run_closed_loop(
+                srv,
+                [[(_SERVING_Q1 if (ci + i) % 2 else _SERVING_Q6)
+                  for i in range(iters)] for ci in range(clients)],
+                classify=lambda ci: "all", timeout=1800.0,
+            )
+            srv.close()
+        finally:
+            for k, v in prev.items():
+                if v is None:
+                    opts.pop(k, None)
+                else:
+                    opts[k] = v
+        if res["errors"]:
+            print(f"straggler arm errors: {res['errors']}",
+                  file=sys.stderr, flush=True)
+        walls = res["walls"].get("all", [])
+        return {
+            "p50_ms": percentile_ms(walls, 0.50),
+            "p99_ms": percentile_ms(walls, 0.99),
+            "qps": round(res["queries"] / res["wall_s"], 3),
+            "queries": res["queries"],
+            "errors": len(res["errors"]),
+        }
+
     # warm every compile cache (templates + stage programs) off-clock
     run_arm(clients, True)
+    straggler_off = run_straggler_arm(False)
+    straggler_on = run_straggler_arm(True)
+    print(json.dumps({"serving_straggler_detail": {
+        "off": straggler_off, "on": straggler_on,
+        "straggler_ms": straggler_ms, "delay_ms": delay_ms,
+        "clients": clients,
+    }}), file=sys.stderr, flush=True)
+    print(json.dumps({
+        "metric": "serving_straggler_p99_ms_off",
+        "value": straggler_off["p99_ms"],
+        "unit": "milliseconds",
+    }), flush=True)
+    # hedging on vs off under one seeded sticky straggler: vs_baseline =
+    # off/on (>1 means hedging cut the closed-loop p99; the acceptance
+    # gate asks >= 1.5x)
+    if straggler_on["p99_ms"]:
+        print(json.dumps({
+            "metric": "serving_straggler_p99_ms_on",
+            "value": straggler_on["p99_ms"],
+            "unit": "milliseconds",
+            "vs_baseline": round(
+                (straggler_off["p99_ms"] or 0)
+                / straggler_on["p99_ms"], 4,
+            ),
+        }), flush=True)
     seq = run_arm(1, True)  # serialized: the pre-serving baseline
     fifo = run_arm(clients, False)
     fair = run_arm(clients, True)

@@ -38,13 +38,19 @@ from datafusion_distributed_tpu.runtime.tracing import (  # noqa: E402
 
 class Console:
     def __init__(self, resolver, channels, poll_s: float = 0.5,
-                 out=None, health=None, serving=None):
+                 out=None, health=None, serving=None, faults=None,
+                 checkpoints=None):
         # ``health``: a coordinator's HealthTracker — wiring it in joins
         # circuit-breaker state into the membership rows below.
         # ``serving``: a runtime/serving.py ServingSession — wiring it in
-        # adds the multi-query tier's active/queued/admitted line
+        # adds the multi-query tier's active/queued/admitted line.
+        # ``faults``/``checkpoints``: a coordinator's FaultCounters and a
+        # runtime/checkpoint.py CheckpointStore — wiring either adds the
+        # robustness line (hedge + checkpoint/resume counters)
         self.obs = ObservabilityService(resolver, channels, health=health,
-                                        serving=serving)
+                                        serving=serving,
+                                        fault_counters=faults,
+                                        checkpoints=checkpoints)
         self.poll_s = poll_s
         self.out = out or sys.stdout
         self.tracked_keys: list = []  # TaskKeys to poll progress for
@@ -140,6 +146,29 @@ class Console:
             if p99 is not None:
                 line += f"  {_DIM}p99 {p99 * 1e3:.0f}ms{_RESET}"
             lines.append(line)
+        rb = self.obs.get_robustness()
+        hed = rb.get("hedging", {})
+        ckpt = rb.get("checkpoint", {})
+        ck_counts = {k: v for k, v in ckpt.items() if k != "store"}
+        if any(hed.values()) or any(ck_counts.values()):
+            line = (
+                f"\n{_BOLD}robustness{_RESET}  hedges "
+                f"{hed.get('hedges_issued', 0)} issued "
+                f"({hed.get('hedges_won', 0)} won, "
+                f"{hed.get('hedges_lost', 0)} lost, "
+                f"{hed.get('hedge_budget_denied', 0)} denied), "
+                f"checkpoints {ckpt.get('checkpoint_stages_saved', 0)} "
+                f"saved / {ckpt.get('checkpoint_stages_restored', 0)} "
+                f"restored, {ckpt.get('queries_resumed', 0)} resumed"
+            )
+            st = ckpt.get("store")
+            if isinstance(st, dict) and not st.get("error"):
+                line += (
+                    f"  {_DIM}{st.get('recoverable', 0)} recoverable, "
+                    f"{_fmt_bytes(st.get('staged_bytes', 0))} "
+                    f"staged{_RESET}"
+                )
+            lines.append(line)
         if dp.get("entries") or dp.get("peak_nbytes"):
             lines.append(
                 f"\n{_BOLD}data plane{_RESET}  staged "
@@ -163,7 +192,9 @@ class Console:
             ev = ts.get("events_by_name") or {}
             faults = {k: v for k, v in ev.items()
                       if k in ("task_retry", "task_rerouted", "peer_heal",
-                               "worker_quarantined", "query_cancel")}
+                               "worker_quarantined", "query_cancel",
+                               "hedge_issued", "hedge_won", "hedge_lost",
+                               "checkpoint_saved", "query_resumed")}
             if faults:
                 line += "  " + _DIM + ", ".join(
                     f"{k}={faults[k]}" for k in sorted(faults)

@@ -61,9 +61,16 @@ class FaultSpec:
     """One fault family: where, what, how often, and bounds."""
 
     site: str  # "set_plan" | "execute"
-    kind: str = "crash"  # "crash" | "transport" | "delay" | "corrupt_plan"
+    #: "crash" | "transport" | "delay" | "corrupt_plan" | "straggler".
+    #: "delay" rolls per CALL (uniform injected latency); "straggler" is
+    #: WORKER-PINNED: one seeded decision per (query, url) makes that
+    #: worker sticky-slow for the REST of the query at every matching
+    #: call — the real tail-latency pathology (one slow machine, not a
+    #: uniformly slow cluster) the hedger exists to beat. Caps count
+    #: straggler WORKERS elected, not delayed calls.
+    kind: str = "crash"
     rate: float = 1.0  # per-call probability (seed-hashed, deterministic)
-    delay_s: float = 0.0  # for kind="delay": injected latency
+    delay_s: float = 0.0  # for kind="delay"/"straggler": injected latency
     #: restrict to these worker urls (substring match); None = any worker
     workers: Optional[Sequence[str]] = None
     #: restrict to these stage ids; None = any stage
@@ -181,6 +188,10 @@ class FaultPlan:
         self._calls: dict[tuple, int] = {}  # guarded-by: _lock
         self._per_stage: dict[tuple, int] = {}  # guarded-by: _lock
         self._totals: dict[int, int] = {}  # guarded-by: _lock
+        #: (spec_idx, query_scope, url) -> elected straggler? ONE seeded
+        #: decision per key; True keeps delaying every later matching
+        #: call — the sticky-slow-worker fault (kind="straggler")
+        self._stragglers: dict[tuple, bool] = {}  # guarded-by: _lock
         #: event idx -> matching-call count / fired flag
         self._member_calls: dict[int, int] = {}  # guarded-by: _lock
         self._member_fired: set = set()  # guarded-by: _lock
@@ -233,6 +244,11 @@ class FaultPlan:
             for i, spec in enumerate(self.specs):
                 if not spec._matches(site, url, stage_id, task_number):
                     continue
+                if spec.kind == "straggler":
+                    if self._straggler_locked(i, spec, qscope, url, site,
+                                              stage_id, task_number):
+                        return spec
+                    continue
                 ck = (i, qscope, site, stage_id, task_number)
                 nth = self._calls.get(ck, 0)
                 self._calls[ck] = nth + 1
@@ -258,6 +274,37 @@ class FaultPlan:
                 return spec
         return None
 
+    def _straggler_locked(self, i: int, spec: FaultSpec, qscope: str,
+                          url: str, site: str, stage_id: int,
+                          task_number: int) -> bool:
+        """Sticky straggler election (caller holds `_lock`): decide ONCE
+        per (spec, query-scope, url) whether this worker is slow, then
+        answer every later matching call from that verdict — the rest of
+        the query sees one consistently slow endpoint, not independent
+        per-call coin flips. Caps bound ELECTIONS, not delayed calls."""
+        sk = (i, qscope, url)
+        verdict = self._stragglers.get(sk)
+        if verdict is None:
+            if spec.max_total is not None and (
+                self._totals.get(i, 0) >= spec.max_total
+            ):
+                verdict = False
+            else:
+                h = hashlib.sha256(
+                    f"{self.seed}:{i}:straggler:{qscope}:{url}".encode()
+                ).digest()
+                unit = int.from_bytes(h[:8], "big") / float(1 << 64)
+                verdict = unit < spec.rate
+            self._stragglers[sk] = verdict
+            if verdict:
+                self._totals[i] = self._totals.get(i, 0) + 1
+                self.fired.append({
+                    "site": site, "url": url, "stage_id": stage_id,
+                    "task_number": task_number, "kind": "straggler",
+                    "nth_call": 0,
+                })
+        return verdict
+
     def sweep_query(self, query_id: str) -> int:
         """Release the per-query call-count state for a COMPLETED query
         (meaningful under ``query_scoped``: each in-flight query holds its
@@ -270,7 +317,34 @@ class FaultPlan:
             dead = [ck for ck in self._calls if ck[1] == query_id]
             for ck in dead:
                 del self._calls[ck]
-        return len(dead)
+            sticky = [
+                sk for sk in self._stragglers if sk[1] == query_id
+            ]
+            for sk in sticky:
+                del self._stragglers[sk]
+        return len(dead) + len(sticky)
+
+
+def _interruptible_sleep(delay_s: float, cancel=None,
+                         poll_s: float = 0.005) -> None:
+    """Injected-delay sleep honoring the call's cancel handle: the delay
+    is chopped into ``poll_s`` increments and aborts as soon as
+    ``cancel.is_set()`` — so a hedged/cancelled loser stuck in an
+    injected delay releases its slot at CANCELLATION latency, not after
+    the full delay, and chaos tests measure the real cancel plumbing
+    (the per-query cancel event / a hedge attempt's loser-cancel ride in
+    through the worker surface's ``cancel=`` parameter)."""
+    if delay_s <= 0:
+        return
+    if cancel is None:
+        time.sleep(delay_s)
+        return
+    deadline = time.monotonic() + delay_s
+    while not cancel.is_set():
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return
+        time.sleep(min(poll_s, remaining))
 
 
 def _raise_for(spec: FaultSpec, site: str, url: str, key) -> None:
@@ -379,12 +453,16 @@ class ChaosWorker:
             )
 
     # -- intercepted control plane ------------------------------------------
-    def set_plan(self, key, plan_obj, task_count, **kw):
+    def set_plan(self, key, plan_obj, task_count, cancel=None, **kw):
+        # ``cancel`` is consumed HERE (the injected delay polls it), not
+        # forwarded: the inner worker surface has no dispatch-cancel
+        # parameter — the coordinator only passes it because this proxy
+        # declares it
         self._membership("set_plan", key)
         spec = self._plan.decide("set_plan", self.url, key)
         if spec is not None:
-            if spec.kind == "delay":
-                time.sleep(spec.delay_s)
+            if spec.kind in ("delay", "straggler"):
+                _interruptible_sleep(spec.delay_s, cancel)
             elif spec.kind == "corrupt_plan":
                 # in-transit corruption: a DEEP copy is mutated (the
                 # in-process transport shares the dict object with the
@@ -398,32 +476,37 @@ class ChaosWorker:
         return self._inner.set_plan(key, plan_obj, task_count, **kw)
 
     # -- intercepted data plane ---------------------------------------------
-    def _execute_fault(self, key):
+    def _execute_fault(self, key, cancel=None):
         self._membership("execute", key)
         spec = self._plan.decide("execute", self.url, key)
         if spec is not None:
-            if spec.kind == "delay":
-                time.sleep(spec.delay_s)
+            if spec.kind in ("delay", "straggler"):
+                _interruptible_sleep(spec.delay_s, cancel)
             else:
                 _raise_for(spec, "execute", self.url, key)
 
-    def execute_task(self, key):
+    def execute_task(self, key, cancel=None):
         # deliberately NO timeout= parameter: advertising one would make
         # the coordinator delegate deadline enforcement to the inner
         # worker, which cannot see this proxy's injected delay — the
-        # coordinator's thread deadline must cover the whole (faulty) call
-        self._execute_fault(key)
+        # coordinator's thread deadline must cover the whole (faulty)
+        # call. ``cancel`` IS declared: the coordinator's attempt-cancel
+        # plumbing (per-query event, hedge loser-cancel) reaches the
+        # injected delay's poll loop through it; the inner in-process
+        # worker has no cancel surface, so it is consumed here.
+        self._execute_fault(key, cancel)
         return self._inner.execute_task(key)
 
     def execute_task_stream(self, key, **kw):
         # inject at CALL time, not first-iteration: the coordinator's
         # retry-while-nothing-yielded window must see the fault before
-        # any chunk is out
-        self._execute_fault(key)
+        # any chunk is out. The stream's own cancel event (already part
+        # of the surface) doubles as the delay's interrupt.
+        self._execute_fault(key, kw.get("cancel"))
         return self._inner.execute_task_stream(key, **kw)
 
     def execute_task_partitions(self, key, *a, **kw):
-        self._execute_fault(key)
+        self._execute_fault(key, kw.get("cancel"))
         return self._inner.execute_task_partitions(key, *a, **kw)
 
     # -- transparent delegation ---------------------------------------------

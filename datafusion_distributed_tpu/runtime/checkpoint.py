@@ -1,0 +1,374 @@
+"""Query checkpoint/resume: per-stage output snapshots + recovery.
+
+The serving-hardening half of the ROADMAP item that PR 5's peer-heal
+machinery did not cover: peer healing re-ships *producers* onto
+survivors mid-query, but a COORDINATOR loss today throws away every
+completed stage of every admitted query. This module generalizes that
+idea to whole queries — on stage completion the coordinator snapshots
+the stage's materialized consumer slices into the workers' TableStores
+(data stays on the cluster; the coordinator keeps metadata only), and a
+fresh session/coordinator resumes an admitted query from its last
+completed stage frontier instead of re-running it from scratch.
+
+Records are validated, never trusted:
+
+- each `StageCheckpoint` carries the stage's STRUCTURAL FINGERPRINT
+  (plan/fingerprint.py — literal values included, since the pristine
+  pre-hoist subtree is fingerprinted): on resume the re-planned query's
+  stage must fingerprint identically or the checkpoint is ignored and
+  the stage re-executes (`checkpoint_fp_mismatch`);
+- each staged slice is fetched from the worker recorded as holding it:
+  a departed worker (or an evicted id) invalidates ONLY that stage
+  (`checkpoint_slices_lost`) — the stage re-executes, and its own
+  producers still restore from THEIR checkpoints, so a partially-lost
+  frontier heals incrementally exactly like the elastic-membership
+  re-ship path;
+- the membership epoch at save time rides the record for observability
+  (the snapshot a resume decision can be audited against).
+
+Restored slices are the byte-exact Tables the original run produced, so
+a resumed query's downstream computation — and therefore its result —
+is byte-identical to an uninterrupted run.
+
+Scope: the in-process data plane (workers exposing `table_store`).
+A wire transport would stage checkpoint slices through a store RPC;
+workers without the surface simply never checkpoint (save returns
+None, resume falls back to full re-execution). The AdaptiveCoordinator
+opts out entirely (`Coordinator._checkpoint_eligible`): its consumer
+task counts derive from runtime LoadInfo, so a restored lattice could
+disagree with a re-derived one.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Optional
+
+#: query-record lifecycle states
+ADMITTED = "admitted"  # running (or interrupted mid-run): recoverable
+RESUMED = "resumed"    # picked up by ServingSession.recover()
+DONE = "done"          # resolved; slices released
+
+
+@dataclass(frozen=True)
+class StageCheckpoint:
+    """One completed stage's snapshot: the consumer-side scan rebuilt
+    verbatim on restore. Frozen — a record is immutable once saved (the
+    cross-thread handoff relies on it, like SystemMetrics)."""
+
+    exec_index: int          # which coordinator.execute() of the query
+    stage_id: int
+    fingerprint: str         # structural fp of the pristine exchange subtree
+    #: (worker_url, table_id, nbytes) per consumer slice — the task lattice
+    slices: tuple
+    replicated: bool
+    pinned: bool
+    t_prod: int              # producer task count at save time
+    membership_epoch: Optional[int]
+    saved_s: float           # monotonic save stamp
+
+
+class QueryRecord:
+    """One admitted query's checkpoint state in the store."""
+
+    __slots__ = ("record_id", "sql", "priority", "status", "stages",
+                 "resumes")
+
+    def __init__(self, sql: str, priority: int):
+        self.record_id = uuid.uuid4().hex
+        self.sql = sql
+        self.priority = int(priority)
+        self.status = ADMITTED
+        #: (exec_index, stage_id) -> StageCheckpoint
+        self.stages: dict = {}
+        self.resumes = 0
+
+
+class CheckpointStore:
+    """Cross-session registry of admitted queries and their completed-
+    stage snapshots. Deliberately decoupled from any ServingSession so it
+    SURVIVES a session/coordinator teardown — construct one, pass it to
+    session after session, and `ServingSession.recover()` resumes
+    whatever the previous session left unresolved.
+
+    Thread-safe: per-query coordinators save stages from stage-DAG
+    fan-out threads while the serving tier admits/releases concurrently.
+    Slice staging/fetching runs OUTSIDE the lock (worker TableStore calls
+    block on their own locks); only record bookkeeping is held under it.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._records: dict[str, QueryRecord] = {}  # guarded-by: _lock
+        self.saves = 0  # guarded-by: _lock
+        self.restores = 0  # guarded-by: _lock
+
+    # -- query lifecycle -----------------------------------------------------
+    def admit(self, sql: str, priority: int = 0) -> str:
+        """Register an admitted query; -> its record id."""
+        rec = QueryRecord(sql, priority)
+        with self._lock:
+            self._records[rec.record_id] = rec
+        return rec.record_id
+
+    def mark_resumed(self, record_id: str) -> None:
+        with self._lock:
+            rec = self._records.get(record_id)
+            if rec is not None:
+                rec.status = RESUMED
+                rec.resumes += 1
+
+    def incomplete(self) -> list:
+        """Records a fresh session should recover: admitted (or already
+        once-resumed) queries that never resolved. Snapshot list — the
+        caller iterates without the lock."""
+        with self._lock:
+            return [
+                r for r in self._records.values() if r.status != DONE
+            ]
+
+    def release(self, record_id: str, channels) -> int:
+        """The query resolved (or was cancelled): drop its record and
+        release every staged checkpoint slice through ``channels``
+        (departed workers already released theirs with their process);
+        -> slices released. The zero-leak half of the acceptance gate."""
+        with self._lock:
+            rec = self._records.pop(record_id, None)
+        if rec is None:
+            return 0
+        released = 0
+        for ck in rec.stages.values():
+            for url, tid, _nbytes in ck.slices:
+                try:
+                    store = getattr(channels.get_worker(url),
+                                    "table_store", None)
+                    if store is not None:
+                        store.remove([tid])
+                        released += 1
+                except Exception:
+                    pass  # departed worker: its store died with it
+        return released
+
+    # -- stage snapshots ------------------------------------------------------
+    def save_stage(self, record_id: str, exec_index: int, stage_id: int,
+                   fingerprint: str, tables, replicated: bool,
+                   pinned: bool, t_prod: int, resolver,
+                   channels) -> Optional[int]:
+        """Stage ``tables`` (the consumer-side scan slices) into the live
+        workers' TableStores, round-robin, and record the checkpoint;
+        -> staged bytes, or None when the snapshot could not be taken
+        (no store surface / a mid-save departure — never an error: a
+        failed checkpoint degrades to re-execution, not a failed query).
+        """
+        from datafusion_distributed_tpu.runtime.tracing import table_nbytes
+
+        try:
+            urls = resolver.get_urls()
+        except Exception:
+            urls = []
+        if not urls:
+            return None
+        staged: list = []  # (url, tid, nbytes)
+        total = 0
+        try:
+            for i, t in enumerate(tables):
+                url = urls[(stage_id + i) % len(urls)]
+                store = getattr(channels.get_worker(url), "table_store",
+                                None)
+                if store is None or not hasattr(store, "put_as"):
+                    raise LookupError("worker has no TableStore surface")
+                tid = (
+                    f"ckpt-{record_id[:8]}-{exec_index}-{stage_id}-{i}-"
+                    f"{uuid.uuid4().hex[:8]}"
+                )
+                store.put_as(tid, t)
+                nb = table_nbytes(t)
+                staged.append((url, tid, nb))
+                total += nb
+        except Exception:
+            # partial snapshot is worthless: release what staged and skip
+            for url, tid, _nb in staged:
+                try:
+                    getattr(channels.get_worker(url), "table_store").remove(
+                        [tid]
+                    )
+                except Exception:
+                    pass
+            return None
+        ck = StageCheckpoint(
+            exec_index=exec_index, stage_id=stage_id,
+            fingerprint=fingerprint, slices=tuple(staged),
+            replicated=bool(replicated), pinned=bool(pinned),
+            t_prod=int(t_prod),
+            membership_epoch=getattr(resolver, "membership_epoch", None),
+            saved_s=time.monotonic(),
+        )
+        displaced = None
+        with self._lock:
+            rec = self._records.get(record_id)
+            if rec is None:
+                released = True  # query resolved while we staged
+            else:
+                # same-key re-save (two executors racing one record):
+                # the displaced snapshot's slices must release or they
+                # leak in the workers' stores for the process lifetime
+                displaced = rec.stages.get((exec_index, stage_id))
+                rec.stages[(exec_index, stage_id)] = ck
+                self.saves += 1
+                released = False
+        if displaced is not None:
+            for url, tid, _nb in displaced.slices:
+                try:
+                    getattr(channels.get_worker(url), "table_store").remove(
+                        [tid]
+                    )
+                except Exception:
+                    pass
+        if released:
+            for url, tid, _nb in staged:
+                try:
+                    getattr(channels.get_worker(url), "table_store").remove(
+                        [tid]
+                    )
+                except Exception:
+                    pass
+            return None
+        return total
+
+    def restore_stage(self, record_id: str, exec_index: int,
+                      stage_id: int, fingerprint: Optional[str],
+                      channels):
+        """-> (slices, replicated, pinned, t_prod) for a valid checkpoint
+        of this stage, or (None, reason) where reason is one of
+        "miss" / "fp_mismatch" / "slice_lost". Every slice is fetched
+        from the worker recorded as holding it; a departed worker or an
+        evicted id invalidates the checkpoint (and drops the record so
+        the re-executed stage can save a fresh one)."""
+        with self._lock:
+            rec = self._records.get(record_id)
+            ck = rec.stages.get((exec_index, stage_id)) if rec else None
+        if ck is None:
+            return None, "miss"
+        if fingerprint is None or ck.fingerprint != fingerprint:
+            self._drop_stage(record_id, exec_index, stage_id, channels)
+            return None, "fp_mismatch"
+        tables = []
+        try:
+            for url, tid, _nb in ck.slices:
+                store = getattr(channels.get_worker(url), "table_store",
+                                None)
+                if store is None:
+                    raise LookupError(f"no store on {url}")
+                tables.append(store.get(tid))
+        except Exception:
+            self._drop_stage(record_id, exec_index, stage_id, channels)
+            return None, "slice_lost"
+        with self._lock:
+            self.restores += 1
+        return (tables, ck.replicated, ck.pinned, ck.t_prod), "hit"
+
+    def _drop_stage(self, record_id: str, exec_index: int, stage_id: int,
+                    channels) -> None:
+        """Invalidate one stage's checkpoint (release surviving slices)."""
+        with self._lock:
+            rec = self._records.get(record_id)
+            ck = (
+                rec.stages.pop((exec_index, stage_id), None)
+                if rec else None
+            )
+        if ck is None:
+            return
+        for url, tid, _nb in ck.slices:
+            try:
+                getattr(channels.get_worker(url), "table_store").remove(
+                    [tid]
+                )
+            except Exception:
+                pass
+
+    # -- introspection -------------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            recs = list(self._records.values())
+            out = {
+                "queries": len(recs),
+                "recoverable": sum(1 for r in recs if r.status != DONE),
+                "stages": sum(len(r.stages) for r in recs),
+                "staged_bytes": sum(
+                    nb
+                    for r in recs
+                    for ck in r.stages.values()
+                    for _u, _t, nb in ck.slices
+                ),
+                "saves": self.saves,
+                "restores": self.restores,
+            }
+        return out
+
+
+class QueryCheckpointer:
+    """Per-query facade installed as `Coordinator.checkpoints`: binds one
+    store record to one cluster and tracks the execute-call sequence so
+    subquery and overflow-retry executes key their stages independently
+    of the main execute (the sequence is deterministic for a given SQL,
+    so a resume's Nth execute matches the original run's Nth).
+
+    `begin_execute` runs on the driver thread before any stage fan-out;
+    the per-execute fingerprint map is read-only afterwards, so
+    save/restore from concurrent stage threads need no lock here (the
+    store serializes record mutation itself)."""
+
+    def __init__(self, store: CheckpointStore, record_id: str, resolver,
+                 channels):
+        self.store = store
+        self.record_id = record_id
+        self.resolver = resolver
+        self.channels = channels
+        self._exec_index = -1
+        self._stage_fps: dict = {}
+
+    def begin_execute(self, plan) -> None:
+        """Stamp a new execute() and fingerprint its pristine exchange
+        subtrees (pre-hoist, so literal values are structural — two
+        queries differing only in literals can never share a stage
+        checkpoint)."""
+        from datafusion_distributed_tpu.plan.fingerprint import (
+            plan_fingerprint,
+        )
+
+        self._exec_index += 1
+        fps: dict = {}
+        try:
+            exchanges = plan.collect(
+                lambda n: getattr(n, "is_exchange", False)
+            )
+        except Exception:
+            exchanges = []
+        for node in exchanges:
+            sid = node.stage_id if node.stage_id is not None else 0
+            fps[sid] = plan_fingerprint(node)
+        self._stage_fps = fps
+
+    def stage_fingerprint(self, stage_id: int) -> Optional[str]:
+        return self._stage_fps.get(stage_id)
+
+    def save(self, stage_id: int, tables, replicated: bool, pinned: bool,
+             t_prod: int) -> Optional[int]:
+        fp = self.stage_fingerprint(stage_id)
+        if fp is None:
+            return None  # unfingerprintable stage: not checkpointable
+        return self.store.save_stage(
+            self.record_id, self._exec_index, stage_id, fp, tables,
+            replicated, pinned, t_prod, self.resolver, self.channels,
+        )
+
+    def restore(self, stage_id: int):
+        """-> ((slices, replicated, pinned, t_prod), "hit") or
+        (None, reason)."""
+        return self.store.restore_stage(
+            self.record_id, self._exec_index, stage_id,
+            self.stage_fingerprint(stage_id), self.channels,
+        )

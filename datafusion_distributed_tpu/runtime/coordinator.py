@@ -87,6 +87,22 @@ FAULT_TOLERANCE_DEFAULTS = {
     "quarantine_seconds": 30.0,
 }
 
+#: straggler-hedging knobs (`SET distributed.hedging` etc.): when a
+#: task's attempt outlives max(sketch-p<hedge_quantile>, hedge_floor_s)
+#: the coordinator speculatively re-dispatches it to a different healthy
+#: worker; first completed attempt wins, the loser is cancelled and its
+#: staged slices released. hedge_budget bounds IN-FLIGHT speculative
+#: attempts cluster-wide (runtime/metrics.py HedgeBudget) so a cold
+#: sketch or a uniformly slow stage cannot stampede the cluster with
+#: doubled load. Off by default: hedging burns spare capacity for tail
+#: latency — a serving-tier tradeoff the operator opts into.
+HEDGING_DEFAULTS = {
+    "hedging": False,
+    "hedge_quantile": 0.99,
+    "hedge_floor_s": 0.05,
+    "hedge_budget": 2,
+}
+
 #: stage-DAG scheduler knobs (`SET distributed.stage_parallelism`):
 #: bounded in-flight budget for CONCURRENT STAGES — how many independent
 #: exchange subtrees may materialize at once. 0 = auto (the worker
@@ -98,7 +114,9 @@ SCHEDULER_DEFAULTS = {
 
 #: single lookup for every `SET distributed.*` knob default the
 #: coordinator reads through _opt_int/_opt_float
-_OPTION_DEFAULTS = {**FAULT_TOLERANCE_DEFAULTS, **SCHEDULER_DEFAULTS}
+_OPTION_DEFAULTS = {
+    **FAULT_TOLERANCE_DEFAULTS, **SCHEDULER_DEFAULTS, **HEDGING_DEFAULTS,
+}
 
 
 def _terminal(exc: WorkerError) -> WorkerError:
@@ -112,6 +130,29 @@ def _terminal(exc: WorkerError) -> WorkerError:
 #: their first failures concurrently, and a lost race would drop a failure
 #: on an orphan tracker (threshold-1 quarantines silently missed)
 _HEALTH_INIT_LOCK = threading.Lock()
+
+#: same role for the lazily-created HedgeBudget: two concurrent tasks
+#: each minting a budget would double the in-flight bound
+_HEDGE_INIT_LOCK = threading.Lock()
+
+
+class _EitherSet:
+    """Duck-typed cancel handle merging two events: ``is_set()`` when
+    EITHER is. Lets a hedge attempt hand workers/chaos ONE pollable
+    object combining the per-query cancel (a sibling failed / the caller
+    cancelled) with the attempt's private loser-cancel (it lost the
+    hedge race). Members may be None or nested _EitherSets."""
+
+    __slots__ = ("_a", "_b")
+
+    def __init__(self, a, b):
+        self._a = a
+        self._b = b
+
+    def is_set(self) -> bool:
+        return (self._a is not None and self._a.is_set()) or (
+            self._b is not None and self._b.is_set()
+        )
 
 
 class _RetryState:
@@ -380,6 +421,16 @@ class Coordinator:
     # `self._tracer` for the execute's duration (NULL_TRACER when
     # `SET distributed.tracing` is off — the always-cheap-when-off path)
     trace_store: "object" = None
+    # in-flight speculative-attempt budget (runtime/metrics.py
+    # HedgeBudget), shared across every per-query coordinator under the
+    # serving tier so the hedge stampede bound is cluster-wide; created
+    # lazily on the first hedge decision otherwise
+    hedges: "object" = None
+    # per-query checkpoint facade (runtime/checkpoint.py
+    # QueryCheckpointer): when set, every materialized (MemoryScan)
+    # exchange boundary snapshots its consumer slices on completion and
+    # restores them — fingerprint-validated — on a resumed execute
+    checkpoints: "object" = None
 
     #: declarative concurrency model (tools/check_concurrency.py): these
     #: per-execute caches are shared by sibling-stage fan-out threads and
@@ -498,6 +549,21 @@ class Coordinator:
         # external cancel reaches any execute attempt without being
         # conflated with one attempt's internal teardown.
         self._cancel_event = _threading.Event()
+        # hedge-attempt threads spawned this execute (appends are
+        # GIL-atomic single-op list mutations like _peer_shipped, so no
+        # lock is declared): joined in the finally below so every
+        # loser's cleanup lands before the query resolves — the leak
+        # gates observe a quiesced store, never a racing release
+        self._hedge_threads: list = []
+        # one `query_resumed` event per execute, on the first restore
+        self._resume_traced = False
+        if self.checkpoints is not None:
+            # stamp this execute in the checkpoint session and
+            # fingerprint the pristine exchange subtrees (restore keys)
+            try:
+                self.checkpoints.begin_execute(plan)
+            except Exception:
+                self.checkpoints = None  # never fail the query for it
         # pin this query's spans against the shared store's LRU for as
         # long as it runs (runtime/metrics.py begin/finish_query)
         self.stage_metrics.begin_query(query_id)
@@ -539,6 +605,17 @@ class Coordinator:
             self._signal_cancel()
             raise
         finally:
+            # drain hedge attempts FIRST: a loser's thread owns releasing
+            # its staged slices, and the cancel plumbing (interruptible
+            # chaos delays, gRPC wire deadlines, per-attempt events)
+            # makes these joins short on cancellable surfaces. A loser
+            # mid-compute on a surface with NO cancel parameter (plain
+            # in-process Worker) cannot be interrupted from Python — a
+            # final-stage straggler can then hold query COMPLETION (not
+            # the result) until it finishes or the join budget expires;
+            # `task_timeout_s` bounds that wall when set
+            for t in self._hedge_threads:
+                t.join(timeout=30.0)
             for worker, key in self._peer_shipped:
                 try:
                     # peer producers report metrics at query end (the
@@ -965,8 +1042,78 @@ class Coordinator:
                      parent=tr.reserved_id(("stage", stage_id)),
                      stage=stage_id, exchange=type(plan).__name__,
                      producer_tasks=t_prod):
-            return self._materialize_exchange_body(
+            restored = self._restore_stage_checkpoint(
+                plan, producer, query_id, stage_id
+            )
+            if restored is not None:
+                return restored
+            scan = self._materialize_exchange_body(
                 plan, producer, query_id, stage_id, t_prod
+            )
+            self._save_stage_checkpoint(query_id, stage_id, t_prod, scan)
+            return scan
+
+    # -- query checkpoint/resume (runtime/checkpoint.py) ---------------------
+    def _checkpoint_eligible(self) -> bool:
+        """Whether this coordinator's stage lattices are deterministic
+        enough to snapshot/restore (the AdaptiveCoordinator re-derives
+        consumer counts from runtime LoadInfo and opts out)."""
+        return True
+
+    def _restore_stage_checkpoint(self, plan, producer, query_id: str,
+                                  stage_id: int):
+        """Consumer-side scan rebuilt from a valid stage checkpoint, or
+        None (no checkpointer / miss / fingerprint mismatch / staged-
+        slice loss — the latter two re-execute the stage, whose own
+        producers still restore from THEIR checkpoints: the partially-
+        lost-frontier heal)."""
+        ck = self.checkpoints
+        if ck is None or not self._checkpoint_eligible():
+            return None
+        hit, reason = ck.restore(stage_id)
+        if hit is None:
+            if reason == "fp_mismatch":
+                self.faults.bump("checkpoint_fp_mismatch")
+                self._tr().event("checkpoint_fp_mismatch", stage=stage_id)
+            elif reason == "slice_lost":
+                self.faults.bump("checkpoint_slices_lost")
+                self._tr().event("checkpoint_slices_lost", stage=stage_id)
+            return None
+        slices, replicated, pinned, _t_prod = hit
+        scan = MemoryScanExec(slices, producer.schema(), pinned=pinned,
+                              replicated=replicated)
+        self.faults.bump("checkpoint_stages_restored")
+        if not self._resume_traced:
+            # first restored stage of this execute: the query is resuming
+            self._resume_traced = True
+            self.faults.bump("queries_resumed")
+            self._tr().event("query_resumed", stage=stage_id)
+        self.stream_metrics[(query_id, stage_id)] = {
+            "plane": "checkpoint",
+            "coordinator_bytes": 0,
+            "partitions": len(slices),
+        }
+        self._seed_consumer_scan(plan, scan)
+        return scan
+
+    def _save_stage_checkpoint(self, query_id: str, stage_id: int,
+                               t_prod: int, scan) -> None:
+        """Snapshot a just-materialized boundary. Only MemoryScan results
+        checkpoint — a peer-plane boundary's data never materialized on
+        the coordinator (its producers re-ship through the peer-heal
+        path instead)."""
+        ck = self.checkpoints
+        if ck is None or not self._checkpoint_eligible():
+            return
+        if type(scan) is not MemoryScanExec:
+            return
+        staged = ck.save(stage_id, list(scan.tasks), scan.replicated,
+                         scan.pinned, t_prod)
+        if staged is not None:
+            self.faults.bump("checkpoint_stages_saved")
+            self._tr().event(
+                "checkpoint_saved", stage=stage_id,
+                slices=len(scan.tasks), bytes=staged,
             )
 
     def _materialize_exchange_body(
@@ -1389,6 +1536,10 @@ class Coordinator:
         except Exception:
             return False
 
+    # NOTE: AdaptiveCoordinator overrides _checkpoint_eligible to False —
+    # its consumer lattices derive from runtime LoadInfo and cannot be
+    # re-derived at restore time (see the override below).
+
     def _shuffle_stage_partition_streams(
         self, exchange, producer: ExecutionPlan, query_id: str,
         stage_id: int, t_prod: int,
@@ -1749,32 +1900,49 @@ class Coordinator:
                             pass
                         raise
                     asp.set(worker=worker.url)
+                    hedge_after = self._hedge_threshold()
                     try:
-                        try:
-                            with tr.span("execute_rpc", "execute",
-                                         worker=worker.url):
-                                out = self._execute_with_deadline(
-                                    worker, key
-                                )
-                            # metrics are best-effort: a flaky progress
-                            # RPC after a SUCCESSFUL execute must not
-                            # discard the result, re-run the task, or
-                            # count against the worker
+                        if hedge_after is not None and (
+                            not self._stage_span_shipped(query_id,
+                                                         stage_id)
+                        ):
+                            # hedge arm: race the primary against a
+                            # speculative re-dispatch once its wall
+                            # passes the sketch-derived threshold
+                            worker, out = self._hedged_execute(
+                                stage_plan, query_id, stage_id,
+                                task_number, task_count,
+                                (worker, key, plan_obj, store),
+                                hedge_after, state, asp,
+                            )
+                        else:
                             try:
-                                self._record_task_progress(worker, key)
-                            except Exception:
-                                pass
-                        finally:
-                            # best-effort: with the result in hand a
-                            # cleanup hiccup must not discard it (or
-                            # re-execute the task), and on the failure
-                            # path it must not MASK the execute error;
-                            # cleanup is local-only ops
-                            try:
-                                self._cleanup_task(worker, key, plan_obj,
-                                                   store)
-                            except Exception:
-                                pass
+                                with tr.span("execute_rpc", "execute",
+                                             worker=worker.url):
+                                    out = self._execute_attempt(
+                                        worker, key,
+                                        cancel=self._cancel_event,
+                                    )
+                                # metrics are best-effort: a flaky
+                                # progress RPC after a SUCCESSFUL execute
+                                # must not discard the result, re-run the
+                                # task, or count against the worker
+                                try:
+                                    self._record_task_progress(worker,
+                                                               key)
+                                except Exception:
+                                    pass
+                            finally:
+                                # best-effort: with the result in hand a
+                                # cleanup hiccup must not discard it (or
+                                # re-execute the task), and on the
+                                # failure path it must not MASK the
+                                # execute error; cleanup is local-only
+                                try:
+                                    self._cleanup_task(worker, key,
+                                                       plan_obj, store)
+                                except Exception:
+                                    pass
                     except BaseException as e:
                         # attribute the failure to the worker the ERROR
                         # names when it names one (a dead peer PRODUCER
@@ -1799,37 +1967,55 @@ class Coordinator:
                 return out
 
     # -- fault tolerance -----------------------------------------------------
-    def _execute_with_deadline(self, worker, key) -> Table:
-        """Bulk-plane execute under the per-task deadline (`SET
-        distributed.task_timeout_s`). Workers whose execute_task accepts a
-        ``timeout`` get NATIVE enforcement — the gRPC client turns it into
-        a wire deadline that cancels the stream server-side instead of
-        leaking an open RPC per abandoned attempt. Workers without the
-        parameter (MeshWorker, user duck-types) fall back to the
-        coordinator-side thread deadline, which works against any
-        transport but can only abandon, not cancel."""
+    def _execute_attempt(self, worker, key, cancel=None) -> Table:
+        """ONE bulk-plane execute attempt under the per-task deadline
+        (`SET distributed.task_timeout_s`). Workers whose execute_task
+        accepts a ``timeout`` get NATIVE enforcement — the gRPC client
+        turns it into a wire deadline that cancels the stream server-side
+        instead of leaking an open RPC per abandoned attempt. Workers
+        without the parameter (MeshWorker, user duck-types) fall back to
+        the coordinator-side thread deadline, which works against any
+        transport but can only abandon, not cancel.
+
+        ``cancel``: a pollable cancel handle (the per-query event, or a
+        hedge attempt's combined loser-cancel) forwarded to workers whose
+        surface declares it — chaos proxies poll it inside injected
+        delays, so a cancelled attempt releases its slot at cancellation
+        latency rather than the full injected delay."""
         timeout = self._opt_float("task_timeout_s")
+        kw = {}
+        if cancel is not None and self._worker_accepts_param(
+            worker, "execute_task", "cancel"
+        ):
+            kw["cancel"] = cancel
         if not timeout:
-            return worker.execute_task(key)
+            return worker.execute_task(key, **kw)
         if self._worker_accepts_timeout(worker):
-            return worker.execute_task(key, timeout=timeout)
+            return worker.execute_task(key, timeout=timeout, **kw)
         return call_with_deadline(
-            lambda: worker.execute_task(key), timeout, worker.url, key
+            lambda: worker.execute_task(key, **kw), timeout, worker.url,
+            key,
         )
 
     def _worker_accepts_timeout(self, worker,
                                 method: str = "execute_task") -> bool:
         """Whether this worker type's ``method`` takes an EXPLICIT
-        ``timeout=`` (cached per (type, method) — signature inspection is
-        not free per task). A bare ``**kwargs`` deliberately does NOT
-        count: a forwarding wrapper could swallow the kwarg without
-        enforcing anything, silently disabling the deadline — such
-        workers get the coordinator-side thread deadline (execute) or no
-        deadline (dispatch) instead of a TypeError."""
+        ``timeout=`` (see `_worker_accepts_param`)."""
+        return self._worker_accepts_param(worker, method, "timeout")
+
+    def _worker_accepts_param(self, worker, method: str,
+                              param: str) -> bool:
+        """Whether this worker type's ``method`` declares an EXPLICIT
+        ``param`` (cached per (type, method, param) — signature
+        inspection is not free per task). A bare ``**kwargs``
+        deliberately does NOT count: a forwarding wrapper could swallow
+        the kwarg without honoring it, silently disabling the deadline or
+        the cancel plumbing — such workers get the coordinator-side
+        fallback instead of a TypeError."""
         cache = getattr(self, "_timeout_sig_cache", None)
         if cache is None:
             cache = self._timeout_sig_cache = {}
-        ck = (type(worker), method)
+        ck = (type(worker), method, param)
         hit = cache.get(ck)
         if hit is None:
             import inspect
@@ -1838,7 +2024,7 @@ class Coordinator:
                 params = inspect.signature(
                     getattr(worker, method)
                 ).parameters
-                hit = "timeout" in params
+                hit = param in params
             except (TypeError, ValueError, AttributeError):
                 hit = False
             cache[ck] = hit
@@ -1886,6 +2072,458 @@ class Coordinator:
         if self.health is not None and url:
             self.health.record_success(url)
 
+    # -- straggler hedging ---------------------------------------------------
+    def _hedge_budget(self):
+        if self.hedges is None:
+            from datafusion_distributed_tpu.runtime.metrics import (
+                HedgeBudget,
+            )
+
+            with _HEDGE_INIT_LOCK:
+                if self.hedges is None:  # double-checked: fan-out threads
+                    self.hedges = HedgeBudget()
+        return self.hedges
+
+    def _hedge_threshold(self) -> Optional[float]:
+        """Seconds an attempt may run before a speculative re-dispatch,
+        or None with hedging off. max(sketch-p<hedge_quantile>,
+        hedge_floor_s): the floor keeps a COLD sketch from hedging
+        everything instantly (and the in-flight budget bounds whatever
+        the floor still admits)."""
+        from datafusion_distributed_tpu.ops.table import parse_bool_knob
+
+        v = self.config_options.get("hedging", False)
+        try:
+            enabled = parse_bool_knob(v)
+        except Exception:
+            enabled = bool(v)
+        if not enabled:
+            return None
+        q = min(max(self._opt_float("hedge_quantile"), 0.0), 1.0)
+        floor = max(self._opt_float("hedge_floor_s"), 0.0)
+        p = None
+        if self.latency is not None and getattr(self.latency, "count", 0):
+            try:
+                p = self.latency.percentile(q)
+            except Exception:
+                p = None
+        threshold = max(p or 0.0, floor)
+        return threshold if threshold > 0 else None
+
+    def _stage_span_shipped(self, query_id: str, stage_id: int) -> bool:
+        """Whether this (query, stage) shipped as mesh SPANS: a span plan
+        is shared across sibling tasks, so neither a lone-task
+        re-dispatch nor a lone-task hedge is defined for it."""
+        spans = getattr(self, "_span_shipped", None)
+        if not spans:
+            return False
+        with self._span_lock:  # vs concurrent sibling-stage shipment
+            return any(
+                k[0] == query_id and k[1] == stage_id for k in spans
+            )
+
+    def _record_hedge_loss(self, url: str) -> None:
+        """Hedge-loss mark, DISTINCT from a failure: never advances the
+        circuit breaker (runtime/health.py record_hedge_loss)."""
+        if not url:
+            return
+        tracker = self._health_tracker()
+        mark = getattr(tracker, "record_hedge_loss", None)
+        if callable(mark):
+            mark(url)
+
+    def _dispatch_hedge(self, stage_plan, query_id, stage_id, task_number,
+                        task_count, primary_url, state):
+        """Speculatively dispatch the SAME task to a different healthy
+        worker; -> (worker, key, plan_obj, store) or None (no budget, no
+        alternative candidate, or the dispatch itself failed — a hedge
+        that cannot launch must never fail the primary attempt)."""
+        try:
+            urls = self.resolver.get_urls()
+        except Exception:
+            return None
+        if not any(u != primary_url for u in urls):
+            return None  # single-worker cluster: nowhere to hedge to
+        budget = self._hedge_budget()
+        if not budget.try_acquire(self._opt_int("hedge_budget")):
+            self.faults.bump("hedge_budget_denied")
+            return None
+        ok = False
+        try:
+            disp = self._dispatch_task(
+                stage_plan, query_id, stage_id, task_number, task_count,
+                exclude=set(state.excluded) | {primary_url},
+            )
+            if disp[0].url == primary_url:
+                # exclusion fell back to the primary (every alternative
+                # quarantined): hedging the same worker is pure waste
+                try:
+                    self._cleanup_task(*disp)
+                except Exception:
+                    pass
+                self.faults.bump("hedges_abandoned")
+                return None
+            ok = True
+            return disp
+        except Exception:
+            self.faults.bump("hedges_abandoned")
+            return None
+        finally:
+            if not ok:
+                budget.release()
+
+    def _hedged_execute(self, stage_plan, query_id, stage_id, task_number,
+                        task_count, primary, threshold, state, asp):
+        """Bulk-plane hedge race: run the already-dispatched ``primary``
+        attempt in a thread; if it outlives ``threshold``, speculatively
+        re-dispatch to a different worker and let the FIRST completed
+        attempt win. The loser is cancelled through its per-attempt
+        cancel handle and its thread releases its staged slices when the
+        in-flight call resolves (execute's finally joins these threads,
+        so the query never resolves with a release still pending).
+        -> (winner worker, result Table). Raises the primary's error when
+        every attempt fails (the normal retry loop takes over)."""
+        import queue as _queue
+        import threading as _threading
+
+        tr = self._tr()
+        results: "_queue.Queue" = _queue.Queue()
+        race_lock = _threading.Lock()
+        attempts: list = []
+
+        def start(disp, speculative: bool) -> dict:
+            ev = _threading.Event()
+            att = {
+                "worker": disp[0], "key": disp[1], "plan_obj": disp[2],
+                "store": disp[3], "ev": ev, "spec": speculative,
+                "lost": False,
+            }
+            cancel = _EitherSet(self._cancel_event, ev)
+
+            def run() -> None:
+                sp = tr.start_span(
+                    "execute_rpc", "execute", parent=asp.span_id,
+                    worker=att["worker"].url, hedge=speculative,
+                )
+                payload = None
+                try:
+                    out = self._execute_attempt(
+                        att["worker"], att["key"], cancel=cancel
+                    )
+                except BaseException as e:
+                    sp.set(error=type(e).__name__)
+                    payload = (att, None, e)
+                else:
+                    payload = (att, out, None)
+                finally:
+                    tr.end_span(sp)
+                    if speculative:
+                        self._hedge_budget().release()
+                # deliver-or-discard under the race lock: after the main
+                # thread marks an attempt lost, nothing more enqueues
+                with race_lock:
+                    if not att["lost"]:
+                        results.put(payload)
+                if payload[2] is None and not att["lost"]:
+                    # winner-side metrics (losers are being discarded: a
+                    # cancelled attempt's wall must not feed the sketch)
+                    try:
+                        self._record_task_progress(att["worker"],
+                                                   att["key"])
+                    except Exception:
+                        pass
+                try:
+                    self._cleanup_task(att["worker"], att["key"],
+                                       att["plan_obj"], att["store"])
+                except Exception:
+                    pass
+
+            t = _threading.Thread(target=run, daemon=True,
+                                  name="dftpu-hedge")
+            attempts.append(att)
+            self._hedge_threads.append(t)
+            t.start()
+            return att
+
+        start(primary, speculative=False)
+        started = 1
+        hedged = False
+        first = None
+        try:
+            first = results.get(timeout=threshold)
+        except _queue.Empty:
+            disp = self._dispatch_hedge(
+                stage_plan, query_id, stage_id, task_number, task_count,
+                primary[0].url, state,
+            )
+            if disp is not None:
+                hedged = True
+                self.faults.bump("hedges_issued")
+                tr.event(
+                    "hedge_issued", stage=stage_id, task=task_number,
+                    primary=primary[0].url, hedge=disp[0].url,
+                    threshold_ms=round(threshold * 1e3, 1),
+                )
+                start(disp, speculative=True)
+                started = 2
+        errors: list = []
+        winner = None
+        while winner is None:
+            while first is None:
+                try:
+                    first = results.get(timeout=0.05)
+                except _queue.Empty:
+                    if self._cancelled():
+                        self._abandon_attempts(attempts, race_lock)
+                        self._check_cancelled()
+            att, out, err = first
+            first = None
+            if err is None:
+                winner = (att, out)
+                break
+            errors.append((att, err))
+            if len(errors) >= started:
+                # every attempt failed: surface the PRIMARY's error (the
+                # retry loop's health/reroute attribution expects it) and
+                # count the non-surfaced failures against their workers
+                surfaced = next(
+                    (e for a, e in errors if not a["spec"]),
+                    errors[0][1],
+                )
+                self._note_failed_attempts(
+                    [(a, e) for a, e in errors if e is not surfaced]
+                )
+                raise surfaced
+        att, out = winner
+        # the race resolved with a success: attempts that FAILED before
+        # the win were genuine failures (breaker-visible); attempts still
+        # running merely LOST (cancelled, breaker-neutral)
+        self._note_failed_attempts(errors)
+        failed = {id(a) for a, _e in errors}
+        self._abandon_attempts(
+            [a for a in attempts if a is not att], race_lock,
+        )
+        for a in attempts:
+            if a is not att and id(a) not in failed:
+                self._record_hedge_loss(a["worker"].url)
+        if hedged:
+            name = "hedge_won" if att["spec"] else "hedge_lost"
+            self.faults.bump("hedges_won" if att["spec"] else
+                             "hedges_lost")
+            tr.event(name, stage=stage_id, task=task_number,
+                     worker=att["worker"].url)
+        return att["worker"], out
+
+    def _abandon_attempts(self, atts, race_lock) -> None:
+        """Mark ``atts`` lost (their threads stop delivering and discard
+        their own results/slices) and set their cancel handles."""
+        for a in atts:
+            with race_lock:
+                a["lost"] = True
+            a["ev"].set()
+
+    def _note_failed_attempts(self, errors) -> None:
+        """Health accounting for hedge-race attempts that FAILED with a
+        genuine error (collected before any winner, so never
+        cancellation-induced): a retryable infrastructure failure counts
+        against its worker's breaker exactly as the unhedged path would
+        count it — a worker that keeps crashing hedge attempts must not
+        stay quarantine-proof just because a sibling attempt won."""
+        member = set(self._full_membership_urls())
+        for a, e in errors:
+            if not is_retryable(e):
+                continue  # query-semantic: no breaker input (as unhedged)
+            url = getattr(e, "worker_url", "") or a["worker"].url
+            if url in member:
+                self._record_worker_failure(url)
+
+    def _discard_attempt(self, att, it) -> None:
+        """Release a losing (or abandoned) streaming attempt: close its
+        chunk iterator (the worker-side stream's own cleanup runs in its
+        finalizers) and drop its staged slices. Best-effort and silent —
+        teardown of discarded work must never mask or fail anything."""
+        try:
+            if it is not None:
+                it.close()
+        except Exception:
+            pass
+        try:
+            self._cleanup_task(att["worker"], att["key"],
+                               att["plan_obj"], att["store"])
+        except Exception:
+            pass
+
+    def _hedged_first_chunk(self, stage_plan, query_id, stage_id,
+                            task_number, task_count, primary, body,
+                            cancel, threshold, state, done, pull_span):
+        """Streaming-plane hedge race over the FIRST chunk (which
+        contains the task's execution — later chunks slice an already-
+        materialized output). Returns the winning attempt's
+        (worker, key, plan_obj, store, iterator, first_item); the caller
+        adopts the iterator and streams it exactly like an unhedged pull,
+        so the retry-while-nothing-yielded contract is preserved. Losers
+        are cancelled per-attempt and release their own staged state.
+        Raises the primary's error when every attempt fails."""
+        import queue as _queue
+        import threading as _threading
+
+        tr = self._tr()
+        timeout = self._opt_float("task_timeout_s")
+        results: "_queue.Queue" = _queue.Queue()
+        race_lock = _threading.Lock()
+        attempts: list = []
+
+        def start(disp, speculative: bool) -> dict:
+            ev = _threading.Event()
+            att = {
+                "worker": disp[0], "key": disp[1], "plan_obj": disp[2],
+                "store": disp[3], "ev": ev, "spec": speculative,
+                "lost": False,
+            }
+            # the attempt's pollable cancel merges the CALLER's stream
+            # cancel (LIMIT satisfied / sibling failure) with this
+            # attempt's private loser-cancel and the per-query event
+            combined = _EitherSet(
+                cancel, _EitherSet(ev, self._cancel_event)
+            )
+
+            def run() -> None:
+                sp = tr.start_span(
+                    "pull_attempt", "execute", parent=pull_span.span_id,
+                    worker=att["worker"].url, hedge=speculative,
+                )
+                it = None
+                payload = None
+                try:
+                    it = iter(body(att["worker"], att["key"], combined))
+                    if timeout:
+                        first = call_with_deadline(
+                            lambda: next(it, done), timeout,
+                            att["worker"].url, att["key"],
+                        )
+                    else:
+                        first = next(it, done)
+                except BaseException as e:
+                    sp.set(error=type(e).__name__)
+                    payload = (att, None, None, e)
+                else:
+                    payload = (att, it, first, None)
+                finally:
+                    tr.end_span(sp)
+                    if speculative:
+                        self._hedge_budget().release()
+                # deliver-or-discard under the race lock: once the main
+                # thread marks this attempt lost, nothing more enqueues —
+                # so a post-race drain of the queue sees every delivered
+                # loser, and an undelivered loser discards itself here
+                with race_lock:
+                    lost = att["lost"]
+                    if not lost:
+                        results.put(payload)
+                if payload[3] is not None:
+                    # a FAILED attempt's staged state is dead no matter
+                    # how the race resolves (the main thread never adopts
+                    # an error): release it here — idempotent with the
+                    # caller's primary-cleanup on the all-failed path
+                    self._discard_attempt(att, it)
+                elif lost:
+                    self._discard_attempt(att, it)
+
+            t = _threading.Thread(target=run, daemon=True,
+                                  name="dftpu-hedge-pull")
+            attempts.append(att)
+            self._hedge_threads.append(t)
+            t.start()
+            return att
+
+        start(primary, speculative=False)
+        started = 1
+        hedged = False
+        first_res = None
+        try:
+            first_res = results.get(timeout=threshold)
+        except _queue.Empty:
+            disp = self._dispatch_hedge(
+                stage_plan, query_id, stage_id, task_number, task_count,
+                primary[0].url, state,
+            )
+            if disp is not None:
+                hedged = True
+                self.faults.bump("hedges_issued")
+                tr.event(
+                    "hedge_issued", stage=stage_id, task=task_number,
+                    primary=primary[0].url, hedge=disp[0].url,
+                    threshold_ms=round(threshold * 1e3, 1),
+                    plane="stream",
+                )
+                start(disp, speculative=True)
+                started = 2
+        errors: list = []
+        winner = None
+        while winner is None:
+            while first_res is None:
+                try:
+                    first_res = results.get(timeout=0.05)
+                except _queue.Empty:
+                    if self._cancelled():
+                        self._abandon_attempts(attempts, race_lock)
+                        self._drain_discard(results)
+                        self._check_cancelled()
+            att, it, first, err = first_res
+            first_res = None
+            if err is None:
+                winner = (att, it, first)
+                break
+            errors.append((att, err))
+            if len(errors) >= started:
+                # surface the PRIMARY's error for the retry loop's
+                # attribution; count the non-surfaced failures here
+                surfaced = next(
+                    (e for a, e in errors if not a["spec"]),
+                    errors[0][1],
+                )
+                self._note_failed_attempts(
+                    [(a, e) for a, e in errors if e is not surfaced]
+                )
+                raise surfaced
+        att, it, first = winner
+        # failed-before-the-win attempts are breaker-visible failures;
+        # still-running attempts merely lost the race (breaker-neutral)
+        self._note_failed_attempts(errors)
+        failed = {id(a) for a, _e in errors}
+        self._abandon_attempts(
+            [a for a in attempts if a is not att], race_lock,
+        )
+        # a loser that DELIVERED before being marked lost sits in the
+        # queue: its iterator/slices are discarded here (its thread
+        # already exited and will not)
+        self._drain_discard(results)
+        for a in attempts:
+            if a is not att and id(a) not in failed:
+                self._record_hedge_loss(a["worker"].url)
+        if hedged:
+            name = "hedge_won" if att["spec"] else "hedge_lost"
+            self.faults.bump("hedges_won" if att["spec"] else
+                             "hedges_lost")
+            tr.event(name, stage=stage_id, task=task_number,
+                     worker=att["worker"].url, plane="stream")
+        return (att["worker"], att["key"], att["plan_obj"],
+                att["store"], it, first)
+
+    def _drain_discard(self, results) -> None:
+        """Discard every already-delivered losing attempt in ``results``
+        (close iterators, release slices)."""
+        import queue as _queue
+
+        while True:
+            try:
+                late = results.get_nowait()
+            except _queue.Empty:
+                return
+            att, it, _first, err = late
+            if err is None:
+                self._discard_attempt(att, it)
+
     def _handle_task_failure(self, exc, url, key_tuple, state) -> bool:
         """Record + classify a failed task attempt; True -> caller retries.
 
@@ -1922,21 +2560,14 @@ class Coordinator:
             # endpoint that no longer exists would only re-grow the
             # health map the membership prune just cleaned
             self._record_worker_failure(url)
-        spans = getattr(self, "_span_shipped", None)
-        if spans:
-            with self._span_lock:  # vs concurrent sibling-stage shipment
-                span_hit = any(
-                    k[0] == key_tuple[0] and k[1] == key_tuple[1]
-                    for k in spans
-                )
-            if span_hit:
-                # this (query, stage) actually shipped as mesh SPANS: a
-                # span plan is shared across sibling tasks, so
-                # re-dispatching a lone task elsewhere is undefined.
-                # Keyed on what shipped, not on the width cache — a
-                # membership change resetting the cache mid-stage must
-                # not silently lift this guard
-                return False
+        if self._stage_span_shipped(key_tuple[0], key_tuple[1]):
+            # this (query, stage) actually shipped as mesh SPANS: a
+            # span plan is shared across sibling tasks, so
+            # re-dispatching a lone task elsewhere is undefined.
+            # Keyed on what shipped, not on the width cache — a
+            # membership change resetting the cache mid-stage must
+            # not silently lift this guard
+            return False
         if state.attempt >= self._opt_int("max_task_retries"):
             self.faults.bump("retries_exhausted")
             self._tr().event(
@@ -2053,15 +2684,37 @@ class Coordinator:
                 raise
             pull_span.set(worker=worker.url)
             yielded = False
+            hedge_after = self._hedge_threshold()
             try:
                 try:
-                    it = iter(body(worker, key, cancel))
-                    if timeout:
-                        first = call_with_deadline(
-                            lambda: next(it, done), timeout, worker.url, key
+                    if hedge_after is not None and (
+                        not self._stage_span_shipped(query_id, stage_id)
+                    ):
+                        # hedge arm (streaming plane): race the FIRST
+                        # chunk — the wait that contains the execution —
+                        # against a speculative re-dispatch; the winner's
+                        # iterator is adopted below, so nothing has been
+                        # yielded before the race resolves and replay
+                        # safety is untouched
+                        worker, key, plan_obj, store, it, first = (
+                            self._hedged_first_chunk(
+                                stage_plan, query_id, stage_id,
+                                task_number, task_count,
+                                (worker, key, plan_obj, store),
+                                body, cancel, hedge_after, state, done,
+                                pull_span,
+                            )
                         )
+                        pull_span.set(worker=worker.url)
                     else:
-                        first = next(it, done)
+                        it = iter(body(worker, key, cancel))
+                        if timeout:
+                            first = call_with_deadline(
+                                lambda: next(it, done), timeout,
+                                worker.url, key,
+                            )
+                        else:
+                            first = next(it, done)
                     if first is not done:
                         yielded = True
                         yield first
@@ -2210,6 +2863,14 @@ class Coordinator:
                 ctx["parent"] = dsp.parent_id
                 config = {**config, TRACE_CTX_KEY: ctx}
             ship_kw = {}
+            ship_cancel = getattr(self, "_cancel_event", None)
+            if ship_cancel is not None and self._worker_accepts_param(
+                worker, "set_plan", "cancel"
+            ):
+                # surfaces that declare a dispatch cancel (chaos proxies)
+                # get the per-query event so injected ship delays abort
+                # at cancellation latency
+                ship_kw["cancel"] = ship_cancel
             dispatch_timeout = self._opt_float("dispatch_timeout_s")
             if dispatch_timeout and self._worker_accepts_timeout(
                 worker, "set_plan"
@@ -2426,6 +3087,15 @@ class AdaptiveCoordinator(Coordinator):
         # a caller-configured headroom, not clobber it with the class default
         self._base_resize_headroom = self.resize_headroom
         self._headroom_pinned = False
+
+    def _checkpoint_eligible(self) -> bool:
+        """Adaptive lattices derive from runtime LoadInfo (consumer task
+        counts and capacities re-sized mid-query from sampled outputs):
+        a restored checkpoint lattice could disagree with the one a
+        resume would re-derive, so the adaptive coordinator opts out of
+        checkpoint save/restore entirely — resumes under it degrade to
+        full re-execution, never to a mismatched lattice."""
+        return False
 
     def pin_overflow_headroom(self, attempt: int) -> None:
         """Widen the resize headroom for retry ``attempt`` of one query and

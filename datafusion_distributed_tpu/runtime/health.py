@@ -50,6 +50,9 @@ class _WorkerState:
     probe_until: float = 0.0
     total_failures: int = 0
     total_successes: int = 0
+    #: hedge losses (speculative peer finished first) — observability
+    #: only, NEVER breaker input: slow is not broken
+    hedge_losses: int = 0
 
 
 class HealthTracker:
@@ -78,6 +81,17 @@ class HealthTracker:
             s.consecutive_failures = 0
             s.trips = 0
             s.state = CLOSED
+
+    def record_hedge_loss(self, url: str) -> None:
+        """The worker lost a hedge race (its attempt was outpaced by a
+        speculative re-dispatch). Distinct from `record_failure` by
+        design: a hedge loss NEVER advances `consecutive_failures` or
+        trips the breaker — a slow-but-correct worker must stay routable
+        (hedging exists to route around it per task), and quarantining
+        on slowness would amplify one straggler into lost capacity."""
+        with self._lock:
+            s = self._state_locked(url)
+            s.hedge_losses += 1
 
     def record_failure(self, url: str) -> bool:
         """-> True when this failure TRIPPED the breaker (closed/half-open ->
@@ -196,6 +210,7 @@ class HealthTracker:
                     if s.state == OPEN else 0.0,
                     "total_failures": s.total_failures,
                     "total_successes": s.total_successes,
+                    "hedge_losses": s.hedge_losses,
                 }
                 for url, s in self._workers.items()
             }

@@ -225,6 +225,52 @@ class MetricsStore:
         return out
 
 
+class HedgeBudget:
+    """In-flight budget for speculative (hedged) task attempts — the
+    stampede guard of the straggler hedger (runtime/coordinator.py): a
+    cold latency sketch or a genuinely slow stage makes EVERY task look
+    hedge-worthy, and without a bound the hedger would double the
+    cluster's load exactly when it is already slow. One budget is shared
+    by every per-query coordinator under the serving tier, so the bound
+    is cluster-wide, not per-query.
+
+    `try_acquire(limit)` admits a hedge while fewer than ``limit``
+    speculative attempts are in flight (the limit is passed per call so
+    a live `SET distributed.hedge_budget` applies to the next hedge
+    decision); the hedge releases its slot when its attempt resolves."""
+
+    def __init__(self) -> None:
+        import threading
+
+        self._lock = threading.Lock()
+        self._in_flight = 0  # guarded-by: _lock
+        self.peak_in_flight = 0  # guarded-by: _lock
+        self.denied = 0  # guarded-by: _lock
+
+    def try_acquire(self, limit: int) -> bool:
+        with self._lock:
+            if limit <= 0 or self._in_flight >= limit:
+                self.denied += 1
+                return False
+            self._in_flight += 1
+            self.peak_in_flight = max(
+                self.peak_in_flight, self._in_flight
+            )
+            return True
+
+    def release(self) -> None:
+        with self._lock:
+            self._in_flight = max(self._in_flight - 1, 0)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "in_flight": self._in_flight,
+                "peak_in_flight": self.peak_in_flight,
+                "denied": self.denied,
+            }
+
+
 class FaultCounters:
     """Thread-safe counters for the fault-tolerant execution layer
     (retries, reroutes, timeouts, quarantine trips). Surfaced through

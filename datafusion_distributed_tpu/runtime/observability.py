@@ -99,12 +99,15 @@ class ObservabilityService:
 
     def __init__(self, resolver, channels, sample_system: bool = False,
                  health=None, fault_counters=None, serving=None,
-                 trace_store=None):
+                 trace_store=None, checkpoints=None):
         self.resolver = resolver
         self.channels = channels
         self.health = health
         self.fault_counters = fault_counters
         self.serving = serving
+        # checkpoint store (runtime/checkpoint.py) surfaced by
+        # get_robustness; falls back to the wired serving session's store
+        self.checkpoints = checkpoints
         # distributed-tracing store surfaced by get_trace_summary (None =
         # the process-wide default, runtime/tracing.py)
         self.trace_store = trace_store
@@ -204,6 +207,43 @@ class ObservabilityService:
             return self.serving.stats()
         except Exception as e:
             return {"error": str(e)}
+
+    def get_robustness(self) -> dict:
+        """Straggler-hedging + query-checkpoint counters (the serving-
+        hardening robustness layer): hedge issue/win/loss/deny totals and
+        checkpoint save/restore/fallback totals from the wired
+        FaultCounters, plus the checkpoint store's live record/byte
+        accounting when one is wired (directly or through the serving
+        session). Empty sub-dicts without wiring — same degradation
+        contract as get_fault_counters."""
+        fc = (
+            self.fault_counters.as_dict()
+            if self.fault_counters is not None else {}
+        )
+        out = {
+            "hedging": {
+                k: fc.get(k, 0)
+                for k in ("hedges_issued", "hedges_won", "hedges_lost",
+                          "hedges_abandoned", "hedge_budget_denied")
+            },
+            "checkpoint": {
+                k: fc.get(k, 0)
+                for k in ("checkpoint_stages_saved",
+                          "checkpoint_stages_restored",
+                          "checkpoint_fp_mismatch",
+                          "checkpoint_slices_lost", "queries_resumed",
+                          "queries_recovered")
+            },
+        }
+        store = self.checkpoints
+        if store is None and self.serving is not None:
+            store = getattr(self.serving, "checkpoints", None)
+        if store is not None:
+            try:
+                out["checkpoint"]["store"] = store.stats()
+            except Exception as e:
+                out["checkpoint"]["store"] = {"error": str(e)}
+        return out
 
     def get_task_progress(self, keys) -> dict:
         """TaskKey list -> progress dicts from whichever worker holds each.

@@ -91,6 +91,10 @@ SERVING_DEFAULTS = {
     "admission_budget_bytes": 16e9,
     "fair_share": True,
     "serving_stage_slots": 0,  # 0 = auto: the live worker count
+    #: query checkpoint/resume (runtime/checkpoint.py): admitted queries
+    #: snapshot completed-stage outputs onto the workers so a fresh
+    #: session's `recover()` resumes them from the staged frontier
+    "checkpointing": False,
 }
 
 
@@ -121,6 +125,9 @@ class QueryHandle:
         # reuses it), so cancel() reaches in-flight dispatches directly
         self._cancel_event = threading.Event()
         self._coordinator = None
+        # checkpoint-store record id (runtime/checkpoint.py) when the
+        # session checkpoints; pre-set by recover() for resumed queries
+        self._ckpt_record: Optional[str] = None
         # the coordinator-internal query id of the MAIN execute (stamped
         # by the driver) — the key into the distributed-tracing store,
         # isolating this handle's trace from every concurrent query's
@@ -553,6 +560,8 @@ class ServingSession:
                  admission_budget_bytes: Optional[float] = None,
                  fair_share: Optional[bool] = None,
                  stage_slots: Optional[int] = None,
+                 checkpoints=None,
+                 checkpointing: Optional[bool] = None,
                  seed: int = 0):
         from datafusion_distributed_tpu.runtime.coordinator import (
             InMemoryCluster,
@@ -561,6 +570,7 @@ class ServingSession:
             HealthPolicy,
             HealthTracker,
         )
+        from datafusion_distributed_tpu.runtime.metrics import HedgeBudget
 
         self.ctx = ctx
         self.cluster = cluster if cluster is not None else InMemoryCluster(
@@ -572,7 +582,23 @@ class ServingSession:
             "admission_budget_bytes": admission_budget_bytes,
             "fair_share": fair_share,
             "serving_stage_slots": stage_slots,
+            "checkpointing": checkpointing,
         }
+        # query checkpoint/resume (runtime/checkpoint.py): a passed
+        # ``checkpoints`` store enables it implicitly — pass the SAME
+        # store to a fresh session and `recover()` resumes whatever this
+        # one leaves unresolved (the store outlives the session on
+        # purpose: that IS the coordinator-loss recovery path)
+        if checkpoints is None and bool(self._opt_over("checkpointing")):
+            from datafusion_distributed_tpu.runtime.checkpoint import (
+                CheckpointStore,
+            )
+
+            checkpoints = CheckpointStore()
+        self.checkpoints = checkpoints
+        # one cluster-wide speculative-attempt budget shared by every
+        # per-query coordinator (the hedge stampede bound)
+        self.hedge_budget = HedgeBudget()
         # shared across every per-query coordinator: quarantine/fault/
         # latency/span state outlives any single query
         self.health = HealthTracker(HealthPolicy(
@@ -632,11 +658,14 @@ class ServingSession:
             return float(SERVING_DEFAULTS["admission_budget_bytes"])
 
     # -- submission ---------------------------------------------------------
-    def submit(self, sql: str, priority: int = 0) -> QueryHandle:
+    def submit(self, sql: str, priority: int = 0,
+               _resume: Optional[str] = None) -> QueryHandle:
         """Parse, plan, and estimate the query NOW (client thread; the
         session plan cache makes repeats cheap), then admit or queue it.
         ``priority``: higher class admits and schedules first; FIFO
-        within a class."""
+        within a class. ``_resume``: internal (recover()) — an existing
+        checkpoint-store record id this submission resumes instead of
+        registering a fresh one."""
         from datafusion_distributed_tpu.planner.statistics import (
             plan_device_bytes,
         )
@@ -659,6 +688,7 @@ class ServingSession:
         except Exception:
             est = 0  # unplannable estimate -> admit on count alone
         handle = QueryHandle(self, sql, df, priority, est)
+        handle._ckpt_record = _resume
         with self._lock:
             if self._closed:
                 # re-checked under the lock: a close() racing the
@@ -704,6 +734,11 @@ class ServingSession:
     def _start_locked(self, h: QueryHandle) -> None:
         h._state = RUNNING
         h.admitted_s = time.monotonic()
+        if self.checkpoints is not None and h._ckpt_record is None:
+            # register the admitted query in the checkpoint store NOW:
+            # from this point a coordinator/session loss leaves a
+            # recoverable record behind
+            h._ckpt_record = self.checkpoints.admit(h.sql, h.priority)
         self._admitted_total += 1
         self._running[h.query_id] = h
         self.scheduler.register_query(h.query_id, priority=h.priority)
@@ -735,6 +770,16 @@ class ServingSession:
                 sweeps(query_id)
             coord.sweep_query(query_id)
 
+        checkpointer = None
+        if self.checkpoints is not None and h._ckpt_record is not None:
+            from datafusion_distributed_tpu.runtime.checkpoint import (
+                QueryCheckpointer,
+            )
+
+            checkpointer = QueryCheckpointer(
+                self.checkpoints, h._ckpt_record,
+                resolver=self.cluster, channels=self.cluster,
+            )
         coord = Coordinator(
             resolver=self.cluster, channels=self.cluster,
             # GIL-atomic snapshot: a live `SET distributed.*` from a
@@ -748,6 +793,8 @@ class ServingSession:
             stage_pool=_QueryPool(self.scheduler, h.query_id),
             cancel_event=h._cancel_event,
             on_query_end=on_query_end,
+            hedges=self.hedge_budget,
+            checkpoints=checkpointer,
         )
         return coord
 
@@ -766,6 +813,14 @@ class ServingSession:
         except BaseException as e:
             h._finish(FAILED, error=e)
         finally:
+            if self.checkpoints is not None and h._ckpt_record is not None:
+                if h._state in (DONE, CANCELLED):
+                    # resolved: the record and its staged slices are
+                    # dead weight (and would leak) — release them.
+                    # FAILED stays recoverable: an interrupted/failed
+                    # query's completed-stage frontier is exactly what
+                    # recover() resumes from.
+                    self.checkpoints.release(h._ckpt_record, self.cluster)
             self._stamp_trace(h, coord)
             self.scheduler.unregister_query(h.query_id)
             wall = h.wall_s()
@@ -799,6 +854,51 @@ class ServingSession:
                 serving_query_id=h.query_id, priority=h.priority,
             )
 
+    # -- query recovery (runtime/checkpoint.py) ------------------------------
+    def recover(self, store=None, cluster=None) -> list:
+        """Resume every admitted-but-unresolved query recorded in
+        ``store`` (default: this session's checkpoint store) — the
+        fresh-coordinator half of checkpoint/resume. Each record's SQL
+        resubmits through normal admission at its original priority; the
+        new query's coordinator restores completed stages from the
+        checkpointed frontier (fingerprint-validated against the
+        re-planned query) and re-executes only what is missing or
+        invalid, falling back to full re-execution when nothing
+        restores. ``cluster`` is accepted for call-site symmetry with
+        the docs but must be the session's own cluster (the staged
+        slices live on its workers). -> the new QueryHandles, in record
+        order."""
+        if store is not None and store is not self.checkpoints:
+            own = self.checkpoints
+            if own is not None and own.stats()["queries"]:
+                # the session's own store already tracks queries: silently
+                # switching would orphan their records
+                raise ValueError(
+                    "recover(store=...) on a session whose own checkpoint "
+                    "store already tracks queries"
+                )
+            # adopt (an auto-created empty store — e.g. from
+            # `SET distributed.checkpointing` — is simply replaced):
+            # resumed queries re-save into the recovered store
+            self.checkpoints = store
+        store = self.checkpoints
+        if store is None:
+            return []
+        if cluster is not None and cluster is not self.cluster:
+            raise ValueError(
+                "recover() must run against the cluster holding the "
+                "checkpointed slices (the session's own cluster)"
+            )
+        handles = []
+        for rec in store.incomplete():
+            store.mark_resumed(rec.record_id)
+            self.faults.bump("queries_recovered")
+            handles.append(
+                self.submit(rec.sql, priority=rec.priority,
+                            _resume=rec.record_id)
+            )
+        return handles
+
     # -- cancellation -------------------------------------------------------
     def _cancel(self, h: QueryHandle) -> bool:
         with self._lock:
@@ -809,7 +909,15 @@ class ServingSession:
                 ))
                 self._completed[CANCELLED] += 1
                 self._admit_locked()
-                return True
+                queued_cancel = True
+            else:
+                queued_cancel = False
+        if queued_cancel:
+            if self.checkpoints is not None and h._ckpt_record is not None:
+                # a RESUMED query cancelled while still queued: its
+                # record (and staged frontier) is explicitly abandoned
+                self.checkpoints.release(h._ckpt_record, self.cluster)
+            return True
         if h.done():
             return False
         # running (or racing admission): the pre-installed cancel event
@@ -836,6 +944,9 @@ class ServingSession:
             }
         out["scheduler"] = self.scheduler.stats()
         out["latency"] = self.query_latency.summary()
+        out["hedging"] = self.hedge_budget.stats()
+        if self.checkpoints is not None:
+            out["checkpoints"] = self.checkpoints.stats()
         return out
 
     # -- lifecycle ----------------------------------------------------------

@@ -215,16 +215,37 @@ class SessionConfig:
                         "serving_stage_slots must be >= 0 (0 = auto: "
                         "the worker count)"
                     )
-            elif key in ("fair_share", "zero_copy"):
+            elif key in ("fair_share", "zero_copy", "hedging",
+                         "checkpointing"):
                 # boolean knobs: fair_share (serving scheduler policy),
                 # zero_copy (view-based data plane — `off` restores the
-                # copying plane everywhere). One shared parser so SET-time
+                # copying plane everywhere), hedging (straggler
+                # speculative re-dispatch), checkpointing (query
+                # checkpoint/resume). One shared parser so SET-time
                 # coercion and runtime reads can't drift.
                 from datafusion_distributed_tpu.ops.table import (
                     parse_bool_knob,
                 )
 
                 value = parse_bool_knob(value)
+            elif key == "hedge_quantile":
+                # hedging knobs validated at SET time like the serving
+                # admission knobs: a bad value must fail the SET, not
+                # silently disable (or stampede) the hedger mid-serve
+                value = float(value)
+                if not 0.0 <= value <= 1.0:
+                    raise ValueError("hedge_quantile must be in [0, 1]")
+            elif key == "hedge_floor_s":
+                value = float(value)
+                if value < 0:
+                    raise ValueError("hedge_floor_s must be >= 0")
+            elif key == "hedge_budget":
+                value = int(value)
+                if value < 0:
+                    raise ValueError(
+                        "hedge_budget must be >= 0 (0 disables hedging "
+                        "by denying every speculative attempt)"
+                    )
             elif key == "tracing":
                 # distributed-tracing mode (runtime/tracing.py):
                 # validated at SET time so a typo fails the SET, not the

@@ -102,6 +102,21 @@ if ! env DFTPU_LOCK_CHECK=1 python -m pytest tests/test_serving.py \
         -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
     FAILED+=("tests/test_serving.py[gate+lockcheck]")
 fi
+# Hedging + query-recovery gate (tests/test_hedging_recovery.py):
+# straggler hedging — hedge-fires-and-winner-wins byte-identity, loser
+# slice release to zero, no breaker trip on hedge loss, in-flight hedge
+# budget bound — and query checkpoint/resume: a query interrupted after
+# N completed stages resumes on a fresh coordinator/session from its
+# staged frontier byte-identically, falling back on fingerprint mismatch
+# or staged-slice loss (departed worker), zero leaked slices either way.
+# Deterministic under DFTPU_CHAOS_SEED; runs under DFTPU_LOCK_CHECK=1
+# (hedge races + checkpoint saves are cross-thread schedules).
+echo "=== tests/test_hedging_recovery.py (hedging + query-recovery gate, DFTPU_LOCK_CHECK=1)"
+if ! env DFTPU_LOCK_CHECK=1 python -m pytest tests/test_hedging_recovery.py \
+        -q --no-header \
+        -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
+    FAILED+=("tests/test_hedging_recovery.py[gate+lockcheck]")
+fi
 # Tracing gate (tests/test_tracing.py): the distributed-tracing
 # subsystem — span-tree shape for distributed TPC-H (worker spans joined
 # via cross-wire context propagation, in-process AND gRPC), retry/heal/
@@ -145,6 +160,7 @@ for f in tests/test_*.py; do
     [ "$f" = "tests/test_plan_verify.py" ] && continue  # ran above (gate)
     [ "$f" = "tests/test_stage_scheduler.py" ] && continue  # ran above
     [ "$f" = "tests/test_serving.py" ] && continue  # ran above (gate)
+    [ "$f" = "tests/test_hedging_recovery.py" ] && continue  # ran above
     [ "$f" = "tests/test_tracing.py" ] && continue  # ran above (gate)
     [ "$f" = "tests/test_elasticity.py" ] && continue  # ran above (gate)
     [ "$f" = "tests/test_data_plane.py" ] && continue  # ran above (gate)

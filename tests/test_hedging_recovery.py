@@ -1,0 +1,480 @@
+"""Tail-latency hedging + query checkpoint/resume (ISSUE 12).
+
+Contracts pinned here:
+
+- Straggler hedging: a task whose attempt outlives max(sketch-p99,
+  hedge_floor_s) speculatively re-dispatches to a different healthy
+  worker; the FIRST completed attempt wins and results stay
+  byte-identical; the loser is cancelled through the per-attempt cancel
+  plumbing and its staged TableStore slices release to zero; a hedge
+  loss marks the slow worker in HealthTracker WITHOUT advancing the
+  circuit breaker; the in-flight hedge budget bounds speculative load
+  (budget 0 disables hedging outright).
+- Chaos `kind="straggler"`: a seeded, WORKER-PINNED sticky delay (one
+  election per (query, url), every later matching call slow) — the
+  tail-latency pathology, distinct from the per-call `kind="delay"`;
+  injected delays poll the call's cancel handle in small increments so
+  cancellation latency reflects the real plumbing, not the full delay.
+- Query checkpoint/resume: completed stages snapshot their consumer
+  slices into worker TableStores (runtime/checkpoint.py); a fresh
+  coordinator/session resumes an interrupted query from the staged
+  frontier with byte-identical results; a fingerprint mismatch against
+  the re-planned query or a staged-slice loss (departed worker) falls
+  back to re-execution; resolved queries release every checkpoint slice
+  (zero leaks).
+- Determinism: the seeded straggler schedule replays identically under
+  DFTPU_CHAOS_SEED and results stay byte-identical across replays.
+
+Named gate in run_tests.sh, run under DFTPU_LOCK_CHECK=1 like the other
+concurrency-heavy gates.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from datafusion_distributed_tpu.runtime.chaos import (
+    ChaosWorker,
+    FaultPlan,
+    FaultSpec,
+    wrap_cluster,
+)
+from datafusion_distributed_tpu.runtime.checkpoint import (
+    CheckpointStore,
+    QueryCheckpointer,
+)
+from datafusion_distributed_tpu.runtime.coordinator import (
+    Coordinator,
+    DynamicCluster,
+    InMemoryCluster,
+)
+from datafusion_distributed_tpu.runtime.serving import ServingSession
+
+CHAOS_SEED = int(os.environ.get("DFTPU_CHAOS_SEED", "20260803"))
+
+# Inlined TPC-H texts (the reference checkout's testdata/ is absent in
+# this container). q6 is single-boundary (streamed coalesce), q3 the
+# bushy multi-join whose stage lattice exercises both hedge planes and
+# multi-stage checkpoints.
+TPCH_Q3 = """
+select l_orderkey,
+       sum(l_extendedprice * (1 - l_discount)) as revenue,
+       o_orderdate, o_shippriority
+from customer, orders, lineitem
+where c_mktsegment = 'BUILDING'
+  and c_custkey = o_custkey
+  and l_orderkey = o_orderkey
+  and o_orderdate < date '1995-03-15'
+  and l_shipdate > date '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by revenue desc, o_orderdate
+limit 10
+"""
+
+TPCH_Q6 = """
+select sum(l_extendedprice * l_discount) as revenue
+from lineitem
+where l_shipdate >= date '1994-01-01'
+  and l_shipdate < date '1995-01-01'
+  and l_discount between 0.05 and 0.07
+  and l_quantity < 24
+"""
+
+MIX = {"q3": TPCH_Q3, "q6": TPCH_Q6}
+
+
+@pytest.fixture(scope="module")
+def tpch_ctx():
+    from datafusion_distributed_tpu.data.tpchgen import gen_tpch
+    from datafusion_distributed_tpu.sql.context import SessionContext
+
+    ctx = SessionContext()
+    ctx.config.distributed_options["bytes_per_task"] = 1  # force fan-out
+    ctx.config.distributed_options["broadcast_joins"] = False
+    ctx.config.distributed_options["task_retry_backoff_s"] = 0.001
+    for name, arrow in gen_tpch(sf=0.002, seed=7).items():
+        ctx.register_arrow(name, arrow)
+    return ctx
+
+
+@pytest.fixture(scope="module")
+def reference(tpch_ctx):
+    """name -> pandas frame from plain sequential coordinated runs."""
+    out = {}
+    for name, sql in MIX.items():
+        out[name] = tpch_ctx.sql(sql).collect_coordinated(
+            coordinator=_coord(InMemoryCluster(4)), num_tasks=4
+        ).to_pandas()
+    return out
+
+
+def _coord(cluster, **opts):
+    return Coordinator(
+        resolver=cluster, channels=cluster,
+        config_options={"bytes_per_task": 1, "broadcast_joins": False,
+                        "task_retry_backoff_s": 0.001, **opts},
+    )
+
+
+def _hedge_opts(**over):
+    """Hedging on with a floor far below the injected straggler delay."""
+    return {"hedging": True, "hedge_floor_s": 0.05, "hedge_budget": 4,
+            **over}
+
+
+def _straggler_plan(seed=CHAOS_SEED, delay_s=0.4, workers=("worker-1",),
+                    query_scoped=True):
+    return FaultPlan(seed, [
+        FaultSpec(site="execute", kind="straggler", delay_s=delay_s,
+                  workers=list(workers), rate=1.0),
+    ], query_scoped=query_scoped)
+
+
+def _assert_no_leaks(cluster):
+    for w in cluster.workers.values():
+        assert not w.table_store.tables, (
+            f"{w.url} leaked TableStore entries: "
+            f"{list(w.table_store.tables)[:4]}"
+        )
+        assert len(w.registry) == 0, f"{w.url} leaked registry entries"
+
+
+def _assert_frames_identical(got, base, label=""):
+    assert list(got.columns) == list(base.columns), label
+    for col in base.columns:
+        np.testing.assert_array_equal(
+            got[col].to_numpy(), base[col].to_numpy(),
+            err_msg=f"{label}.{col} diverged",
+        )
+
+
+# ---------------------------------------------------------------------------
+# chaos: sticky straggler + interruptible delays
+# ---------------------------------------------------------------------------
+
+
+class _DummyWorker:
+    url = "mem://dummy-0"
+
+    def execute_task(self, key):
+        return "ok"
+
+
+class _Key:
+    query_id, stage_id, task_number = "q", 0, 0
+
+
+def test_straggler_election_sticky_and_seeded():
+    """One seeded election per (query, url); every later matching call
+    is slow; the fired log records the ELECTION once, not every call."""
+    plan = _straggler_plan(seed=11, delay_s=0.05, workers=("dummy",))
+    w = ChaosWorker(_DummyWorker(), plan)
+    t0 = time.monotonic()
+    for _ in range(3):
+        w.execute_task(_Key())
+    wall = time.monotonic() - t0
+    assert wall >= 0.14, f"3 calls on a straggler took only {wall:.3f}s"
+    assert [f["kind"] for f in plan.fired] == ["straggler"]
+    # same seed -> same election; different seed space stays per-url
+    plan2 = _straggler_plan(seed=11, delay_s=0.05, workers=("dummy",))
+    ChaosWorker(_DummyWorker(), plan2).execute_task(_Key())
+    assert [f["url"] for f in plan2.fired] == [
+        f["url"] for f in plan.fired
+    ]
+    # sub-rate election is deterministic in the seed
+    a = FaultPlan(3, [FaultSpec(site="execute", kind="straggler",
+                                delay_s=0.0, rate=0.5)])
+    b = FaultPlan(3, [FaultSpec(site="execute", kind="straggler",
+                                delay_s=0.0, rate=0.5)])
+    for p in (a, b):
+        for i in range(6):
+            class K:
+                query_id, stage_id, task_number = "q", 0, i
+
+            ChaosWorker(type("W", (), {
+                "url": f"mem://w-{i}",
+                "execute_task": lambda self, key: None,
+            })(), p).execute_task(K())
+    assert [f["url"] for f in a.fired] == [f["url"] for f in b.fired]
+
+
+def test_injected_delay_polls_cancel():
+    """A cancelled call stuck in an injected delay aborts at cancel
+    latency, not after the full delay (the hedge loser's release path)."""
+    plan = FaultPlan(CHAOS_SEED, [
+        FaultSpec(site="execute", kind="delay", delay_s=2.0, rate=1.0),
+    ])
+    w = ChaosWorker(_DummyWorker(), plan)
+    ev = threading.Event()
+    walls = {}
+
+    def call():
+        t0 = time.monotonic()
+        w.execute_task(_Key(), cancel=ev)
+        walls["wall"] = time.monotonic() - t0
+
+    t = threading.Thread(target=call)
+    t.start()
+    time.sleep(0.1)
+    ev.set()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert walls["wall"] < 1.0, (
+        f"cancelled delay held its slot {walls['wall']:.2f}s"
+    )
+
+
+# ---------------------------------------------------------------------------
+# straggler hedging
+# ---------------------------------------------------------------------------
+
+
+def test_hedge_fires_winner_wins_byte_identical(tpch_ctx, reference):
+    """One sticky straggler, hedging on: the hedge arm fires, results
+    stay byte-identical, and the loser's staged slices release to zero
+    once the query resolves."""
+    plan = _straggler_plan()
+    chaos = wrap_cluster(InMemoryCluster(4), plan)
+    coord = _coord(chaos, **_hedge_opts())
+    for name in ("q6", "q3"):
+        got = tpch_ctx.sql(MIX[name]).collect_coordinated(
+            coordinator=coord, num_tasks=4
+        ).to_pandas()
+        _assert_frames_identical(got, reference[name], f"hedged/{name}")
+    fc = coord.faults.as_dict()
+    assert fc.get("hedges_issued", 0) >= 1, fc
+    assert fc.get("hedges_won", 0) + fc.get("hedges_lost", 0) >= 1, fc
+    assert {f["kind"] for f in plan.fired} == {"straggler"}
+    # loser slice release to zero: every attempt's staged state is gone
+    _assert_no_leaks(chaos.inner)
+
+
+def test_hedge_loss_never_trips_breaker(tpch_ctx, reference):
+    """The straggler takes hedge-loss marks, NOT failures: its breaker
+    stays closed and nothing quarantines."""
+    plan = _straggler_plan()
+    chaos = wrap_cluster(InMemoryCluster(4), plan)
+    coord = _coord(chaos, **_hedge_opts())
+    got = tpch_ctx.sql(TPCH_Q3).collect_coordinated(
+        coordinator=coord, num_tasks=4
+    ).to_pandas()
+    _assert_frames_identical(got, reference["q3"], "breaker/q3")
+    fc = coord.faults.as_dict()
+    assert fc.get("hedges_issued", 0) >= 1, fc
+    assert fc.get("workers_quarantined", 0) == 0, fc
+    snap = coord.health.snapshot() if coord.health is not None else {}
+    for url, s in snap.items():
+        assert s["state"] == "closed", (url, s)
+    assert any(s.get("hedge_losses", 0) >= 1 for s in snap.values()), snap
+    _assert_no_leaks(chaos.inner)
+
+
+def test_hedge_budget_bound(tpch_ctx, reference):
+    """Budget 0 denies every speculative attempt (hedging effectively
+    off); budget 1 bounds in-flight hedges to one at any instant."""
+    # budget 0: no hedge ever issues, the straggler is simply waited out
+    chaos = wrap_cluster(InMemoryCluster(4), _straggler_plan(delay_s=0.2))
+    coord = _coord(chaos, **_hedge_opts(hedge_budget=0))
+    got = tpch_ctx.sql(TPCH_Q6).collect_coordinated(
+        coordinator=coord, num_tasks=4
+    ).to_pandas()
+    _assert_frames_identical(got, reference["q6"], "budget0/q6")
+    fc = coord.faults.as_dict()
+    assert fc.get("hedges_issued", 0) == 0, fc
+    assert fc.get("hedge_budget_denied", 0) >= 1, fc
+    _assert_no_leaks(chaos.inner)
+    # budget 1: hedges issue but never two in flight
+    chaos = wrap_cluster(InMemoryCluster(4), _straggler_plan())
+    coord = _coord(chaos, **_hedge_opts(hedge_budget=1))
+    got = tpch_ctx.sql(TPCH_Q3).collect_coordinated(
+        coordinator=coord, num_tasks=4
+    ).to_pandas()
+    _assert_frames_identical(got, reference["q3"], "budget1/q3")
+    assert coord.faults.get("hedges_issued") >= 1
+    assert coord.hedges is not None
+    assert coord.hedges.peak_in_flight <= 1, coord.hedges.stats()
+    _assert_no_leaks(chaos.inner)
+
+
+def test_hedging_deterministic_under_seed(tpch_ctx, reference):
+    """Two runs under the same DFTPU_CHAOS_SEED elect the same straggler
+    schedule and produce byte-identical results."""
+    fired = []
+    for _run in range(2):
+        plan = _straggler_plan()
+        chaos = wrap_cluster(InMemoryCluster(4), plan)
+        coord = _coord(chaos, **_hedge_opts())
+        got = tpch_ctx.sql(TPCH_Q3).collect_coordinated(
+            coordinator=coord, num_tasks=4
+        ).to_pandas()
+        _assert_frames_identical(got, reference["q3"], "determinism/q3")
+        fired.append(sorted(
+            (f["kind"], f["url"]) for f in plan.fired
+        ))
+        _assert_no_leaks(chaos.inner)
+    assert fired[0] == fired[1], fired
+
+
+# ---------------------------------------------------------------------------
+# query checkpoint/resume
+# ---------------------------------------------------------------------------
+
+#: kills the ROOT stage's only attempt — the query dies AFTER its
+#: producer stages completed (and checkpointed), the mid-query teardown
+_ROOT_CRASH = [FaultSpec(site="execute", kind="crash", stages=[-1],
+                         rate=1.0)]
+
+
+def _run_to_failure(tpch_ctx, cluster, store, rid, sql):
+    chaos = wrap_cluster(cluster, FaultPlan(CHAOS_SEED, _ROOT_CRASH))
+    c1 = _coord(chaos, peer_shuffle=False, max_task_retries=0)
+    c1.checkpoints = QueryCheckpointer(store, rid, chaos, chaos)
+    with pytest.raises(Exception):
+        tpch_ctx.sql(sql).collect_coordinated(coordinator=c1, num_tasks=4)
+    return c1
+
+
+def test_checkpoint_resume_mid_query_byte_identical(tpch_ctx, reference):
+    """A query interrupted after N completed stages resumes on a FRESH
+    coordinator from the staged frontier: all N stages restore (zero
+    re-execution), the result is byte-identical to an uninterrupted run,
+    and releasing the record leaves zero staged slices."""
+    inner = InMemoryCluster(4)
+    store = CheckpointStore()
+    rid = store.admit(TPCH_Q3)
+    c1 = _run_to_failure(tpch_ctx, inner, store, rid, TPCH_Q3)
+    saved = c1.faults.get("checkpoint_stages_saved")
+    assert saved >= 2, c1.faults.as_dict()
+    assert store.stats()["recoverable"] == 1
+    # fresh coordinator, same cluster: the coordinator-loss resume
+    c2 = _coord(inner, peer_shuffle=False)
+    c2.checkpoints = QueryCheckpointer(store, rid, inner, inner)
+    got = tpch_ctx.sql(TPCH_Q3).collect_coordinated(
+        coordinator=c2, num_tasks=4
+    ).to_pandas()
+    _assert_frames_identical(got, reference["q3"], "resume/q3")
+    fc = c2.faults.as_dict()
+    assert fc.get("checkpoint_stages_restored") == saved, fc
+    assert fc.get("queries_resumed") == 1, fc
+    store.release(rid, inner)
+    _assert_no_leaks(inner)
+
+
+def test_resume_fingerprint_mismatch_falls_back(tpch_ctx, reference):
+    """A re-planned query whose stages fingerprint differently (here:
+    a different task lattice) restores NOTHING and re-executes fully —
+    still byte-identical for its own plan."""
+    inner = InMemoryCluster(4)
+    store = CheckpointStore()
+    rid = store.admit(TPCH_Q3)
+    _run_to_failure(tpch_ctx, inner, store, rid, TPCH_Q3)
+    # resume with num_tasks=2: same SQL, different exchange lattice
+    base2 = tpch_ctx.sql(TPCH_Q3).collect_coordinated(
+        coordinator=_coord(InMemoryCluster(4), peer_shuffle=False),
+        num_tasks=2,
+    ).to_pandas()
+    c2 = _coord(inner, peer_shuffle=False)
+    c2.checkpoints = QueryCheckpointer(store, rid, inner, inner)
+    got = tpch_ctx.sql(TPCH_Q3).collect_coordinated(
+        coordinator=c2, num_tasks=2
+    ).to_pandas()
+    _assert_frames_identical(got, base2, "fp-mismatch/q3")
+    _assert_frames_identical(got, reference["q3"], "fp-mismatch/ref")
+    fc = c2.faults.as_dict()
+    assert fc.get("checkpoint_stages_restored", 0) == 0, fc
+    assert fc.get("checkpoint_fp_mismatch", 0) >= 1, fc
+    store.release(rid, inner)
+    _assert_no_leaks(inner)
+
+
+def test_resume_after_membership_churn_falls_back(tpch_ctx, reference):
+    """A worker holding checkpointed slices departs between teardown and
+    resume: the affected stages fall back to re-execution (slice-loss
+    counter), surviving stages still restore, the result stays
+    byte-identical, zero leaks."""
+    cluster = DynamicCluster(4)
+    store = CheckpointStore()
+    rid = store.admit(TPCH_Q3)
+    _run_to_failure(tpch_ctx, cluster, store, rid, TPCH_Q3)
+    # depart a worker that holds at least one checkpoint slice
+    rec = store._records[rid]
+    held = sorted({
+        url for ck in rec.stages.values() for url, _t, _n in ck.slices
+    })
+    assert held, "no checkpointed slices to lose"
+    cluster.remove_worker(held[0])
+    c2 = _coord(cluster, peer_shuffle=False)
+    c2.checkpoints = QueryCheckpointer(store, rid, cluster, cluster)
+    got = tpch_ctx.sql(TPCH_Q3).collect_coordinated(
+        coordinator=c2, num_tasks=4
+    ).to_pandas()
+    _assert_frames_identical(got, reference["q3"], "churn-resume/q3")
+    fc = c2.faults.as_dict()
+    assert fc.get("checkpoint_slices_lost", 0) >= 1, fc
+    store.release(rid, cluster)
+    _assert_no_leaks(cluster)
+
+
+def test_serving_recover_after_teardown(tpch_ctx, reference):
+    """The serving-tier acceptance flow: a query admitted by session 1
+    is interrupted (coordinator teardown), the CheckpointStore survives,
+    and session 2's recover() completes it from the staged frontier with
+    a byte-identical result and zero leaked slices."""
+    inner = InMemoryCluster(4)
+    store = CheckpointStore()
+    opts = tpch_ctx.config.distributed_options
+    opts["max_task_retries"] = 0
+    opts["peer_shuffle"] = False
+    try:
+        chaos = wrap_cluster(inner, FaultPlan(
+            CHAOS_SEED, [FaultSpec(site="execute", kind="crash",
+                                   stages=[-1], rate=1.0, max_total=1)],
+        ))
+        srv1 = ServingSession(tpch_ctx, cluster=chaos, num_tasks=4,
+                              checkpoints=store)
+        h1 = srv1.submit(TPCH_Q3)
+        with pytest.raises(Exception):
+            h1.result(timeout=300)
+        srv1.close()  # the teardown: the store outlives the session
+    finally:
+        opts.pop("max_task_retries", None)
+    st = store.stats()
+    assert st["recoverable"] == 1 and st["stages"] >= 1, st
+    try:
+        srv2 = ServingSession(tpch_ctx, cluster=inner, num_tasks=4,
+                              checkpoints=store)
+        handles = srv2.recover()
+        assert len(handles) == 1
+        got = handles[0].result(timeout=300).to_pandas()
+        _assert_frames_identical(got, reference["q3"], "recover/q3")
+        fc = srv2.faults.as_dict()
+        assert fc.get("queries_recovered") == 1, fc
+        assert fc.get("checkpoint_stages_restored", 0) >= 1, fc
+        srv2.close()
+    finally:
+        opts.pop("peer_shuffle", None)
+    # resolved: record released, store drained, zero leaks
+    assert store.stats()["recoverable"] == 0, store.stats()
+    assert store.stats()["staged_bytes"] == 0, store.stats()
+    _assert_no_leaks(inner)
+
+
+def test_serving_done_and_cancelled_release_checkpoints(tpch_ctx):
+    """Resolved queries (DONE or CANCELLED) never leave checkpoint
+    records or staged slices behind."""
+    inner = InMemoryCluster(4)
+    store = CheckpointStore()
+    opts = tpch_ctx.config.distributed_options
+    opts["peer_shuffle"] = False
+    try:
+        with ServingSession(tpch_ctx, cluster=inner, num_tasks=4,
+                            checkpoints=store) as srv:
+            h = srv.submit(TPCH_Q6)
+            h.result(timeout=300)
+            assert store.stats()["queries"] == 0, store.stats()
+    finally:
+        opts.pop("peer_shuffle", None)
+    _assert_no_leaks(inner)

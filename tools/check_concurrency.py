@@ -122,10 +122,15 @@ _BLOCKING_EXACT = {
     "time.sleep", "cf.wait", "futures.wait", "concurrent.futures.wait",
 }
 #: last-attribute names that block regardless of receiver (rule 205):
-#: the worker RPC dispatch surface + XLA compile entry points
+#: the worker RPC dispatch surface + XLA compile entry points + the
+#: hedge-dispatch entry points (runtime/coordinator.py straggler
+#: hedging: each spawns/awaits speculative RPC attempts — a hedge issued
+#: under a lock would stall every contending thread for a full race)
 _BLOCKING_TAIL = {
     "set_plan", "set_stage_plan", "execute_task", "execute_task_stream",
     "execute_task_partitions", "execute_plan", "block_until_ready",
+    "_execute_attempt", "_dispatch_hedge", "_hedged_execute",
+    "_hedged_first_chunk",
 }
 #: receiver hints for ``.wait()`` / ``.result()`` blocking calls — an
 #: ``Event.wait`` or ``Future.result`` under a lock stalls every other
