@@ -496,6 +496,25 @@ limit 100
 """
 
 
+def _merge_serving_detail(serving: dict) -> None:
+    """Upsert ``meta.serving`` into BENCH_DETAIL.json (creating a
+    minimal document when the suite bench has not run) so `bench.py
+    --serving` results are diffable by tools/bench_compare.py alongside
+    the per-query walls."""
+    try:
+        with open(_DETAIL) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        doc = {"suite": "serving-only", "per_query_s": {}, "failed": {},
+               "meta": {}}
+    doc.setdefault("meta", {})["serving"] = serving
+    try:
+        with open(_DETAIL, "w") as f:
+            json.dump(doc, f, indent=1)
+    except OSError:
+        pass
+
+
 def _serving_bench() -> None:
     platform = os.environ.get("BENCH_PLATFORM", "cpu")
     os.environ.setdefault("JAX_PLATFORMS", platform)
@@ -521,12 +540,17 @@ def _serving_bench() -> None:
     iters = int(os.environ.get("BENCH_SERVING_ITERS", "2"))
     delay_ms = float(os.environ.get("BENCH_SERVING_DELAY_MS", "80"))
     straggler_ms = float(os.environ.get("BENCH_STRAGGLER_MS", "800"))
+    # SLO target for the closed-loop arms (runtime/telemetry.py
+    # SloTracker): attainment against this p99 target rides into
+    # BENCH_DETAIL meta.serving so bench_compare.py can diff it
+    slo_p99_ms = float(os.environ.get("BENCH_SLO_P99_MS", "2000"))
     workers = 4
 
     t0 = time.perf_counter()
     ctx = SessionContext()
     ctx.config.distributed_options["bytes_per_task"] = 1
     ctx.config.distributed_options["broadcast_joins"] = False
+    ctx.config.distributed_options["slo_p99_ms"] = slo_p99_ms
     for name, arrow in gen_tpch(sf=sf, seed=0).items():
         ctx.register_arrow(name, arrow)
     print(f"serving bench: registered tpch sf{sf} in "
@@ -563,6 +587,7 @@ def _serving_bench() -> None:
             classify=lambda ci: "heavy" if ci == 0 else "cheap",
             timeout=1800.0,
         )
+        slo = srv.slo_snapshot()
         srv.close()
         if res["errors"]:
             print(f"serving bench errors: {res['errors']}",
@@ -577,6 +602,9 @@ def _serving_bench() -> None:
             "cheap_p99_ms": percentile_ms(cheap, 0.99),
             "heavy_max_ms": percentile_ms(heavy, 0.99),
             "errors": len(res["errors"]),
+            # rolling SLO attainment vs BENCH_SLO_P99_MS (telemetry.py)
+            "slo_latency_attainment": slo.get("latency_attainment"),
+            "slo_p99_ok": slo.get("p99_ok"),
         }
 
     # ---- injected-straggler arm (the ROADMAP serving-hardening gate):
@@ -675,6 +703,35 @@ def _serving_bench() -> None:
               "platform": platform}
     print(json.dumps({"serving_detail": detail}), file=sys.stderr,
           flush=True)
+    # fold the comparable numbers into BENCH_DETAIL meta.serving (flat,
+    # bench_compare.py's serving section reads these keys) instead of
+    # living only in stdout metric lines — the bench trajectory becomes
+    # machine-diffable run over run
+    _merge_serving_detail({
+        "qps": fair["qps"],
+        "qps_sequential": seq["qps"],
+        "qps_fifo": fifo["qps"],
+        "cheap_p50_ms": fair["cheap_p50_ms"],
+        "cheap_p99_ms": fair["cheap_p99_ms"],
+        "cheap_p99_ms_fifo": fifo["cheap_p99_ms"],
+        "heavy_max_ms": fair["heavy_max_ms"],
+        "straggler_p99_ms_off": straggler_off["p99_ms"],
+        "straggler_p99_ms_on": straggler_on["p99_ms"],
+        "slo_p99_target_ms": slo_p99_ms,
+        "slo_latency_attainment": fair["slo_latency_attainment"],
+        "clients": clients, "sf": sf, "delay_ms": delay_ms,
+        "straggler_ms": straggler_ms, "platform": platform,
+        # just the three arm dicts: the config scalars live at the top
+        # level only (one copy, nothing for consumers to special-case)
+        "arms": {"sequential": seq, "fifo": fifo, "fair": fair},
+    })
+    if fair["slo_latency_attainment"] is not None:
+        print(json.dumps({
+            "metric": f"serving_slo_attainment_{clients}clients",
+            "value": round(fair["slo_latency_attainment"], 4),
+            "unit": "fraction",
+            "vs_baseline": 0.0,
+        }), flush=True)
     # cheap-query p99 with the heavy q21 alongside: fair share must keep
     # it bounded vs FIFO (lower is better; vs_baseline = fifo/fair, >1
     # means fair share improved tail latency)
@@ -833,6 +890,17 @@ def main() -> None:
     # keep the unsuffixed metric name); "cpu" slot = the fallback child
     state = {"tpu": {}, "cpu": {}, "tpu_warm": {}, "cpu_warm": {},
              "failed": {}, "meta": {}}
+    # carry the previous run's meta.serving forward: the suite bench
+    # rewrites BENCH_DETAIL.json wholesale, and losing the serving block
+    # `bench.py --serving` upserted would silently skip every serving
+    # comparison in tools/bench_compare.py
+    try:
+        with open(_DETAIL) as f:
+            _prev_meta = json.load(f).get("meta")
+        if isinstance(_prev_meta, dict) and "serving" in _prev_meta:
+            state["meta"]["serving"] = _prev_meta["serving"]
+    except (OSError, json.JSONDecodeError):
+        pass
 
     def current_report():
         if state["tpu"]:

@@ -39,33 +39,94 @@ from datafusion_distributed_tpu.runtime.tracing import (  # noqa: E402
 class Console:
     def __init__(self, resolver, channels, poll_s: float = 0.5,
                  out=None, health=None, serving=None, faults=None,
-                 checkpoints=None):
+                 checkpoints=None, telemetry=None):
         # ``health``: a coordinator's HealthTracker — wiring it in joins
         # circuit-breaker state into the membership rows below.
         # ``serving``: a runtime/serving.py ServingSession — wiring it in
         # adds the multi-query tier's active/queued/admitted line.
         # ``faults``/``checkpoints``: a coordinator's FaultCounters and a
         # runtime/checkpoint.py CheckpointStore — wiring either adds the
-        # robustness line (hedge + checkpoint/resume counters)
+        # robustness line (hedge + checkpoint/resume counters).
+        # ``telemetry``: a runtime/telemetry.py MetricRegistry merged
+        # into the cluster metrics surface (defaults to the serving
+        # session's registry when one is wired)
         self.obs = ObservabilityService(resolver, channels, health=health,
                                         serving=serving,
                                         fault_counters=faults,
-                                        checkpoints=checkpoints)
+                                        checkpoints=checkpoints,
+                                        telemetry=telemetry)
         self.poll_s = poll_s
         self.out = out or sys.stdout
         self.tracked_keys: list = []  # TaskKeys to poll progress for
+        # time-series ring feeding the sparkline columns: a wired
+        # serving session SHARES its ring (its per-query samples and
+        # this console's per-frame samples land in one history — the
+        # session's registry series and the frame-derived qps/p99/
+        # staged/fault values merge per point); standalone consoles
+        # keep a local ring sampled once per rendered frame
+        from datafusion_distributed_tpu.runtime.telemetry import (
+            TelemetryHistory,
+        )
+
+        shared = getattr(serving, "history", None)
+        # explicit None test: an EMPTY shared ring is len()-falsy but
+        # still the ring to share
+        self.history = shared if shared is not None else (
+            TelemetryHistory(capacity=240, resolution_s=max(poll_s, 0.1))
+        )
 
     def track(self, keys) -> None:
         self.tracked_keys = list(keys)
 
+    @staticmethod
+    def _section(lines: list, label: str, fn) -> None:
+        """Degrade PER LINE: a failing store/worker/panel renders a dim
+        error line instead of aborting the whole refresh loop (the
+        console must stay useful exactly when parts of the cluster are
+        broken)."""
+        try:
+            fn()
+        except Exception as e:
+            lines.append(
+                f"{_DIM}{label} unavailable: "
+                f"{str(e)[:60] or type(e).__name__}{_RESET}"
+            )
+
     def render_frame(self) -> str:
-        """One frame of the display (separated from run() for testing)."""
+        """One frame of the display (separated from run() for testing).
+        Every panel degrades independently (`_section`): an empty or
+        partially broken store renders its line as unavailable and the
+        remaining panels still draw."""
         lines = []
         lines.append(
             f"{_BOLD}datafusion-distributed-tpu cluster console{_RESET}  "
             f"{_DIM}{time.strftime('%H:%M:%S')}{_RESET}"
         )
+        shared: dict = {}
+        self._section(lines, "workers",
+                      lambda: self._render_workers(lines, shared))
+        self._section(lines, "serving",
+                      lambda: self._render_serving(lines, shared))
+        self._section(lines, "robustness",
+                      lambda: self._render_robustness(lines))
+        self._section(lines, "data plane",
+                      lambda: self._render_data_plane(lines, shared))
+        self._section(lines, "telemetry",
+                      lambda: self._render_telemetry(lines, shared))
+        self._section(lines, "tracing",
+                      lambda: self._render_tracing(lines))
+        self._section(lines, "tasks",
+                      lambda: self._render_tasks(lines))
+        sm = sample_system_metrics()
+        lines.append(
+            f"\n{_DIM}console rss={_fmt_bytes(sm.rss_bytes)} "
+            f"cpu={sm.cpu_seconds:.1f}s{_RESET}"
+        )
+        return "\n".join(lines)
+
+    def _render_workers(self, lines: list, shared: dict) -> None:
         workers = self.obs.get_cluster_workers()
+        shared["workers"] = workers
         mem = self.obs.get_membership()
         health = {
             w["url"]: w.get("health", {})
@@ -119,7 +180,12 @@ class Console:
             if isinstance(st, dict):
                 for k in dp:
                     dp[k] += int(st.get(k, 0))
+        shared["dp"] = dp
+
+    def _render_serving(self, lines: list, shared: dict) -> None:
+        dp = shared.get("dp", {})
         srv = self.obs.get_serving_stats()
+        shared["srv"] = srv
         if srv and "error" not in srv:
             comp = srv.get("completed", {})
             lat = srv.get("latency", {}) or {}
@@ -146,6 +212,37 @@ class Console:
             if p99 is not None:
                 line += f"  {_DIM}p99 {p99 * 1e3:.0f}ms{_RESET}"
             lines.append(line)
+            # SLO line (runtime/telemetry.py SloTracker via serving
+            # stats): only rendered once a target is declared
+            slo = srv.get("slo") or {}
+            if slo.get("p99_target_ms") is not None or (
+                slo.get("error_rate_target") is not None
+            ):
+                segments = []
+                att = slo.get("latency_attainment")
+                if slo.get("p99_target_ms") is not None:
+                    ok = slo.get("p99_ok")
+                    # ok is None while the window is empty (idle tier):
+                    # that is "no data", not a breach
+                    verdict = ("no data" if ok is None
+                               else "OK" if ok else "BREACH")
+                    seg = (
+                        f"p99 {slo.get('p99_ms') or 0:.0f}ms vs "
+                        f"{slo['p99_target_ms']:.0f}ms target "
+                        f"[{verdict}]"
+                    )
+                    if att is not None:
+                        seg += f", attainment {att * 100:.1f}%"
+                    segments.append(seg)
+                burn = slo.get("error_budget_burn")
+                if burn is not None:
+                    segments.append(f"error-budget burn {burn:.2f}x")
+                lines.append(
+                    f"{_BOLD}slo{_RESET}      " + ", ".join(segments)
+                    + f"  {_DIM}window {slo.get('window_n', 0)}q{_RESET}"
+                )
+
+    def _render_robustness(self, lines: list) -> None:
         rb = self.obs.get_robustness()
         hed = rb.get("hedging", {})
         ckpt = rb.get("checkpoint", {})
@@ -169,6 +266,9 @@ class Console:
                     f"staged{_RESET}"
                 )
             lines.append(line)
+
+    def _render_data_plane(self, lines: list, shared: dict) -> None:
+        dp = shared.get("dp", {})
         if dp.get("entries") or dp.get("peak_nbytes"):
             lines.append(
                 f"\n{_BOLD}data plane{_RESET}  staged "
@@ -178,6 +278,52 @@ class Console:
                 f"{dp.get('dedup_hits', 0)} dedup)  "
                 f"{_DIM}peak {_fmt_bytes(dp.get('peak_nbytes', 0))}{_RESET}"
             )
+
+    def _render_telemetry(self, lines: list, shared: dict) -> None:
+        """Sparkline columns over the console-local history ring: qps
+        and fault rate as counter RATES, p99 and staged bytes as point
+        values — the at-a-glance trend row the flat counters above
+        cannot show."""
+        srv = shared.get("srv") or {}
+        dp = shared.get("dp", {})
+        comp = srv.get("completed", {}) or {}
+        lat = srv.get("latency", {}) or {}
+        faults = self.obs.get_fault_counters()
+        self.history.sample(None, extra={
+            "queries_done": sum(comp.values()) if comp else None,
+            "p99_ms": (lat.get("p99") * 1e3
+                       if lat.get("p99") is not None else None),
+            "staged_bytes": dp.get("nbytes"),
+            "faults": sum(faults.values()) if faults else 0,
+        })
+        if len(self.history) < 2:
+            return  # nothing to trend yet (first frame / empty tier)
+        cols = []
+        qps = self.history.rate("queries_done")
+        spark = self.history.sparkline("queries_done", as_rate=True)
+        if spark:
+            cols.append(f"qps {spark} {qps if qps is not None else 0:.2f}/s")
+        spark = self.history.sparkline("p99_ms")
+        if spark:
+            cols.append(
+                f"p99 {spark} {self.history.latest('p99_ms'):.0f}ms"
+            )
+        spark = self.history.sparkline("staged_bytes")
+        if spark:
+            cols.append(
+                "staged "
+                f"{spark} {_fmt_bytes(self.history.latest('staged_bytes'))}"
+            )
+        spark = self.history.sparkline("faults", as_rate=True)
+        if spark:
+            fr = self.history.rate("faults")
+            cols.append(f"faults {spark} {fr if fr is not None else 0:.2f}/s")
+        if cols:
+            lines.append(
+                f"\n{_BOLD}telemetry{_RESET}  " + "  ".join(cols)
+            )
+
+    def _render_tracing(self, lines: list) -> None:
         ts = self.obs.get_trace_summary()
         if ts and not ts.get("error") and ts.get("traces"):
             line = (
@@ -200,6 +346,8 @@ class Console:
                     f"{k}={faults[k]}" for k in sorted(faults)
                 ) + _RESET
             lines.append(line)
+
+    def _render_tasks(self, lines: list) -> None:
         if self.tracked_keys:
             prog = self.obs.get_task_progress(self.tracked_keys)
             lines.append(f"\n{_BOLD}tasks ({len(prog)}){_RESET}")
@@ -208,12 +356,6 @@ class Console:
                     f"  {key}  rows={p.get('output_rows', '?')} "
                     f"worker={p.get('worker', '?')}"
                 )
-        sm = sample_system_metrics()
-        lines.append(
-            f"\n{_DIM}console rss={_fmt_bytes(sm.rss_bytes)} "
-            f"cpu={sm.cpu_seconds:.1f}s{_RESET}"
-        )
-        return "\n".join(lines)
 
     def run(self, frames: Optional[int] = None) -> None:
         """Redraw loop; frames=None runs until interrupted."""

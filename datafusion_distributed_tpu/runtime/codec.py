@@ -346,6 +346,35 @@ class TableStore:
                 "dedup_hits": self.dedup_hits,
             }
 
+    def telemetry_families(self) -> list:
+        """Typed-registry adapter (runtime/telemetry.py): the staged-byte
+        accounting as uniformly named gauges/counters, sampled at
+        snapshot time — the `get_metrics` face of the numbers `stats()`
+        already keeps (one source of truth, two surfaces)."""
+        from datafusion_distributed_tpu.runtime.telemetry import family
+
+        s = self.stats()
+        return [
+            family("dftpu_store_staged_bytes", "gauge",
+                   "Live owned bytes staged in the table store "
+                   "(shared buffers counted once).",
+                   [({}, s["nbytes"])]),
+            family("dftpu_store_entries", "gauge",
+                   "Staged entries (owners + views/aliases).",
+                   [({}, s["entries"])]),
+            family("dftpu_store_views", "gauge",
+                   "Zero-copy view/alias entries sharing an owner's "
+                   "buffers.", [({}, s["views"])]),
+            family("dftpu_store_peak_bytes", "gauge",
+                   "High-water mark of owned staged bytes.",
+                   [({}, s["peak_nbytes"])]),
+            family("dftpu_store_puts", "counter",
+                   "Entries ever staged.", [({}, s["puts"])]),
+            family("dftpu_store_dedup_hits", "counter",
+                   "Identity-dedup hits (zero-byte aliases).",
+                   [({}, s["dedup_hits"])]),
+        ]
+
 
 def collect_table_ids(plan_obj: dict) -> list[str]:
     """All shipment-store ids referenced by an encoded plan."""

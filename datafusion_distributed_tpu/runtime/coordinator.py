@@ -452,6 +452,19 @@ class Coordinator:
         site stays a plain call, never a branch tree."""
         return getattr(self, "_tracer", NULL_TRACER)
 
+    def _event(self, name: str, **attrs) -> None:
+        """One fault-path transition, fanned to BOTH sinks: the query's
+        trace-event stream (visible when `SET distributed.tracing` is
+        on) and the always-on structured event log
+        (runtime/eventlog.py), stamped with this query's id — so logs,
+        traces, and the `dftpu_faults` counters correlate on the same
+        query/stage/task ids instead of the old trace-only asymmetry."""
+        self._tr().event(name, **attrs)
+        from datafusion_distributed_tpu.runtime.eventlog import log_event
+
+        log_event(name, query_id=getattr(self, "last_query_id", None),
+                  **attrs)
+
     def last_query_trace(self):
         """The most recent query's QueryTrace on this coordinator (None
         without tracing). Naming convention across surfaces:
@@ -775,7 +788,7 @@ class Coordinator:
         if tok == getattr(self, "_membership_seen", None):
             return tok
         self._membership_seen = tok
-        self._tr().event(
+        self._event(
             "membership_change",
             epoch=tok[1] if tok[0] == "epoch" else None,
         )
@@ -1015,7 +1028,7 @@ class Coordinator:
         instead of running to completion against a query that can no
         longer succeed."""
         if self._cancelled():
-            self._tr().event("task_cancelled")
+            self._event("task_cancelled")
             raise TaskCancelledError(
                 "query cancelled: a sibling stage/task failed or the "
                 "caller cancelled"
@@ -1025,7 +1038,7 @@ class Coordinator:
         ev = getattr(self, "_cancel_event", None)
         if ev is not None:
             if not ev.is_set():
-                self._tr().event("query_cancel")
+                self._event("query_cancel")
             ev.set()
 
     def _materialize_exchange_node(
@@ -1074,10 +1087,10 @@ class Coordinator:
         if hit is None:
             if reason == "fp_mismatch":
                 self.faults.bump("checkpoint_fp_mismatch")
-                self._tr().event("checkpoint_fp_mismatch", stage=stage_id)
+                self._event("checkpoint_fp_mismatch", stage=stage_id)
             elif reason == "slice_lost":
                 self.faults.bump("checkpoint_slices_lost")
-                self._tr().event("checkpoint_slices_lost", stage=stage_id)
+                self._event("checkpoint_slices_lost", stage=stage_id)
             return None
         slices, replicated, pinned, _t_prod = hit
         scan = MemoryScanExec(slices, producer.schema(), pinned=pinned,
@@ -1087,7 +1100,7 @@ class Coordinator:
             # first restored stage of this execute: the query is resuming
             self._resume_traced = True
             self.faults.bump("queries_resumed")
-            self._tr().event("query_resumed", stage=stage_id)
+            self._event("query_resumed", stage=stage_id)
         self.stream_metrics[(query_id, stage_id)] = {
             "plane": "checkpoint",
             "coordinator_bytes": 0,
@@ -1111,7 +1124,7 @@ class Coordinator:
                          scan.pinned, t_prod)
         if staged is not None:
             self.faults.bump("checkpoint_stages_saved")
-            self._tr().event(
+            self._event(
                 "checkpoint_saved", stage=stage_id,
                 slices=len(scan.tasks), bytes=staged,
             )
@@ -1517,7 +1530,7 @@ class Coordinator:
                 for s in peer_scans(stage_plan):
                     reroute_pulls(s, url_map)
         if healed:
-            self._tr().event("peer_heal", reshipped=healed)
+            self._event("peer_heal", reshipped=healed)
         return healed
 
     # -- partition-range data plane ------------------------------------------
@@ -2066,7 +2079,7 @@ class Coordinator:
     def _record_worker_failure(self, url: str) -> None:
         if url and self._health_tracker().record_failure(url):
             self.faults.bump("workers_quarantined")
-            self._tr().event("worker_quarantined", worker=url)
+            self._event("worker_quarantined", worker=url)
 
     def _record_worker_success(self, url: str) -> None:
         if self.health is not None and url:
@@ -2259,7 +2272,7 @@ class Coordinator:
             if disp is not None:
                 hedged = True
                 self.faults.bump("hedges_issued")
-                tr.event(
+                self._event(
                     "hedge_issued", stage=stage_id, task=task_number,
                     primary=primary[0].url, hedge=disp[0].url,
                     threshold_ms=round(threshold * 1e3, 1),
@@ -2310,7 +2323,7 @@ class Coordinator:
             name = "hedge_won" if att["spec"] else "hedge_lost"
             self.faults.bump("hedges_won" if att["spec"] else
                              "hedges_lost")
-            tr.event(name, stage=stage_id, task=task_number,
+            self._event(name, stage=stage_id, task=task_number,
                      worker=att["worker"].url)
         return att["worker"], out
 
@@ -2450,7 +2463,7 @@ class Coordinator:
             if disp is not None:
                 hedged = True
                 self.faults.bump("hedges_issued")
-                tr.event(
+                self._event(
                     "hedge_issued", stage=stage_id, task=task_number,
                     primary=primary[0].url, hedge=disp[0].url,
                     threshold_ms=round(threshold * 1e3, 1),
@@ -2505,7 +2518,7 @@ class Coordinator:
             name = "hedge_won" if att["spec"] else "hedge_lost"
             self.faults.bump("hedges_won" if att["spec"] else
                              "hedges_lost")
-            tr.event(name, stage=stage_id, task=task_number,
+            self._event(name, stage=stage_id, task=task_number,
                      worker=att["worker"].url, plane="stream")
         return (att["worker"], att["key"], att["plan_obj"],
                 att["store"], it, first)
@@ -2570,7 +2583,7 @@ class Coordinator:
             return False
         if state.attempt >= self._opt_int("max_task_retries"):
             self.faults.bump("retries_exhausted")
-            self._tr().event(
+            self._event(
                 "retries_exhausted", stage=key_tuple[1],
                 task=key_tuple[2], error=type(exc).__name__,
             )
@@ -2578,7 +2591,7 @@ class Coordinator:
         if isinstance(exc, TaskTimeoutError):
             self.faults.bump("task_timeouts")
         self.faults.bump("task_retries")
-        self._tr().event(
+        self._event(
             "task_retry", stage=key_tuple[1], task=key_tuple[2],
             attempt=state.attempt, worker=url,
             error=type(exc).__name__,
@@ -2632,7 +2645,7 @@ class Coordinator:
                 raise
             if state.attempt and disp[0].url not in state.excluded:
                 self.faults.bump("tasks_rerouted")
-                self._tr().event(
+                self._event(
                     "task_rerouted", stage=stage_id, task=task_number,
                     worker=disp[0].url,
                 )

@@ -229,6 +229,12 @@ def _handlers(worker: Worker):
     def get_info(request: bytes, context) -> bytes:
         return json.dumps(worker.get_info()).encode()
 
+    def get_metrics(request: bytes, context) -> bytes:
+        # telemetry exposition (runtime/telemetry.py): the snapshot is
+        # JSON-able by construction; the client (or the observability
+        # service) renders OpenMetrics text from it after merging
+        return json.dumps({"metrics": worker.get_metrics()}).encode()
+
     def task_progress(request: bytes, context) -> bytes:
         msg = json.loads(request.decode())
         p = worker.task_progress(_key_from_obj(msg["key"]))
@@ -244,6 +250,7 @@ def _handlers(worker: Worker):
     unary = {
         "SetPlan": set_plan,
         "GetInfo": get_info,
+        "GetMetrics": get_metrics,
         "TaskProgress": task_progress,
         "Invalidate": invalidate,
     }
@@ -535,6 +542,13 @@ class GrpcWorkerClient:
 
     def get_info(self) -> dict:
         return self._call("GetInfo", {})
+
+    def get_metrics(self) -> dict:
+        """The SERVER worker's telemetry snapshot (the `get_metrics`
+        RPC, runtime/telemetry.py wire format) — duck-typed with
+        `Worker.get_metrics` so the observability merge runs unchanged
+        over either transport."""
+        return self._call("GetMetrics", {}).get("metrics", {})
 
     @property
     def peer_capable(self) -> bool:

@@ -197,6 +197,37 @@ class HealthTracker:
             s = self._workers.get(url)
             return CLOSED if s is None else s.state
 
+    def telemetry_families(self) -> list:
+        """Typed-registry adapter (runtime/telemetry.py): worker counts
+        by breaker state plus per-worker success/failure/hedge-loss
+        totals (labeled by url — bounded by cluster size, and pruned
+        with the membership like the breaker state itself)."""
+        from datafusion_distributed_tpu.runtime.telemetry import family
+
+        snap = self.snapshot()
+        by_state = {CLOSED: 0, OPEN: 0, HALF_OPEN: 0}
+        for s in snap.values():
+            by_state[s["state"]] = by_state.get(s["state"], 0) + 1
+        fams = [family(
+            "dftpu_health_workers", "gauge",
+            "Tracked workers by circuit-breaker state.",
+            [({"state": k}, v) for k, v in sorted(by_state.items())],
+        )]
+        for key, metric, help_text in (
+            ("total_successes", "dftpu_health_successes",
+             "Successful dispatch outcomes per worker."),
+            ("total_failures", "dftpu_health_failures",
+             "Failed dispatch outcomes per worker."),
+            ("hedge_losses", "dftpu_health_hedge_losses",
+             "Hedge races lost per worker (never breaker input)."),
+        ):
+            samples = [
+                ({"url": url}, s[key]) for url, s in sorted(snap.items())
+            ]
+            if samples:
+                fams.append(family(metric, "counter", help_text, samples))
+        return fams
+
     def snapshot(self) -> dict:
         """url -> breaker state, for observability surfaces."""
         with self._lock:

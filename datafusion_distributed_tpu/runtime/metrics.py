@@ -270,6 +270,23 @@ class HedgeBudget:
                 "denied": self.denied,
             }
 
+    def telemetry_families(self) -> list:
+        """Typed-registry adapter (runtime/telemetry.py)."""
+        from datafusion_distributed_tpu.runtime.telemetry import family
+
+        s = self.stats()
+        return [
+            family("dftpu_hedges_in_flight", "gauge",
+                   "Speculative (hedged) attempts currently in flight.",
+                   [({}, s["in_flight"])]),
+            family("dftpu_hedges_peak_in_flight", "gauge",
+                   "High-water mark of concurrent hedged attempts.",
+                   [({}, s["peak_in_flight"])]),
+            family("dftpu_hedges_denied", "counter",
+                   "Hedge attempts denied by the in-flight budget.",
+                   [({}, s["denied"])]),
+        ]
+
 
 class FaultCounters:
     """Thread-safe counters for the fault-tolerant execution layer
@@ -299,6 +316,20 @@ class FaultCounters:
         for name, n in other.as_dict().items():
             self.bump(name, n)
         return self
+
+    def telemetry_families(self) -> list:
+        """Typed-registry adapter (runtime/telemetry.py): every fault
+        counter as one `dftpu_faults{kind=...}` counter family — the
+        single exposition sink for the retry/quarantine/hedge/checkpoint
+        counters this store already accumulates."""
+        from datafusion_distributed_tpu.runtime.telemetry import family
+
+        return [family(
+            "dftpu_faults", "counter",
+            "Fault-tolerance transitions by kind (retries, reroutes, "
+            "timeouts, quarantines, hedges, checkpoints).",
+            [({"kind": k}, v) for k, v in sorted(self.as_dict().items())],
+        )]
 
 
 def explain_analyze(
@@ -466,6 +497,32 @@ class LatencySketch:
             "p99": self.percentile(0.99),
             "max": self.max,
         }
+
+    def telemetry_families(self, name: str, help_text: str = "") -> list:
+        """Typed-registry adapter (runtime/telemetry.py): the sketch as
+        a prometheus-style summary — `<name>{quantile=...}` gauges plus
+        `<name>_observations` — under a caller-chosen metric name (one
+        sketch class serves both the task- and query-latency roles)."""
+        from datafusion_distributed_tpu.runtime.telemetry import family
+
+        s = self.summary()
+        quantiles = [
+            ({"quantile": q}, s[q])
+            for q in ("p50", "p95", "p99")
+            if s.get(q) is not None
+        ]
+        fams = [family(
+            f"{name}_observations", "counter",
+            f"Observations recorded into {name}.", [({}, s["count"])],
+        )]
+        if quantiles:
+            fams.append(family(
+                name, "gauge",
+                help_text or f"Log-bucketed latency sketch {name} "
+                             "(seconds).",
+                quantiles,
+            ))
+        return fams
 
     def to_dict(self) -> dict:
         """Wire format (the sketch-bytes analogue)."""

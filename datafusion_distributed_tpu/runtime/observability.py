@@ -99,7 +99,7 @@ class ObservabilityService:
 
     def __init__(self, resolver, channels, sample_system: bool = False,
                  health=None, fault_counters=None, serving=None,
-                 trace_store=None, checkpoints=None):
+                 trace_store=None, checkpoints=None, telemetry=None):
         self.resolver = resolver
         self.channels = channels
         self.health = health
@@ -111,6 +111,10 @@ class ObservabilityService:
         # distributed-tracing store surfaced by get_trace_summary (None =
         # the process-wide default, runtime/tracing.py)
         self.trace_store = trace_store
+        # coordinator/serving-side typed metric registry
+        # (runtime/telemetry.py) merged unlabeled into get_metrics();
+        # falls back to the wired serving session's registry
+        self.telemetry = telemetry
         self.sampler = SystemMetricsSampler().start() if sample_system else None
 
     def ping(self) -> dict:
@@ -196,6 +200,69 @@ class ObservabilityService:
             for k in totals:
                 totals[k] += int(stats.get(k, 0))
         return {**totals, "workers": workers}
+
+    def get_metrics(self) -> dict:
+        """Merged cluster-wide telemetry snapshot (runtime/telemetry.py):
+        every worker's `get_metrics` RPC snapshot folded under a
+        worker=url label, plus the coordinator/serving-side registry
+        (wired directly or through the serving session) unlabeled —
+        the single exposition the console, bench, and any scrape read.
+
+        Degrades per worker like `get_data_plane`: an unreachable or
+        erroring worker contributes an error entry in ``workers`` and
+        the rest of the cluster still answers."""
+        per_worker: dict = {}
+        workers: dict = {}
+        for url in self.resolver.get_urls():
+            try:
+                w = self.channels.get_worker(url)
+                snap = w.get_metrics()
+            except Exception as e:
+                workers[url] = {"error": str(e)}
+                continue
+            if not isinstance(snap, dict):
+                workers[url] = {"error": "non-dict metrics snapshot"}
+                continue
+            per_worker[url] = snap
+            workers[url] = {"families": len(snap)}
+        local = self.telemetry
+        if local is None and self.serving is not None:
+            local = getattr(self.serving, "telemetry", None)
+        from datafusion_distributed_tpu.runtime.telemetry import (
+            merge_snapshots,
+        )
+
+        base = None
+        if local is not None:
+            try:
+                base = local.snapshot()
+            except Exception as e:
+                workers["<local>"] = {"error": str(e)}
+        else:
+            # no registry wired (standalone coordinator observability):
+            # expose whatever adapters ARE wired directly, so the merged
+            # view still carries fault/breaker counters
+            fams: list = []
+            for src in (self.fault_counters, self.health):
+                if src is not None:
+                    try:
+                        fams.extend(src.telemetry_families())
+                    except Exception:
+                        pass
+            if fams:
+                base = dict(fams)
+        return {
+            "metrics": merge_snapshots(base, per_worker),
+            "workers": workers,
+        }
+
+    def render_openmetrics(self) -> str:
+        """OpenMetrics text exposition of the merged cluster snapshot."""
+        from datafusion_distributed_tpu.runtime.telemetry import (
+            render_openmetrics,
+        )
+
+        return render_openmetrics(self.get_metrics()["metrics"])
 
     def get_serving_stats(self) -> dict:
         """Multi-query serving tier counters (empty without a wired

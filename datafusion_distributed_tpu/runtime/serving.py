@@ -95,6 +95,12 @@ SERVING_DEFAULTS = {
     #: snapshot completed-stage outputs onto the workers so a fresh
     #: session's `recover()` resumes them from the staged frontier
     "checkpointing": False,
+    #: SLO targets (runtime/telemetry.py SloTracker), read LIVE per
+    #: stats()/snapshot: rolling p99 latency target in milliseconds and
+    #: error-rate budget over the SLO window. None = no target declared
+    #: (the tracker still reports the rolling p99/error rate).
+    "slo_p99_ms": None,
+    "slo_error_rate": None,
 }
 
 
@@ -630,6 +636,96 @@ class ServingSession:
         self._admitted_total = 0  # guarded-by: _lock
         self._completed = {DONE: 0, FAILED: 0, CANCELLED: 0}  # guarded-by: _lock
         self._closed = False  # guarded-by: _lock
+        # cluster-wide telemetry (runtime/telemetry.py): ONE typed
+        # registry is the exposition sink for every counter this tier
+        # already keeps — faults, hedge budget, breaker state, latency
+        # sketches, admission/queue state, SLO attainment, event-log
+        # tallies — sampled via collector adapters at snapshot time.
+        # `ObservabilityService(serving=...).get_metrics()` merges it
+        # with the per-worker `get_metrics` RPC snapshots.
+        from datafusion_distributed_tpu.runtime.eventlog import (
+            default_event_log,
+        )
+        from datafusion_distributed_tpu.runtime.telemetry import (
+            MetricRegistry,
+            SloTracker,
+            TelemetryHistory,
+        )
+
+        self.slo = SloTracker()
+        self.telemetry = MetricRegistry()
+        for collector in (
+            self.faults.telemetry_families,
+            self.hedge_budget.telemetry_families,
+            self.health.telemetry_families,
+            self._serving_families,
+            self._slo_families,
+            default_event_log().telemetry_families,
+            lambda: self.query_latency.telemetry_families(
+                "dftpu_query_latency_seconds",
+                "Per-query admission->completion wall (seconds).",
+            ),
+            lambda: self.task_latency.telemetry_families(
+                "dftpu_task_latency_seconds",
+                "Per-task execute wall (seconds).",
+            ),
+        ):
+            self.telemetry.register_collector(collector)
+        # bounded time-series ring over the registry: `_drive` samples
+        # it as queries resolve (the resolution gate inside the history
+        # keeps the grid uniform) and the console renders sparkline
+        # columns from it
+        self.history = TelemetryHistory(
+            capacity=int(self._opt("telemetry_history_points", 240)),
+            resolution_s=float(self._opt("telemetry_resolution_s", 1.0)),
+        )
+
+    # -- telemetry adapters (runtime/telemetry.py) --------------------------
+    def _serving_families(self) -> list:
+        """Admission/queue/completion state as typed families."""
+        from datafusion_distributed_tpu.runtime.telemetry import family
+
+        with self._lock:
+            active = len(self._running)
+            queued = len(self._queued)
+            admitted = self._admitted_total
+            completed = dict(self._completed)
+            in_use = sum(r.est_bytes for r in self._running.values())
+            queued_bytes = sum(q.est_bytes for q in self._queued)
+        return [
+            family("dftpu_serving_active_queries", "gauge",
+                   "Admitted queries currently executing.",
+                   [({}, active)]),
+            family("dftpu_serving_queued_queries", "gauge",
+                   "Queries waiting for admission.", [({}, queued)]),
+            family("dftpu_serving_admitted", "counter",
+                   "Queries ever admitted.", [({}, admitted)]),
+            family("dftpu_serving_queries", "counter",
+                   "Resolved queries by terminal state.",
+                   [({"state": k}, v)
+                    for k, v in sorted(completed.items())]),
+            family("dftpu_serving_in_use_bytes", "gauge",
+                   "Admission-estimate bytes of running queries.",
+                   [({}, in_use)]),
+            family("dftpu_serving_queued_bytes", "gauge",
+                   "Admission-estimate bytes of queued queries.",
+                   [({}, queued_bytes)]),
+        ]
+
+    def _slo_families(self) -> list:
+        return self.slo.telemetry_families(
+            p99_target_ms=self._opt("slo_p99_ms", None),
+            error_rate_target=self._opt("slo_error_rate", None),
+        )
+
+    def slo_snapshot(self) -> dict:
+        """Rolling SLO attainment against the live `SET distributed.
+        slo_p99_ms` / `slo_error_rate` targets (runtime/telemetry.py
+        SloTracker) — also folded into `stats()["slo"]`."""
+        return self.slo.snapshot(
+            p99_target_ms=self._opt("slo_p99_ms", None),
+            error_rate_target=self._opt("slo_error_rate", None),
+        )
 
     # -- option plumbing ----------------------------------------------------
     def _opt(self, name: str, default):
@@ -799,6 +895,13 @@ class ServingSession:
         return coord
 
     def _drive(self, h: QueryHandle) -> None:
+        from datafusion_distributed_tpu.runtime.eventlog import log_event
+
+        wait = h.queue_wait_s()
+        log_event("query_admitted", serving_query_id=h.query_id,
+                  priority=h.priority, est_bytes=h.est_bytes,
+                  queue_wait_s=round(wait, 6) if wait is not None
+                  else None)
         coord = None
         try:
             if h._cancel_event.is_set():
@@ -826,6 +929,19 @@ class ServingSession:
             wall = h.wall_s()
             if wall is not None and h._state == DONE:
                 self.query_latency.record(wall)
+            # SLO window (runtime/telemetry.py): DONE counts against the
+            # latency target, FAILED burns error budget; CANCELLED is
+            # operator-initiated and charges neither
+            if h._state == DONE:
+                self.slo.record(wall, ok=True)
+            elif h._state == FAILED:
+                self.slo.record(wall, ok=False)
+            log_event(
+                f"query_{h._state}", serving_query_id=h.query_id,
+                query_id=getattr(coord, "last_query_id", None),
+                wall_s=round(wall, 6) if wall is not None else None,
+                priority=h.priority,
+            )
             with self._lock:
                 self._running.pop(h.query_id, None)
                 self._drivers.pop(h.query_id, None)
@@ -833,6 +949,14 @@ class ServingSession:
                     self._completed.get(h._state, 0) + 1
                 )
                 self._admit_locked()
+            # time-series point per resolved query (the history's own
+            # resolution gate bounds the grid; a quiet tier simply has
+            # no new points, matching a scrape-on-change model)
+            lat = self.query_latency.summary()
+            self.history.sample(self.telemetry, extra={
+                "p99_ms": (lat["p99"] * 1e3
+                           if lat.get("p99") is not None else None),
+            })
 
     def _stamp_trace(self, h: QueryHandle, coord) -> None:
         """Bind the handle to its MAIN execute's trace (the last query id
@@ -945,6 +1069,9 @@ class ServingSession:
         out["scheduler"] = self.scheduler.stats()
         out["latency"] = self.query_latency.summary()
         out["hedging"] = self.hedge_budget.stats()
+        # rolling SLO attainment vs the live targets (empty targets
+        # still report the window's p99/error rate)
+        out["slo"] = self.slo_snapshot()
         if self.checkpoints is not None:
             out["checkpoints"] = self.checkpoints.stats()
         return out

@@ -328,6 +328,35 @@ class Worker:
         )
         self.on_plan = on_plan
         self.table_store = TableStore()
+        # per-worker typed metric registry (runtime/telemetry.py): the
+        # `get_metrics` RPC serves its snapshot, and the observability
+        # service merges per-worker snapshots (worker=url label) into
+        # the cluster view. Collector adapters sample the table store's
+        # existing accounting at snapshot time — no hot-path overhead.
+        from datafusion_distributed_tpu.runtime.telemetry import (
+            MetricRegistry,
+        )
+
+        self.telemetry = MetricRegistry()
+        self.telemetry.register_collector(
+            self.table_store.telemetry_families
+        )
+        self.telemetry.gauge(
+            "dftpu_worker_tasks_cached",
+            "Task registry entries currently held.",
+        ).set_function(lambda: len(self.registry))
+        self._tm_tasks = self.telemetry.counter(
+            "dftpu_worker_tasks_executed",
+            "Task executions by outcome.", labels=("status",),
+        )
+        self._tm_rows = self.telemetry.counter(
+            "dftpu_worker_rows_out", "Rows produced by task executions.",
+        )
+        self._tm_exec = self.telemetry.histogram(
+            "dftpu_worker_execute_seconds",
+            "Per-task execute wall seconds (host-side, around the "
+            "compiled program).",
+        )
         # ChannelResolver-like (get_worker(url)) used by the peer-to-peer
         # data plane to open streams to producer workers (the reference's
         # consumer-side WorkerConnectionPool, `worker_connection_pool.rs`)
@@ -611,12 +640,19 @@ class Worker:
                 f"task{key.task_number}", {}
             )
         except WorkerError:
+            self._tm_tasks.inc(status="error")
             raise
         except Exception as e:
+            self._tm_tasks.inc(status="error")
             raise wrap_worker_exception(e, self.url, key) from e
         data.finished_at = time.time()
         data.metrics["rows_out"] = int(out.num_rows)
         data.metrics["elapsed_s"] = data.finished_at - data.executed_at
+        # telemetry (host-side, after the compiled program returned —
+        # never inside traced code, DFTPU110)
+        self._tm_tasks.inc(status="ok")
+        self._tm_rows.inc(data.metrics["rows_out"])
+        self._tm_exec.observe(data.metrics["elapsed_s"])
         if tctx:
             from datafusion_distributed_tpu.plan import physical as _phys
             from datafusion_distributed_tpu.runtime.tracing import (
@@ -858,6 +894,13 @@ class Worker:
                 # staged bytes/entries/views + peak, per worker — the
                 # observability service's data-plane surface
                 "store": self.table_store.stats()}
+
+    def get_metrics(self) -> dict:
+        """This worker's typed-registry snapshot (runtime/telemetry.py
+        wire format) — the `get_metrics` RPC body on both transports;
+        `ObservabilityService.get_metrics()` merges per-worker snapshots
+        under a worker=url label."""
+        return self.telemetry.snapshot()
 
     def task_progress(self, key: TaskKey) -> Optional[dict]:
         data = self.registry.get(key)

@@ -246,6 +246,19 @@ class SessionConfig:
                         "hedge_budget must be >= 0 (0 disables hedging "
                         "by denying every speculative attempt)"
                     )
+            elif key == "slo_p99_ms":
+                # SLO targets (runtime/telemetry.py SloTracker, read
+                # live by the serving tier's stats/console surfaces):
+                # validated at SET time like the other serving knobs
+                value = float(value)
+                if value <= 0:
+                    raise ValueError("slo_p99_ms must be > 0")
+            elif key == "slo_error_rate":
+                value = float(value)
+                if not 0.0 <= value <= 1.0:
+                    raise ValueError(
+                        "slo_error_rate must be in [0, 1]"
+                    )
             elif key == "tracing":
                 # distributed-tracing mode (runtime/tracing.py):
                 # validated at SET time so a typo fails the SET, not the

@@ -117,6 +117,30 @@ if ! env DFTPU_LOCK_CHECK=1 python -m pytest tests/test_hedging_recovery.py \
         -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
     FAILED+=("tests/test_hedging_recovery.py[gate+lockcheck]")
 fi
+# Telemetry gate (tests/test_telemetry.py): the cluster-wide telemetry
+# pipeline — typed registry units, OpenMetrics exposition-format golden
+# test, cross-transport get_metrics merge (in-process AND gRPC, with
+# per-worker degradation), TelemetryHistory ring bounds, SLO attainment
+# math, event-log/trace id correlation, console per-line degradation
+# against empty/partial stores, and zero new XLA traces with telemetry +
+# event logging active.
+echo "=== tests/test_telemetry.py (telemetry gate)"
+if ! python -m pytest tests/test_telemetry.py -q --no-header \
+        -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
+    FAILED+=("tests/test_telemetry.py[gate]")
+fi
+# bench-compare smoke (tools/bench_compare.py): the bench trajectory
+# diff tool must at minimum hold a file equal to itself regression-free
+# (sub-second; BENCH_DETAIL.json ships with the repo). Real use diffs
+# two runs: python tools/bench_compare.py BENCH_old.json BENCH_new.json
+if [ -f BENCH_DETAIL.json ]; then
+    echo "=== tools/bench_compare.py (self-diff smoke)"
+    if ! python tools/bench_compare.py BENCH_DETAIL.json \
+            BENCH_DETAIL.json >/dev/null; then
+        echo "BENCH COMPARE FAILED: self-diff reported a regression"
+        FAILED+=("tools/bench_compare.py[smoke]")
+    fi
+fi
 # Tracing gate (tests/test_tracing.py): the distributed-tracing
 # subsystem — span-tree shape for distributed TPC-H (worker spans joined
 # via cross-wire context propagation, in-process AND gRPC), retry/heal/
@@ -162,6 +186,7 @@ for f in tests/test_*.py; do
     [ "$f" = "tests/test_serving.py" ] && continue  # ran above (gate)
     [ "$f" = "tests/test_hedging_recovery.py" ] && continue  # ran above
     [ "$f" = "tests/test_tracing.py" ] && continue  # ran above (gate)
+    [ "$f" = "tests/test_telemetry.py" ] && continue  # ran above (gate)
     [ "$f" = "tests/test_elasticity.py" ] && continue  # ran above (gate)
     [ "$f" = "tests/test_data_plane.py" ] && continue  # ran above (gate)
     echo "=== $f"

@@ -150,3 +150,26 @@ def test_tracing_knob_zero_compiles(tpch_ctx):
     assert phys.trace_count() == traces0, (
         "tracing knob flips recompiled — the knob leaked into a cache key"
     )
+
+
+def test_slo_knob_zero_compiles(tpch_ctx):
+    """ISSUE 13 gate extension: flipping the telemetry SLO targets
+    (`SET distributed.slo_p99_ms` / `slo_error_rate`) must cause ZERO
+    new XLA compiles on resubmission — SLO targets are coordinator/
+    serving-side reads (runtime/telemetry.py SloTracker) that ride the
+    shipped config but never a trace-relevant cache key."""
+    ctx, _ = tpch_ctx
+    sql = Q1_TPL.format(**PARAMS_A["q1"])
+    base = ctx.sql(sql).to_pandas()
+    traces0 = phys.trace_count()
+    for p99, err in ((100, 0.01), (5000, 0.5)):
+        ctx.sql(f"set distributed.slo_p99_ms = {p99}")
+        ctx.sql(f"set distributed.slo_error_rate = {err}")
+        got = ctx.sql(sql).to_pandas()
+        assert got.equals(base)
+    ctx.config.distributed_options.pop("slo_p99_ms", None)
+    ctx.config.distributed_options.pop("slo_error_rate", None)
+    assert phys.trace_count() == traces0, (
+        "SLO knob flips recompiled — a telemetry knob leaked into a "
+        "cache key"
+    )

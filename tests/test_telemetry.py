@@ -1,0 +1,763 @@
+"""Telemetry gate (runtime/telemetry.py + runtime/eventlog.py).
+
+Acceptance contract (ISSUE 13): typed metric registry units (fixed
+label sets, once-only registration, collector adapters); OpenMetrics
+exposition format golden test; `get_metrics` merged cluster snapshot
+over BOTH transports with per-worker degradation; TelemetryHistory ring
+bounds + rates; SLO attainment/error-budget math + knob validation;
+event-log/trace correlation on the same query/stage/task ids; console
+rendering degrades per line against empty/partial stores; telemetry +
+event logging enabled adds ZERO new XLA traces; DFTPU110 keeps
+telemetry/event-log calls out of jax-traced code; bench_compare diff
+semantics.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from datafusion_distributed_tpu.io.parquet import arrow_to_table
+from datafusion_distributed_tpu.ops.aggregate import AggSpec
+from datafusion_distributed_tpu.plan import physical as phys
+from datafusion_distributed_tpu.plan.physical import (
+    HashAggregateExec,
+    MemoryScanExec,
+)
+from datafusion_distributed_tpu.planner.distributed import (
+    DistributedConfig,
+    distribute_plan,
+)
+from datafusion_distributed_tpu.runtime.chaos import (
+    one_crash_per_stage,
+    wrap_cluster,
+)
+from datafusion_distributed_tpu.runtime.coordinator import (
+    Coordinator,
+    InMemoryCluster,
+)
+from datafusion_distributed_tpu.runtime.eventlog import (
+    EventLog,
+    default_event_log,
+)
+from datafusion_distributed_tpu.runtime.metrics import (
+    FaultCounters,
+    HedgeBudget,
+    LatencySketch,
+)
+from datafusion_distributed_tpu.runtime.observability import (
+    ObservabilityService,
+)
+from datafusion_distributed_tpu.runtime.telemetry import (
+    MetricRegistry,
+    SloTracker,
+    TelemetryHistory,
+    merge_snapshots,
+    render_openmetrics,
+    scalar_series,
+    sparkline,
+)
+
+CHAOS_SEED = int(os.environ.get("DFTPU_CHAOS_SEED", "20260803"))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _plan(n=2048, num_tasks=4):
+    rng = np.random.default_rng(3)
+    t = arrow_to_table(pa.table({
+        "k": rng.integers(0, 16, n),
+        "v": rng.normal(size=n),
+    }))
+    scan = MemoryScanExec([t], t.schema())
+    agg = HashAggregateExec(
+        "single", ["k"], [AggSpec("sum", "v", "sv")], scan, 32
+    )
+    return distribute_plan(agg, DistributedConfig(num_tasks=num_tasks))
+
+
+# ---------------------------------------------------------------------------
+# registry units
+# ---------------------------------------------------------------------------
+
+
+def test_registry_typed_metrics():
+    r = MetricRegistry()
+    c = r.counter("dftpu_t_faults", "h", labels=("kind",))
+    c.inc(kind="retry")
+    c.inc(2, kind="retry")
+    assert c.value(kind="retry") == 3
+    with pytest.raises(ValueError):
+        c.inc(-1, kind="retry")  # counters only go up
+    with pytest.raises(ValueError):
+        c.inc(kind="retry", extra="x")  # fixed label set
+    with pytest.raises(ValueError):
+        c.inc()  # missing label
+    g = r.gauge("dftpu_t_depth", "h")
+    g.set(5)
+    g.dec(2)
+    assert g.value() == 3
+    h = r.histogram("dftpu_t_wall", "h", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(50)
+    [[_labels, sample]] = h.samples()
+    assert sample["count"] == 3
+    assert sample["buckets"] == [[0.1, 1], [1.0, 2], ["+Inf", 3]]
+    # registration is once-only: same signature returns the SAME object,
+    # a conflicting one raises
+    assert r.counter("dftpu_t_faults", "h", labels=("kind",)) is c
+    with pytest.raises(ValueError):
+        r.gauge("dftpu_t_faults", "h", labels=("kind",))
+    with pytest.raises(ValueError):
+        r.counter("dftpu_t_faults", "h", labels=("other",))
+    with pytest.raises(ValueError):
+        r.counter("Bad-Name", "h")
+    # histogram bucket layout is part of the signature: same buckets
+    # returns the same object, different buckets raise
+    assert r.histogram("dftpu_t_wall", "h", buckets=(1.0, 0.1)) is h
+    with pytest.raises(ValueError):
+        r.histogram("dftpu_t_wall", "h", buckets=(0.5,))
+
+
+def test_registry_callback_gauge_and_collector():
+    r = MetricRegistry()
+    box = {"v": 7}
+    r.gauge("dftpu_t_cb", "h").set_function(lambda: box["v"])
+    fc = FaultCounters()
+    fc.bump("task_retries", 3)
+    r.register_collector(fc.telemetry_families)
+    snap = r.snapshot()
+    assert snap["dftpu_t_cb"]["samples"] == [[{}, 7.0]]
+    assert snap["dftpu_faults"]["samples"] == [[{"kind": "task_retries"}, 3]]
+    box["v"] = 9  # callbacks sample at snapshot time, not set time
+    assert r.snapshot()["dftpu_t_cb"]["samples"] == [[{}, 9.0]]
+    # a broken collector degrades instead of aborting the snapshot
+    r.register_collector(lambda: (_ for _ in ()).throw(RuntimeError()))
+    assert "dftpu_t_cb" in r.snapshot()
+
+
+def test_existing_store_adapters():
+    hb = HedgeBudget()
+    hb.try_acquire(1)
+    hb.try_acquire(1)  # denied
+    fams = dict(hb.telemetry_families())
+    assert fams["dftpu_hedges_in_flight"]["samples"] == [[{}, 1]]
+    assert fams["dftpu_hedges_denied"]["samples"] == [[{}, 1]]
+    sk = LatencySketch()
+    for v in (0.01, 0.02, 0.5):
+        sk.record(v)
+    fams = dict(sk.telemetry_families("dftpu_t_lat"))
+    assert fams["dftpu_t_lat_observations"]["samples"] == [[{}, 3]]
+    quantiles = {s[0]["quantile"] for s in fams["dftpu_t_lat"]["samples"]}
+    assert quantiles == {"p50", "p95", "p99"}
+
+
+# ---------------------------------------------------------------------------
+# exposition format (golden)
+# ---------------------------------------------------------------------------
+
+
+def test_openmetrics_exposition_golden():
+    r = MetricRegistry()
+    c = r.counter("dftpu_g_faults", "Faults by kind.", labels=("kind",))
+    c.inc(2, kind="retry")
+    c.inc(1, kind='we"ird\nkind')  # label escaping
+    r.gauge("dftpu_g_bytes", "Staged bytes.").set(1024)
+    h = r.histogram("dftpu_g_wall", "Wall seconds.", buckets=(0.5, 2.0))
+    h.observe(0.1)
+    h.observe(1.0)
+    expected = (
+        '# HELP dftpu_g_bytes Staged bytes.\n'
+        '# TYPE dftpu_g_bytes gauge\n'
+        'dftpu_g_bytes 1024\n'
+        '# HELP dftpu_g_faults Faults by kind.\n'
+        '# TYPE dftpu_g_faults counter\n'
+        'dftpu_g_faults_total{kind="retry"} 2\n'
+        'dftpu_g_faults_total{kind="we\\"ird\\nkind"} 1\n'
+        '# HELP dftpu_g_wall Wall seconds.\n'
+        '# TYPE dftpu_g_wall histogram\n'
+        'dftpu_g_wall_bucket{le="0.5"} 1\n'
+        'dftpu_g_wall_bucket{le="2.0"} 2\n'
+        'dftpu_g_wall_bucket{le="+Inf"} 2\n'
+        'dftpu_g_wall_sum 1.1\n'
+        'dftpu_g_wall_count 2\n'
+        '# EOF\n'
+    )
+    assert r.render_openmetrics() == expected
+
+
+def test_merge_snapshots_worker_labels():
+    r = MetricRegistry()
+    r.gauge("dftpu_m_bytes", "h").set(10)
+    base = MetricRegistry()
+    base.counter("dftpu_m_queries", "h").inc(4)
+    merged = merge_snapshots(
+        base.snapshot(), {"grpc://a": r.snapshot(), "grpc://b": r.snapshot()}
+    )
+    samples = merged["dftpu_m_bytes"]["samples"]
+    assert [s[0] for s in samples] == [
+        {"worker": "grpc://a"}, {"worker": "grpc://b"}
+    ]
+    assert merged["dftpu_m_queries"]["samples"] == [[{}, 4]]
+    # scalar flattening keys samples by name+labels
+    flat = scalar_series(merged)
+    assert flat['dftpu_m_bytes{worker="grpc://a"}'] == 10.0
+    assert flat["dftpu_m_queries"] == 4.0
+
+
+# ---------------------------------------------------------------------------
+# cross-transport merged get_metrics
+# ---------------------------------------------------------------------------
+
+
+def test_get_metrics_merges_in_process_cluster():
+    cluster = InMemoryCluster(2)
+    coord = Coordinator(resolver=cluster, channels=cluster)
+    coord.execute(_plan())
+    obs = ObservabilityService(cluster, cluster,
+                               fault_counters=coord.faults)
+    out = obs.get_metrics()
+    m = out["metrics"]
+    assert set(out["workers"]) == set(cluster.get_urls())
+    ok = m["dftpu_worker_tasks_executed"]["samples"]
+    workers = {s[0]["worker"] for s in ok}
+    assert workers == set(cluster.get_urls())
+    assert sum(v for _l, v in ok) >= 2  # every task landed somewhere
+    # exposition of the merged view parses as the same line shape
+    text = obs.render_openmetrics()
+    assert text.endswith("# EOF\n")
+    assert "dftpu_worker_tasks_executed_total{" in text
+
+
+def test_get_metrics_degrades_per_worker():
+    cluster = InMemoryCluster(2)
+    url = cluster.get_urls()[0]
+
+    class Flaky:
+        def get_urls(self):
+            return cluster.get_urls()
+
+        def get_worker(self, u):
+            if u == url:
+                raise RuntimeError("down")
+            return cluster.get_worker(u)
+
+    out = ObservabilityService(Flaky(), Flaky()).get_metrics()
+    assert out["workers"][url] == {"error": "down"}
+    other = [u for u in cluster.get_urls() if u != url][0]
+    assert "families" in out["workers"][other]
+    assert any(
+        s[0].get("worker") == other
+        for s in out["metrics"]["dftpu_store_staged_bytes"]["samples"]
+    )
+
+
+def test_get_metrics_over_grpc():
+    grpc = pytest.importorskip("grpc", reason="grpc not installed")
+    del grpc
+    from datafusion_distributed_tpu.runtime.grpc_worker import (
+        start_localhost_cluster,
+    )
+
+    cluster = start_localhost_cluster(2)
+    try:
+        coord = Coordinator(resolver=cluster, channels=cluster)
+        coord.execute(_plan())
+        obs = ObservabilityService(cluster, cluster)
+        out = obs.get_metrics()
+        executed = out["metrics"]["dftpu_worker_tasks_executed"]["samples"]
+        assert {s[0]["worker"] for s in executed} <= set(cluster.get_urls())
+        assert sum(v for _l, v in executed) >= 2
+        # degradation: stop one server — the merge still answers with an
+        # error entry for the dead endpoint
+        victim = cluster.get_urls()[0]
+        cluster._by_url[victim][0].stop(grace=None)
+        out2 = obs.get_metrics()
+        assert "error" in out2["workers"][victim]
+        survivor = cluster.get_urls()[1]
+        assert "families" in out2["workers"][survivor]
+    finally:
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# history ring
+# ---------------------------------------------------------------------------
+
+
+def test_history_ring_bounds_and_rates():
+    clock = {"t": 0.0}
+    h = TelemetryHistory(capacity=4, resolution_s=1.0,
+                         clock=lambda: clock["t"])
+    r = MetricRegistry()
+    c = r.counter("dftpu_h_done", "h")
+    for i in range(10):
+        c.inc(2)
+        assert h.sample(r, extra={"p99_ms": 100.0 + i})
+        assert not h.sample(r)  # inside the resolution window: no-op
+        clock["t"] += 1.0
+    assert len(h) == 4  # ring bound
+    series = h.series("dftpu_h_done")
+    assert len(series) == 4
+    assert series[-1][1] == 20.0
+    assert h.rate("dftpu_h_done") == pytest.approx(2.0)  # 2/sample @ 1s
+    assert h.latest("p99_ms") == pytest.approx(109.0)
+    assert len(h.sparkline("p99_ms")) == 4
+    assert h.rate("missing") is None
+    with pytest.raises(ValueError):
+        TelemetryHistory(capacity=1)
+
+
+def test_sparkline_shapes():
+    assert sparkline([]) == ""
+    assert sparkline([5, 5, 5]) == "▁▁▁"
+    s = sparkline([0, 1, 2, 3], width=2)
+    assert len(s) == 2
+    assert sparkline([0, 7])[-1] == "█"
+
+
+# ---------------------------------------------------------------------------
+# SLO math
+# ---------------------------------------------------------------------------
+
+
+def test_slo_attainment_and_burn_math():
+    t = SloTracker(window=100)
+    # 8 fast, 1 slow, 1 failure
+    for _ in range(8):
+        t.record(0.050, ok=True)
+    t.record(0.500, ok=True)
+    t.record(None, ok=False)
+    s = t.snapshot(p99_target_ms=100, error_rate_target=0.2)
+    assert s["window_n"] == 10
+    assert s["error_rate"] == pytest.approx(0.1)
+    assert s["p99_ms"] == pytest.approx(500.0)
+    assert s["p99_ok"] is False
+    assert s["latency_attainment"] == pytest.approx(8 / 9)
+    assert s["error_budget_burn"] == pytest.approx(0.5)  # 0.1 / 0.2
+    # zero-error target: any failure is an infinite burn
+    import math
+    assert t.snapshot(error_rate_target=0.0)["error_budget_burn"] == (
+        math.inf
+    )
+    # window slide: old entries age out
+    t2 = SloTracker(window=2)
+    t2.record(1.0)
+    t2.record(0.01)
+    t2.record(0.01)
+    assert t2.snapshot(p99_target_ms=100)["latency_attainment"] == 1.0
+    fams = dict(t.telemetry_families(p99_target_ms=100))
+    assert fams["dftpu_slo_latency_attainment"]["samples"][0][1] == (
+        pytest.approx(8 / 9)
+    )
+
+
+def test_slo_knob_validation():
+    from datafusion_distributed_tpu.sql.context import SessionContext
+
+    ctx = SessionContext()
+    ctx.sql("set distributed.slo_p99_ms = 250")
+    assert ctx.config.distributed_options["slo_p99_ms"] == 250.0
+    ctx.sql("set distributed.slo_error_rate = 0.01")
+    with pytest.raises(ValueError):
+        ctx.sql("set distributed.slo_p99_ms = 0")
+    with pytest.raises(ValueError):
+        ctx.sql("set distributed.slo_error_rate = 1.5")
+
+
+# ---------------------------------------------------------------------------
+# event log + correlation with traces
+# ---------------------------------------------------------------------------
+
+
+def test_eventlog_ring_sink_and_dump(tmp_path):
+    sink = tmp_path / "events.jsonl"
+    log = EventLog(capacity=3, path=str(sink))
+    for i in range(5):
+        log.log("task_retry", query_id="q1", stage=i, task=0)
+    st = log.stats()
+    assert st["events"] == 3 and st["total"] == 5 and st["dropped"] == 2
+    # ring keeps the LAST capacity events; the sink has ALL of them
+    assert [e["stage"] for e in log.events()] == [2, 3, 4]
+    lines = [json.loads(x) for x in
+             sink.read_text().strip().splitlines()]
+    assert [e["stage"] for e in lines] == [0, 1, 2, 3, 4]
+    assert all(e["kind"] == "task_retry" and "ts" in e and "seq" in e
+               for e in lines)
+    # filters + dump
+    assert log.events(query_id="nope") == []
+    out = tmp_path / "dump.jsonl"
+    assert log.dump(str(out)) == 3
+    # non-JSON field values degrade to repr instead of failing the caller
+    e = log.log("weird", query_id="q2", obj=object())
+    assert isinstance(e["obj"], str)
+    fams = dict(log.telemetry_families())
+    # the 6th event ("weird") evicted one more: 3 drops total
+    assert fams["dftpu_events_dropped"]["samples"] == [[{}, 3]]
+    assert fams["dftpu_events_logged"]["samples"] == [[{}, 6]]
+    # the per-kind counter is MONOTONIC (ever logged, not retained):
+    # ring eviction must never make a counter-typed sample go down
+    assert dict(
+        (s[0]["kind"], s[1]) for s in fams["dftpu_events"]["samples"]
+    ) == {"task_retry": 5, "weird": 1}
+    log.close()
+
+
+def test_fault_events_correlate_with_trace_ids():
+    cluster = InMemoryCluster(3)
+    chaos = wrap_cluster(cluster, one_crash_per_stage(CHAOS_SEED))
+    coord = Coordinator(
+        resolver=chaos, channels=chaos,
+        config_options={"task_retry_backoff_s": 0.001, "tracing": "on"},
+    )
+    log = default_event_log()
+    before = {e["seq"] for e in log.events()}
+    coord.execute(_plan())
+    qid = coord.last_query_id
+    fresh = [e for e in log.events() if e["seq"] not in before]
+    retries = [e for e in fresh if e["kind"] == "task_retry"]
+    assert retries, "chaos retries must land in the event log"
+    # the SAME query id the trace carries, and the same stage/task ids
+    # the trace event recorded — logs and traces join on one id space
+    assert all(e["query_id"] == qid for e in retries)
+    trace = coord.last_query_trace()
+    trace_retries = [
+        attrs for _t, name, attrs, _p in trace.event_list()
+        if name == "task_retry"
+    ]
+    assert len(trace_retries) == len(retries)
+    assert (
+        {(e.get("stage"), e.get("task")) for e in retries}
+        == {(a.get("stage"), a.get("task")) for a in trace_retries}
+    )
+    # fault counters tell the same story (metrics leg of the triangle)
+    assert coord.faults.get("task_retries") == len(retries)
+
+
+def test_fault_events_logged_with_tracing_off():
+    """The event log is the ALWAYS-ON half: chaos retries appear even
+    when tracing is off (the old asymmetry this module closes)."""
+    cluster = InMemoryCluster(3)
+    chaos = wrap_cluster(cluster, one_crash_per_stage(CHAOS_SEED))
+    coord = Coordinator(resolver=chaos, channels=chaos,
+                        config_options={"task_retry_backoff_s": 0.001})
+    log = default_event_log()
+    before = {e["seq"] for e in log.events()}
+    coord.execute(_plan())
+    fresh = [e for e in log.events() if e["seq"] not in before]
+    assert any(e["kind"] == "task_retry" for e in fresh)
+    assert coord.last_query_trace() is None  # tracing really was off
+
+
+# ---------------------------------------------------------------------------
+# serving SLO surface + zero-compile pin
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def serving_ctx():
+    from datafusion_distributed_tpu.sql.context import SessionContext
+
+    ctx = SessionContext()
+    rng = np.random.default_rng(0)
+    ctx.register_arrow("t", pa.table({
+        "k": rng.integers(0, 8, 4096),
+        "v": rng.normal(size=4096),
+    }))
+    return ctx
+
+
+def test_serving_slo_and_registry(serving_ctx):
+    from datafusion_distributed_tpu.runtime.serving import ServingSession
+
+    ctx = serving_ctx
+    ctx.config.distributed_options["slo_p99_ms"] = 60000.0
+    ctx.config.distributed_options["slo_error_rate"] = 0.5
+    try:
+        srv = ServingSession(ctx, num_workers=2)
+        try:
+            hs = [srv.submit(
+                "select k, sum(v) as s from t group by k order by k"
+            ) for _ in range(3)]
+            for h in hs:
+                h.result()
+            st = srv.stats()
+            slo = st["slo"]
+            assert slo["window_n"] == 3
+            assert slo["latency_attainment"] == 1.0
+            assert slo["p99_ok"] is True
+            assert slo["error_budget_burn"] == 0.0
+            snap = srv.telemetry.snapshot()
+            assert snap["dftpu_serving_queries"]["samples"]
+            done = [v for labels, v in
+                    snap["dftpu_serving_queries"]["samples"]
+                    if labels == {"state": "done"}]
+            assert done == [3]
+            assert "dftpu_slo_latency_attainment" in snap
+            assert "dftpu_faults" in snap
+            assert len(srv.history) >= 1
+            # a console wired to the session SHARES its history ring
+            # (one trend store — the session samples per query, the
+            # console per frame; an empty ring must still be shared)
+            from datafusion_distributed_tpu.console import Console
+
+            con = Console(srv.cluster, srv.cluster, serving=srv)
+            assert con.history is srv.history
+            # the merged observability surface folds the serving
+            # registry in unlabeled
+            obs = ObservabilityService(srv.cluster, srv.cluster,
+                                       serving=srv)
+            merged = obs.get_metrics()["metrics"]
+            assert "dftpu_serving_admitted" in merged
+            assert "dftpu_worker_tasks_executed" in merged
+        finally:
+            srv.close()
+    finally:
+        ctx.config.distributed_options.pop("slo_p99_ms", None)
+        ctx.config.distributed_options.pop("slo_error_rate", None)
+
+
+def test_telemetry_and_eventlog_zero_new_traces(serving_ctx):
+    """Enabling the telemetry pipeline + event logging adds ZERO new
+    XLA traces: snapshots, expositions, history sampling, and event
+    logging are host-side reads of already-kept state."""
+    ctx = serving_ctx
+    sql = "select k, sum(v) as s from t group by k order by k"
+    cluster = InMemoryCluster(2)
+    coord = Coordinator(resolver=cluster, channels=cluster)
+    df = ctx.sql(sql)
+    base = df._strip_quals(df.collect_coordinated_table(
+        coordinator=coord, num_tasks=2
+    )).to_pandas()
+    obs = ObservabilityService(cluster, cluster,
+                               fault_counters=coord.faults)
+    n0 = phys.trace_count()
+    hist = TelemetryHistory(capacity=8, resolution_s=0.0)
+    for _ in range(2):
+        df2 = ctx.sql(sql)
+        got = df2._strip_quals(df2.collect_coordinated_table(
+            coordinator=Coordinator(resolver=cluster, channels=cluster,
+                                    faults=coord.faults),
+            num_tasks=2,
+        )).to_pandas()
+        assert got.equals(base)
+        out = obs.get_metrics()
+        assert out["metrics"]
+        obs.render_openmetrics()
+        hist.sample(None, extra=scalar_series(out["metrics"]))
+        default_event_log().log("bench_tick", query_id="telemetry-test")
+    assert phys.trace_count() == n0, (
+        "telemetry/event logging forced an XLA retrace"
+    )
+
+
+# ---------------------------------------------------------------------------
+# console: per-line degradation against empty/partial stores
+# ---------------------------------------------------------------------------
+
+
+def test_console_renders_empty_cluster():
+    from datafusion_distributed_tpu.console import Console
+
+    cluster = InMemoryCluster(2)  # no queries ever ran
+    frame = Console(cluster, cluster, poll_s=0.01).render_frame()
+    assert "workers (2 active" in frame
+    assert "console rss=" in frame  # reached the footer: no abort
+
+
+def test_console_degrades_on_worker_get_info_error():
+    from datafusion_distributed_tpu.console import Console
+
+    cluster = InMemoryCluster(2)
+    bad = cluster.get_urls()[0]
+
+    class Partial:
+        def get_urls(self):
+            return cluster.get_urls()
+
+        def get_worker(self, u):
+            if u == bad:
+                raise RuntimeError("get_info boom")
+            return cluster.get_worker(u)
+
+    frame = Console(Partial(), Partial(), poll_s=0.01).render_frame()
+    assert "DOWN" in frame            # the broken worker's row degrades
+    assert "mem://worker-1" in frame  # the healthy worker still renders
+    assert "console rss=" in frame
+
+
+def test_console_degrades_per_section_never_aborts():
+    from datafusion_distributed_tpu.console import Console
+
+    class Boom:
+        def get_urls(self):
+            raise RuntimeError("resolver dead")
+
+        def get_worker(self, u):
+            raise RuntimeError("resolver dead")
+
+    class BadServing:
+        telemetry = None
+        history = None
+
+        def stats(self):
+            raise RuntimeError("serving store exploded")
+
+    con = Console(Boom(), Boom(), poll_s=0.01, serving=BadServing())
+    for _ in range(2):  # the refresh LOOP must survive, not just one frame
+        frame = con.render_frame()
+        assert "workers unavailable" in frame
+        assert "console rss=" in frame
+
+
+def test_console_slo_line_idle_window_is_no_data_not_breach():
+    from datafusion_distributed_tpu.console import Console
+
+    cluster = InMemoryCluster(1)
+
+    class IdleServing:
+        telemetry = None
+        history = None
+
+        def stats(self):
+            # a target declared but nothing served yet: SloTracker
+            # omits p99_ok for an empty window
+            return {"active": 0, "queued": 0, "admitted_total": 0,
+                    "completed": {}, "budget_bytes": 0, "latency": {},
+                    "slo": {"window_n": 0, "p99_ms": None,
+                            "p99_target_ms": 250.0}}
+
+    con = Console(cluster, cluster, poll_s=0.01, serving=IdleServing())
+    frame = con.render_frame()
+    assert "[no data]" in frame
+    assert "BREACH" not in frame
+
+
+def test_console_sparkline_row_appears_with_history():
+    from datafusion_distributed_tpu.console import Console
+
+    cluster = InMemoryCluster(1)
+    con = Console(cluster, cluster, poll_s=0.01)
+    con.history = TelemetryHistory(capacity=16, resolution_s=0.0)
+
+    class FakeServing:
+        telemetry = None
+        history = None
+        _n = 0
+
+        def stats(self):
+            FakeServing._n += 2
+            return {
+                "active": 0, "queued": 0, "admitted_total": FakeServing._n,
+                "completed": {"done": FakeServing._n},
+                "latency": {"p99": 0.120},
+                "budget_bytes": 0,
+                "slo": {},
+            }
+
+    con.obs.serving = FakeServing()
+    con.render_frame()
+    frame = con.render_frame()  # second frame: two points -> trends
+    assert "telemetry" in frame
+    assert "qps" in frame and "p99" in frame
+
+
+# ---------------------------------------------------------------------------
+# DFTPU110: telemetry/eventlog calls are forbidden inside traced code
+# ---------------------------------------------------------------------------
+
+
+def test_dftpu110_flags_telemetry_in_traced_code(tmp_path):
+    bad = tmp_path / "bad_kernel.py"
+    bad.write_text(
+        "from jax import jit\n"
+        "def kernel(x):\n"
+        "    registry_counter.inc(1)\n"
+        "    log_event('tick', value=1)\n"
+        "    self.telemetry.snapshot()\n"
+        "    return x + 1\n"
+        "f = jit(kernel)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "check_tracer_safety.py"),
+         "--json", str(bad)],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 1
+    report = json.loads(proc.stdout)
+    rules = [v["rule"] for v in report["violations"]]
+    assert rules.count("DFTPU110") >= 3, report
+    # the package itself stays clean under the new rule
+    proc2 = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "check_tracer_safety.py")],
+        capture_output=True, text=True,
+    )
+    assert proc2.returncode == 0, proc2.stdout + proc2.stderr
+
+
+# ---------------------------------------------------------------------------
+# bench_compare
+# ---------------------------------------------------------------------------
+
+
+def test_bench_compare_semantics():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from bench_compare import compare
+    finally:
+        sys.path.pop(0)
+    base = {
+        "per_query_s": {"q1": 1.0, "q2": 0.5, "tiny": 0.001},
+        "total_s": 1.5,
+        "meta": {"serving": {"qps": 2.0, "cheap_p99_ms": 100,
+                             "slo_latency_attainment": 0.99}},
+    }
+    cur = {
+        "per_query_s": {"q1": 1.3, "q2": 0.4, "tiny": 0.002},
+        "total_s": 1.7,
+        "meta": {"serving": {"qps": 1.0, "cheap_p99_ms": 90,
+                             "slo_latency_attainment": 0.5}},
+    }
+    out = compare(base, cur, threshold=0.10)
+    names = {c["name"]: c["status"] for c in out["comparisons"]}
+    assert names["per_query_s:q1"] == "regression"     # 30% slower
+    assert names["per_query_s:q2"] == "improvement"    # 20% faster
+    assert names["per_query_s:tiny"] == "skipped"      # noise floor
+    assert names["serving:qps"] == "regression"        # higher-is-better
+    assert names["serving:cheap_p99_ms"] == "ok"       # within threshold
+    assert names["serving:slo_latency_attainment"] == "regression"
+    # identical docs never regress (the run_tests.sh smoke contract)
+    clean = compare(base, base, threshold=0.10)
+    assert clean["regressions"] == []
+    # --queries restricts the per-query section
+    only = compare(base, cur, threshold=0.10, queries={"q2"})
+    per_q = [c["name"] for c in only["comparisons"]
+             if c["name"].startswith("per_query_s:")]
+    assert per_q == ["per_query_s:q2"]
+
+
+def test_bench_compare_cli_exit_codes(tmp_path):
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps({"per_query_s": {"q1": 1.0}, "total_s": 1.0}))
+    b.write_text(json.dumps({"per_query_s": {"q1": 2.0}, "total_s": 2.0}))
+    tool = os.path.join(REPO, "tools", "bench_compare.py")
+    ok = subprocess.run([sys.executable, tool, str(a), str(a)],
+                        capture_output=True, text=True)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    bad = subprocess.run([sys.executable, tool, str(a), str(b), "--json"],
+                         capture_output=True, text=True)
+    assert bad.returncode == 1
+    doc = json.loads(bad.stdout)
+    assert [c["name"] for c in doc["regressions"]] == [
+        "per_query_s:q1", "total_s"
+    ]
+    missing = subprocess.run([sys.executable, tool, str(a), "nope.json"],
+                             capture_output=True, text=True)
+    assert missing.returncode == 2

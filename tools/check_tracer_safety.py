@@ -41,6 +41,16 @@ plan/verify.py):
                                  compiled program). Takes precedence
                                  over DFTPU105 for the monotonic clocks
                                  — allowlist entries must name DFTPU109
+  DFTPU110  telemetry-in-trace   telemetry / event-log API call
+                                 (runtime/telemetry.py metric mutation,
+                                 registry snapshot, runtime/eventlog.py
+                                 log_event) in a trace path — metrics
+                                 and structured events are host-side
+                                 only: inside a jitted function the
+                                 call runs ONCE at trace time (one
+                                 phantom increment/event baked per
+                                 compile, nothing per execution), and a
+                                 Tracer argument in a field errors
 
 "Trace path" = a function that executes under jax tracing: ``_execute``
 and ``evaluate`` methods in the plan/ops/parallel layers, any function
@@ -376,10 +386,42 @@ class _RuleVisitor(ast.NodeVisitor):
             and parts[-2] in ("tr", "tracing")
         )
 
+    @staticmethod
+    def _is_telemetry_api(name: str) -> bool:
+        """Calls that belong to the telemetry / event-log surface
+        (runtime/telemetry.py, runtime/eventlog.py): any receiver or
+        attribute chain naming a telemetry object (`self.telemetry...`,
+        `registry.counter`, `eventlog.log`), the module-level
+        `log_event`, and metric-mutation methods on receivers that look
+        like metrics (`*_counter.inc`, `hist.observe`)."""
+        parts = name.split(".")
+        if any("telemetry" in p.lower() or "eventlog" in p.lower()
+               for p in parts):
+            return True
+        if parts[-1] in ("log_event", "render_openmetrics",
+                         "merge_snapshots"):
+            return True
+        if len(parts) > 1 and parts[-1] in ("inc", "dec", "observe",
+                                            "set_function"):
+            recv = parts[-2].lower()
+            return any(h in recv for h in (
+                "counter", "gauge", "histogram", "metric", "_tm_",
+            )) or recv.startswith("tm_") or recv.endswith("_tm")
+        return False
+
     def visit_Call(self, node: ast.Call) -> None:
         name = _dotted(node.func)
         if self._in_trace_path():
-            if self._is_tracing_api(name):
+            if self._is_telemetry_api(name):
+                self._emit(
+                    node, "DFTPU110",
+                    f"{name}() inside a traced function: telemetry and "
+                    "event-log instrumentation must stay host-side — "
+                    "under jit the call runs once at trace time (one "
+                    "phantom increment/event per COMPILE, nothing per "
+                    "execution) and a Tracer argument errors",
+                )
+            elif self._is_tracing_api(name):
                 self._emit(
                     node, "DFTPU109",
                     f"{name}() inside a traced function: tracing "
