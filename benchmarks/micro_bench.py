@@ -311,6 +311,183 @@ def main() -> None:
     })
     print(json.dumps(results[-1]), flush=True)
 
+    # ---- pipelined streaming shuffle --------------------------------------
+    # q5-shaped two-stage shuffle (peerless coordinator tier, DAG
+    # scheduler): a fact table hash-shuffled to 8 consumer tasks over 4
+    # workers, aggregated per partition, coalesced. Two injected costs
+    # stand in for what an in-process cluster lacks: a per-chunk wire
+    # delay on the partition streams (DCN latency) and a per-execute
+    # delay on the CONSUMER stage (device latency). Both planes pay both
+    # identically and produce byte-identical results (the gate test pins
+    # that); the MATERIALIZED plane serializes [stream the whole
+    # boundary] -> [two waves of delayed consumer executes], while the
+    # PIPELINED plane starts consumer task j the moment partition j
+    # closes — the first wave of consumer executes overlaps the later
+    # partitions' streaming, which is the pipeline-parallelism claim
+    # this case measures.
+    from datafusion_distributed_tpu.ops.aggregate import AggSpec as _Agg
+    from datafusion_distributed_tpu.parallel.exchange import (
+        partition_table as _ptab,
+    )
+    from datafusion_distributed_tpu.plan.exchanges import (
+        ShuffleExchangeExec as _Shuf,
+    )
+    from datafusion_distributed_tpu.plan.physical import (
+        HashAggregateExec as _HAgg,
+        MemoryScanExec as _MScan,
+    )
+    from datafusion_distributed_tpu.planner.distributed import (
+        DistributedConfig as _DCfg,
+        distribute_plan as _dplan,
+    )
+    from datafusion_distributed_tpu.runtime.worker import Worker as _Wkr
+
+    wire_ms = 3.0
+    consumer_delay_ms = 120.0
+
+    class _SlowWireWorker(_Wkr):
+        def execute_task_partitions(self, *a, **kw):
+            for item in super().execute_task_partitions(*a, **kw):
+                time.sleep(wire_ms / 1e3)
+                yield item
+
+    class _SlowWireCluster:
+        def __init__(self, n):
+            self.workers = {
+                f"mem://wire-{i}": _SlowWireWorker(f"mem://wire-{i}")
+                for i in range(n)
+            }
+            for w in self.workers.values():
+                w.peer_channels = self
+
+        def get_urls(self):
+            return list(self.workers.keys())
+
+        def get_worker(self, url):
+            return self.workers[url]
+
+    ps_n = 1 << 17
+    ps_ndv = 1 << 12
+    ps_t = arrow_to_table(pa.table({
+        "k": rng.integers(0, ps_ndv, ps_n), "v": rng.normal(size=ps_n),
+    }))
+
+    def two_stage_shuffle_plan():
+        scan = _MScan(_ptab(ps_t, 4), ps_t.schema())
+        # per-dest sized at 4x the expected rows-per-(producer, dest):
+        # the boundary cost, not padded compute, must dominate this case
+        ex = _Shuf(scan, ["k"], 8,
+                   round_up_pow2(max(4 * ps_n // (8 * 4), 8)))
+        agg = _HAgg("single", ["k"], [_Agg("sum", "v", "sv")], ex,
+                    num_slots=round_up_pow2(4 * ps_ndv))
+        agg.est_rows = ps_ndv
+        return _dplan(agg, _DCfg(num_tasks=8))
+
+    ps_plan = two_stage_shuffle_plan()
+    # the consumer stage's tasks run while materializing the SECOND
+    # boundary (the coalesce above the aggregate)
+    consumer_sid = max(
+        e.stage_id for e in ps_plan.collect(
+            lambda n: getattr(n, "is_exchange", False)
+        )
+    )
+
+    def run_pipelined(pipelined: bool):
+        cluster = wrap_cluster(_SlowWireCluster(4), FaultPlan(0, [
+            FaultSpec(site="execute", kind="delay",
+                      delay_s=consumer_delay_ms / 1e3, rate=1.0,
+                      stages=[consumer_sid]),
+        ]))
+        coord = Coordinator(
+            resolver=cluster, channels=cluster,
+            config_options={"stage_parallelism": 4,
+                            "peer_shuffle": False,
+                            "stream_chunk_rows": 1024,
+                            "pipelined_shuffle": pipelined},
+        )
+        t0 = time.perf_counter()
+        coord.execute(ps_plan)
+        return time.perf_counter() - t0, coord
+
+    run_pipelined(True)  # warm the XLA compile caches once
+    t_mat = min(run_pipelined(False)[0] for _ in range(2))
+    pl_runs = [run_pipelined(True) for _ in range(2)]
+    t_pipe, pl_coord = min(pl_runs, key=lambda r: r[0])
+    pl_bytes = sum(
+        v.get("exchange_bytes", 0)
+        for v in pl_coord.stream_metrics.values()
+        if v.get("plane") == "pipelined"
+    )
+    results.append({"bench": "pipelined_shuffle_materialized",
+                    "ms": round(t_mat * 1e3, 1)})
+    print(json.dumps(results[-1]), flush=True)
+    results.append({
+        "bench": "pipelined_shuffle_pipelined",
+        "ms": round(t_pipe * 1e3, 1),
+        "speedup_vs_materialized": round(t_mat / max(t_pipe, 1e-9), 2),
+        "exchange_bytes": pl_bytes,
+        "workers": 4,
+        "consumer_tasks": 8,
+        "wire_delay_per_chunk_ms": wire_ms,
+        "consumer_delay_ms": consumer_delay_ms,
+        "rows": ps_n,
+    })
+    print(json.dumps(results[-1]), flush=True)
+
+    # partial-aggregate push-down arm: an aggregate-over-shuffle plan
+    # (hand-placed boundary — raw rows cross the wire) with the
+    # statistics-driven push-down off vs on; the measured number is the
+    # exchange bytes the pre-shuffle partial states save.
+    pa_n = 1 << 16
+    pa_t = arrow_to_table(pa.table({
+        "k": rng.integers(0, 64, pa_n), "v": rng.normal(size=pa_n),
+    }))
+
+    def agg_over_shuffle(pushdown: bool):
+        scan = _MScan(_ptab(pa_t, 4), pa_t.schema())
+        ex = _Shuf(scan, ["k"], 4, round_up_pow2(max(4 * pa_n // 4, 8)))
+        agg = _HAgg("single", ["k"],
+                    [_Agg("sum", "v", "sv"),
+                     _Agg("count_star", None, "c")], ex)
+        agg.est_rows = 64
+        return _dplan(agg, _DCfg(num_tasks=4,
+                                 partial_agg_pushdown=pushdown))
+
+    def run_pushdown(pushdown: bool):
+        cluster = InMemoryCluster(4)
+        coord = Coordinator(
+            resolver=cluster, channels=cluster,
+            config_options={"stage_parallelism": 4,
+                            "peer_shuffle": False},
+        )
+        plan = agg_over_shuffle(pushdown)
+        coord.execute(plan)  # warm
+        t0 = time.perf_counter()
+        coord.execute(plan)
+        dt = time.perf_counter() - t0
+        xbytes = sum(
+            v.get("exchange_bytes", 0)
+            for v in coord.stream_metrics.values()
+            if "exchange_bytes" in v
+        ) // 2  # two executes recorded
+        return dt, xbytes
+
+    t_pd_off, b_off = run_pushdown(False)
+    t_pd_on, b_on = run_pushdown(True)
+    results.append({
+        "bench": "pipelined_shuffle_pushdown_off",
+        "ms": round(t_pd_off * 1e3, 2),
+        "exchange_bytes": b_off,
+    })
+    print(json.dumps(results[-1]), flush=True)
+    results.append({
+        "bench": "pipelined_shuffle_pushdown_on",
+        "ms": round(t_pd_on * 1e3, 2),
+        "exchange_bytes": b_on,
+        "bytes_reduction_vs_off": round(1 - b_on / max(b_off, 1), 4),
+    })
+    print(json.dumps(results[-1]), flush=True)
+
     # ---- multi-query serving throughput -----------------------------------
     # Closed-loop serving bench (runtime/serving.py): N clients each
     # submit-and-wait over a mixed workload — cheap q6-shaped aggregates

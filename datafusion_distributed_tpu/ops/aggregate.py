@@ -48,6 +48,23 @@ class AggSpec:
     output_name: str
 
 
+#: Aggregate functions whose partial states merge LOSSLESSLY through the
+#: partial -> (exchange) -> final mode chain above: sum/count/min/max
+#: merge as themselves, avg decomposes into a (sum, count) pair the
+#: final stage recombines. This is the eligibility set the planner's
+#: partial-aggregate push-down consults (planner/distributed.py
+#: `_partial_agg_pushdown_pass`) — one source of truth next to the
+#: kernel that implements the merges, so a new aggregate function only
+#: becomes push-down-eligible when its merge modes actually exist here.
+#: (The variance family also decomposes — see _VARIANCE_FUNCS — but is
+#: kept out of the push-down set: the ISSUE scope is sum/count/min/max
+#: + avg, and variance's (sum, sumsq, count) triple WIDENS the exchange
+#: payload 3x, defeating the bytes-reduction goal at low NDV gains.)
+PUSHDOWN_DECOMPOSABLE_FUNCS = frozenset(
+    {"sum", "count", "count_star", "min", "max", "avg"}
+)
+
+
 @dataclass
 class GroupTable:
     """Result of the claim loop: per-row group ids + per-slot key columns."""

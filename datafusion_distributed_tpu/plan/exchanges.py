@@ -53,6 +53,12 @@ class ExchangeExec(ExecutionPlan):
         # once this many rows arrived (host tier only; in-mesh collectives
         # are single-program and already bounded by the local limit)
         self.consumer_fetch: Optional[int] = None
+        # planner-predicted bytes crossing this boundary (stamped by the
+        # partial-aggregate push-down from sampled NDV statistics; the
+        # coordinator records predicted-vs-measured through the telemetry
+        # registry). Never a compile-cache or fingerprint input — it
+        # annotates the plan, it does not shape the trace.
+        self.predicted_exchange_bytes: Optional[int] = None
 
     def children(self):
         return [self.child]
@@ -103,6 +109,7 @@ class ShuffleExchangeExec(ExchangeExec):
         n.stage_id = self.stage_id
         n.producer_tasks = self.producer_tasks
         n.consumer_fetch = self.consumer_fetch
+        n.predicted_exchange_bytes = self.predicted_exchange_bytes
         return n
 
     def output_capacity(self):

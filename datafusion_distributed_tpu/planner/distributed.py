@@ -143,6 +143,21 @@ class DistributedConfig:
     # insert partial_reduce aggregates below hash shuffles (the reference's
     # `partial_reduce` knob, default off; see _partial_reduce_pass)
     partial_reduce: bool = False
+    # statistics-driven partial-aggregate push-down (`SET
+    # distributed.partial_agg_pushdown`): push decomposable aggregates
+    # (sum/count/min/max, avg via sum+count) BELOW hash shuffles when the
+    # sampled key-distribution statistics (catalog NDV -> est_rows)
+    # predict the partial states shrink the exchange payload, and stamp
+    # `predicted_exchange_bytes` on the rewritten shuffles so the
+    # coordinator can record predicted-vs-measured bytes (see
+    # _partial_agg_pushdown_pass; grounding: *Chasing Similarity* /
+    # *Partial Partial Aggregates*, PAPERS.md)
+    partial_agg_pushdown: bool = False
+    # minimum predicted BYTES reduction (0..1) for the push-down to fire:
+    # below it the pre-exchange aggregate is pure compute overhead (the
+    # high-NDV regime where distribution-aware placement says "aggregate
+    # after the exchange")
+    partial_agg_pushdown_min_reduction: float = 0.2
     # unlimited ORDER BY over data larger than this (global row capacity)
     # plans as a distributed sample sort (range shuffle + local sorts);
     # smaller sorts keep the cheaper coalesce-then-sort shape (two fewer
@@ -213,12 +228,14 @@ def distribute_plan(
     if plan.collect(lambda n: getattr(n, "is_exchange", False)):
         if _root_distribution(plan) == Distribution.PARTITIONED:
             plan = CoalesceExchangeExec(plan, config.num_tasks)
+        plan = _partial_agg_pushdown_pass(plan, config)
         return _prepare(plan)
     out, dist, ann = _inject(plan, config)
     if dist == Distribution.PARTITIONED:
         out, t_root = _seal_stage(out, ann, config)
         out = CoalesceExchangeExec(out, t_root)
     out = _partial_reduce_pass(out, config)
+    out = _partial_agg_pushdown_pass(out, config)
     out = _prepare(out)
     return out
 
@@ -644,6 +661,51 @@ def _mk_shuffle(child, keys, cfg: DistributedConfig,
     return ex
 
 
+def _repack_slots(partial: HashAggregateExec) -> int:
+    """Slot count for a partial_reduce re-pack: one task's slice can
+    hold at most `slice_capacity` distinct keys, so
+    min(global_slots, pow2(2 * slice_capacity)) keeps the load factor
+    <= 0.5 without the global table's padding (capacity-safe: groups
+    <= slice rows <= slice capacity, so this can never overflow)."""
+    return min(
+        partial.num_slots,
+        round_up_pow2(max(2 * partial.child.output_capacity(), 16)),
+    )
+
+
+def _repack_partial_shuffle(
+    node: ShuffleExchangeExec, cfg: DistributedConfig,
+    cap_per_dest: bool = False,
+) -> ShuffleExchangeExec:
+    """Insert a `partial_reduce` re-group between ``node``'s partial
+    aggregate and the shuffle, re-sizing the per-destination capacity
+    from the tighter slot count. ONE rewrite shared by
+    `_partial_reduce_pass` (unconditional, knob-gated) and the
+    stats-gated shape of `_partial_agg_pushdown_pass` — the capacity
+    arithmetic must not drift between them. ``cap_per_dest`` bounds the
+    new per-destination capacity by the original shuffle's (the
+    push-down pass never widens an exchange)."""
+    partial = node.child
+    slots = _repack_slots(partial)
+    reduce_node = HashAggregateExec(
+        "partial_reduce", partial.group_names, partial.aggs, partial,
+        slots,
+    )
+    per_dest = round_up_pow2(max(
+        cfg.shuffle_skew_factor * slots // max(node.num_tasks, 1), 8
+    ))
+    if cap_per_dest:
+        per_dest = min(node.per_dest_capacity, per_dest)
+    ex = ShuffleExchangeExec(
+        reduce_node, node.key_names, node.num_tasks, per_dest
+    )
+    ex.stage_id = node.stage_id
+    ex.producer_tasks = getattr(node, "producer_tasks", None)
+    ex.consumer_fetch = node.consumer_fetch
+    ex.predicted_exchange_bytes = node.predicted_exchange_bytes
+    return ex
+
+
 def _partial_reduce_pass(plan: ExecutionPlan,
                          cfg: DistributedConfig) -> ExecutionPlan:
     """Insert `mode=partial_reduce` between a producer stage's partial
@@ -673,23 +735,154 @@ def _partial_reduce_pass(plan: ExecutionPlan,
             and list(node.key_names) == list(node.child.group_names)
         ):
             return node
-        partial = node.child
-        slots = min(
-            partial.num_slots,
-            round_up_pow2(max(2 * partial.child.output_capacity(), 16)),
-        )
-        reduce_node = HashAggregateExec(
-            "partial_reduce", partial.group_names, partial.aggs, partial,
-            slots,
-        )
-        per_dest = round_up_pow2(max(
-            cfg.shuffle_skew_factor * slots // max(node.num_tasks, 1), 8
-        ))
-        ex = ShuffleExchangeExec(
-            reduce_node, node.key_names, node.num_tasks, per_dest
-        )
-        ex.producer_tasks = getattr(node, "producer_tasks", None)
-        return ex
+        return _repack_partial_shuffle(node, cfg)
+
+    return walk(plan)
+
+
+def _partial_agg_pushdown_pass(plan: ExecutionPlan,
+                               cfg: DistributedConfig) -> ExecutionPlan:
+    """Statistics-driven partial-aggregate push-down below hash shuffles
+    (`DistributedConfig.partial_agg_pushdown`, default off).
+
+    Two shapes, both decided from the SAMPLED key-distribution
+    statistics the planner already carries (catalog NDV samples stamped
+    as `est_rows` — planner/statistics.py):
+
+    1. ``agg(single) over shuffle over raw rows`` (pre-injected /
+       hand-placed boundaries, where the SQL planner's eager split never
+       ran): rewrite to ``agg(final) over shuffle over agg(partial)``
+       when the predicted partial-state bytes undercut the raw-row bytes
+       by at least `partial_agg_pushdown_min_reduction`. Eligibility:
+       decomposable aggregates only (sum/count/min/max, avg via its
+       sum+count decomposition — ops/aggregate.py
+       PUSHDOWN_DECOMPOSABLE_FUNCS) and shuffle keys ⊆ group keys (same
+       group ⇒ same partition, so the final merge is partition-local).
+       The rewritten shuffle's per-destination capacity and the final
+       aggregate's merge-table sizing come from the same prediction —
+       the consumer-side merge schedule follows the statistics instead
+       of the raw-row capacities.
+
+    2. ``shuffle over agg(partial)`` (the SQL planner's eager split):
+       the exchange already carries partial states; stamp the predicted
+       exchange bytes (so the coordinator can record
+       predicted-vs-measured through the telemetry registry) and insert
+       a `partial_reduce` re-pack — the `_partial_reduce_pass` rewrite —
+       only where the statistics predict it pays (per-task groups well
+       under the padded slice capacity), instead of unconditionally.
+
+    The decision is the distribution-aware placement of *Chasing
+    Similarity*: low-NDV keys collapse under pre-exchange aggregation
+    (q1's handful of groups), high-NDV keys gain nothing and skip the
+    extra aggregate. Prediction math: `expected_distinct` /
+    `predict_partial_agg_reduction` (planner/statistics.py)."""
+    if not cfg.partial_agg_pushdown:
+        return plan
+    from datafusion_distributed_tpu.ops.aggregate import (
+        PUSHDOWN_DECOMPOSABLE_FUNCS,
+    )
+    from datafusion_distributed_tpu.planner.statistics import (
+        estimate_rows,
+        predict_partial_agg_reduction,
+        row_width,
+    )
+
+    threshold = max(min(cfg.partial_agg_pushdown_min_reduction, 1.0), 0.0)
+
+    def agg_ndv(agg: HashAggregateExec, rows_in: float) -> float:
+        if agg.est_rows is not None:
+            return max(float(agg.est_rows), 1.0)
+        return max(rows_in ** 0.5, 1.0)
+
+    def walk(node: ExecutionPlan) -> ExecutionPlan:
+        children = [walk(c) for c in node.children()]
+        if children:
+            node = node.with_new_children(children)
+        # -- shape 1: single aggregate directly above a raw-row shuffle --
+        if (
+            isinstance(node, HashAggregateExec)
+            and node.mode == "single"
+            and node.group_names
+            and type(node.child) is ShuffleExchangeExec
+            and not isinstance(node.child.child, HashAggregateExec)
+            and set(node.child.key_names) <= set(node.group_names)
+            and all(a.func in PUSHDOWN_DECOMPOSABLE_FUNCS
+                    for a in node.aggs)
+        ):
+            ex = node.child
+            t_prod = (ex.producer_tasks if ex.producer_tasks is not None
+                      else ex.num_tasks)
+            rows_in = estimate_rows(ex.child)
+            ndv = agg_ndv(node, rows_in)
+            pred = predict_partial_agg_reduction(rows_in, ndv, t_prod)
+            partial = HashAggregateExec(
+                "partial", node.group_names, node.aggs, ex.child,
+            )
+            partial.est_rows = node.est_rows
+            w_raw = row_width(ex.child.schema())
+            w_partial = row_width(partial.schema())
+            bytes_in = rows_in * w_raw
+            bytes_out = pred.rows_out * w_partial
+            if bytes_in <= 0 or (
+                1.0 - bytes_out / bytes_in
+            ) < threshold:
+                return node  # high-NDV regime: aggregate after the wire
+            per_dest = min(
+                ex.per_dest_capacity,
+                round_up_pow2(max(
+                    cfg.shuffle_skew_factor
+                    * int(pred.rows_per_task + 1) // max(ex.num_tasks, 1),
+                    8,
+                )),
+            )
+            new_ex = ShuffleExchangeExec(
+                partial, ex.key_names, ex.num_tasks, per_dest
+            )
+            new_ex.stage_id = ex.stage_id
+            new_ex.producer_tasks = ex.producer_tasks
+            new_ex.consumer_fetch = ex.consumer_fetch
+            new_ex.predicted_exchange_bytes = int(bytes_out)
+            # consumer-side merge sizing mirrors _inject_aggregate's
+            # final stage: bounded by what the rewritten exchange can
+            # actually deliver (never an overflow the session retry
+            # could not already handle)
+            final = HashAggregateExec(
+                "final", node.group_names, node.aggs, new_ex,
+                min(node.num_slots,
+                    round_up_pow2(max(new_ex.output_capacity(), 16))),
+            )
+            final.est_rows = node.est_rows
+            return final
+        # -- shape 2: shuffle already over an eager partial aggregate ----
+        if (
+            type(node) is ShuffleExchangeExec
+            and isinstance(node.child, HashAggregateExec)
+            and node.child.mode == "partial"
+            and node.child.group_names
+            and list(node.key_names) == list(node.child.group_names)
+        ):
+            partial = node.child
+            t_prod = (node.producer_tasks
+                      if node.producer_tasks is not None
+                      else node.num_tasks)
+            rows_in = estimate_rows(partial.child)
+            ndv = agg_ndv(partial, rows_in)
+            pred = predict_partial_agg_reduction(rows_in, ndv, t_prod)
+            node.predicted_exchange_bytes = int(
+                pred.rows_out * row_width(partial.schema())
+            )
+            # stats-gated partial_reduce re-pack (the SAME rewrite the
+            # partial_reduce knob applies unconditionally —
+            # _repack_partial_shuffle): only when a task's slice
+            # capacity bounds its groups far tighter than the global
+            # table AND the key distribution actually collapses
+            if (_repack_slots(partial) < partial.num_slots
+                    and pred.reduction >= threshold
+                    and not isinstance(partial.child,
+                                       HashAggregateExec)):
+                return _repack_partial_shuffle(node, cfg,
+                                               cap_per_dest=True)
+        return node
 
     return walk(plan)
 

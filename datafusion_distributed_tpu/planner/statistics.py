@@ -300,6 +300,58 @@ def compute_based_task_count(
     return max(1, min(t, max_tasks))
 
 
+@dataclass
+class ExchangeReduction:
+    """Predicted effect of aggregating BELOW an exchange instead of above
+    it, from sampled key-distribution statistics (the decision input of
+    the partial-aggregate push-down — *Chasing Similarity*'s
+    distribution-aware aggregation placement)."""
+
+    rows_in: float  # raw rows that would cross without the push-down
+    rows_out: float  # partial-state rows that cross with it
+    rows_per_task: float  # expected distinct groups per producer task
+    reduction: float  # 1 - rows_out/rows_in (0 = no win, ->1 = collapse)
+
+
+def expected_distinct(n: float, ndv: float) -> float:
+    """Expected number of DISTINCT values observed in ``n`` draws from a
+    uniform domain of ``ndv`` values: ndv * (1 - (1 - 1/ndv)^n) — the
+    standard coupon-collector partial-coverage estimate. This is what
+    makes the push-down *distribution-aware*: a producer task holding
+    rows/t raw rows emits at most this many partial groups, so low-NDV
+    keys collapse (q1's 4 groups) while high-NDV keys barely shrink and
+    the push-down is skipped (pure compute overhead)."""
+    import math
+
+    n = max(float(n), 0.0)
+    ndv = max(float(ndv), 1.0)
+    if n <= 0:
+        return 0.0
+    # log-space for numerical stability at large n/ndv
+    return ndv * -math.expm1(n * math.log1p(-1.0 / ndv)) if ndv > 1 \
+        else 1.0
+
+
+def predict_partial_agg_reduction(
+    rows_in: float, ndv: float, t_producer: int
+) -> ExchangeReduction:
+    """Rows crossing a shuffle with vs without a pre-exchange partial
+    aggregate: each of ``t_producer`` tasks holds ~rows_in/t raw rows and
+    emits `expected_distinct(rows_in/t, ndv)` partial states. The NDV
+    comes from the catalog's sampled statistics (the `est_rows` the
+    planner stamps on aggregates) — the same NDV samples that size hash
+    tables."""
+    t = max(int(t_producer), 1)
+    rows_in = max(float(rows_in), 0.0)
+    per_task = expected_distinct(rows_in / t, ndv)
+    rows_out = min(per_task * t, rows_in)
+    reduction = 1.0 - (rows_out / rows_in) if rows_in > 0 else 0.0
+    return ExchangeReduction(
+        rows_in=rows_in, rows_out=rows_out, rows_per_task=per_task,
+        reduction=max(reduction, 0.0),
+    )
+
+
 def plan_device_bytes(plan) -> int:
     """Coarse upper bound on one program's device-buffer footprint:
     sum over nodes of output_capacity * row_width. Used by the

@@ -55,6 +55,7 @@ from datafusion_distributed_tpu.runtime.metrics import (
     FaultCounters,
     MetricsStore,
 )
+from datafusion_distributed_tpu.runtime.streams import StreamScanExec
 from datafusion_distributed_tpu.runtime.tracing import (
     DEFAULT_TRACE_STORE,
     NULL_TRACER,
@@ -110,6 +111,21 @@ HEDGING_DEFAULTS = {
 #: behavior, byte-identical results by design at any setting).
 SCHEDULER_DEFAULTS = {
     "stage_parallelism": 0,
+}
+
+#: pipelined-shuffle knob (`SET distributed.pipelined_shuffle`, default
+#: on): shuffle boundaries on the coordinator-mediated partition-stream
+#: plane stream producer slices into a live PartitionFeed and the
+#: consumer stage releases on FIRST SLICE instead of stage-complete —
+#: each consumer task then blocks only for ITS partition
+#: (runtime/streams.py StreamScanExec). Results are byte-identical to
+#: the materialized plane by construction (same chunk order, same
+#: capacity arithmetic). Engages only under the stage-DAG scheduler
+#: (stage_parallelism > 1 — `= 1` keeps the documented pre-scheduler
+#: materialized behavior) and only without a checkpointer (checkpoints
+#: snapshot materialized frontiers).
+PIPELINE_DEFAULTS = {
+    "pipelined_shuffle": True,
 }
 
 #: single lookup for every `SET distributed.*` knob default the
@@ -568,6 +584,12 @@ class Coordinator:
         # loser's cleanup lands before the query resolves — the leak
         # gates observe a quiesced store, never a racing release
         self._hedge_threads: list = []
+        # pipelined-shuffle feeder threads (one per pipelined boundary;
+        # GIL-atomic appends like _hedge_threads): joined in the finally
+        # so producer-side cleanup (task invalidation, staged-slice
+        # release inside the pull retry loops) lands before the query
+        # resolves and the leak gates observe a quiesced store
+        self._stream_feeds: list = []
         # one `query_resumed` event per execute, on the first restore
         self._resume_traced = False
         if self.checkpoints is not None:
@@ -628,6 +650,13 @@ class Coordinator:
             # the result) until it finishes or the join budget expires;
             # `task_timeout_s` bounds that wall when set
             for t in self._hedge_threads:
+                t.join(timeout=30.0)
+            # drain pipelined feeders: on success they already finished
+            # (the root stage consumed every partition); on failure the
+            # cancel event stops their pullers at the next checkpoint —
+            # either way their per-task cleanup runs before the query
+            # resolves
+            for t in self._stream_feeds:
                 t.join(timeout=30.0)
             for worker, key in self._peer_shipped:
                 try:
@@ -848,7 +877,17 @@ class Coordinator:
             plan, plan.children()[0], query_id
         )
         sid = plan.stage_id if plan.stage_id is not None else 0
-        self._record_stage_span(query_id, sid, t0, t0, _time.monotonic())
+        if isinstance(scan, StreamScanExec):
+            # pipelined boundary reached through the sequential fallback
+            # (e.g. an unschedulable hand-built plan at parallelism > 1):
+            # the span records at feed completion like the DAG path
+            scan.feed.on_complete(
+                lambda end_s, s=sid, t=t0:
+                self._record_stage_span(query_id, s, t, t, end_s)
+            )
+        else:
+            self._record_stage_span(query_id, sid, t0, t0,
+                                    _time.monotonic())
         return scan
 
     def _materialize_exchanges_dag(
@@ -890,6 +929,20 @@ class Coordinator:
             scan = self._materialize_exchange_node(
                 exchange, producer, query_id
             )
+            if isinstance(scan, StreamScanExec):
+                # pipelined boundary: the job resolved at FIRST SLICE —
+                # consumers release now while producers keep streaming.
+                # The stage span is recorded by the feed at COMPLETION
+                # (same submit/start as the materialized plane would
+                # use), so overlap-factor/explain_analyze keep covering
+                # the stage's true production window.
+                sid = (exchange.stage_id
+                       if exchange.stage_id is not None else 0)
+                scan.feed.on_complete(
+                    lambda end_s, s=sid, sub=submit_s, t=t0:
+                    self._record_stage_span(query_id, s, sub, t, end_s)
+                )
+                return scan, submit_s, t0, None
             return scan, submit_s, t0, _time.monotonic()
 
         # the stage jobs' executor: a per-query bounded pool, or — under
@@ -954,7 +1007,9 @@ class Coordinator:
                         self._signal_cancel()
                         continue
                     resolved[sid] = scan
-                    self._record_stage_span(query_id, sid, sub_s, t0, t1)
+                    if t1 is not None:  # pipelined spans record at feed
+                        self._record_stage_span(query_id, sid, sub_s, t0,
+                                                t1)
                     for c in sorted(consumers.get(sid, ())):
                         waiting[c].discard(sid)
                         if not waiting[c] and first_error is None and (
@@ -1162,6 +1217,16 @@ class Coordinator:
             isinstance(plan, ShuffleExchangeExec)
             and self._partition_streams_enabled(plan)
         ):
+            if self._pipelined_shuffle_enabled(plan):
+                # PIPELINED shuffle: producers stream partition slices
+                # into a live feed and this boundary resolves at FIRST
+                # SLICE — the consumer stage's tasks block only for
+                # their own partition (runtime/streams.py StreamScanExec)
+                scan = self._shuffle_stage_pipelined(
+                    plan, producer, query_id, stage_id, t_prod
+                )
+                self._seed_consumer_scan(plan, scan)
+                return scan
             # partition-range data plane: each producer serves its hash-
             # partitioned output over ONE multiplexed stream; the hashing
             # runs on the workers and the coordinator only demuxes
@@ -1178,6 +1243,19 @@ class Coordinator:
         if isinstance(plan, ShuffleExchangeExec) and not isinstance(
             plan, RangeShuffleExchangeExec
         ):
+            from datafusion_distributed_tpu.planner.statistics import (
+                row_width,
+            )
+
+            # bulk plane: the exchange moved the producers' LIVE rows
+            # through the coordinator (padded capacities are device
+            # buffers, not wire bytes here)
+            self._record_exchange_bytes(
+                plan, query_id, stage_id,
+                sum(int(o.num_rows) for o in outputs)
+                * row_width(producer.schema()),
+                "bulk",
+            )
             # consumer-count decision + regroup are overridable together:
             # the adaptive coordinator defers co-shuffled siblings so a
             # join stage's feeds agree on ONE adapted count
@@ -1553,6 +1631,36 @@ class Coordinator:
     # its consumer lattices derive from runtime LoadInfo and cannot be
     # re-derived at restore time (see the override below).
 
+    def _partition_stream_pullers(self, exchange, prepared, query_id,
+                                  stage_id, t_prod, chunk_rows,
+                                  trace_parent):
+        """One multiplexed partition-range puller per producer task —
+        SHARED by the materialized and pipelined shuffle planes: the
+        pull protocol (partition-range request shape, retry/reroute/
+        heal/hedge wrapping, trace parenting) must stay identical across
+        planes or their byte-identity contract drifts. Each puller
+        yields ((partition, chunk), est_bytes)."""
+        t_cons = exchange.num_tasks
+
+        def make_puller(task_number: int):
+            def body(worker, key, cancel):
+                for p, piece, est in worker.execute_task_partitions(
+                    key, exchange.key_names, t_cons, 0, t_cons,
+                    per_dest_capacity=exchange.per_dest_capacity,
+                    chunk_rows=chunk_rows, cancel=cancel,
+                ):
+                    yield (p, piece), est
+
+            def pull(cancel):
+                yield from self._pull_task_with_retry(
+                    prepared, query_id, stage_id, task_number, t_prod,
+                    body, cancel, trace_parent=trace_parent,
+                )
+
+            return pull
+
+        return [make_puller(i) for i in range(t_prod)]
+
     def _shuffle_stage_partition_streams(
         self, exchange, producer: ExecutionPlan, query_id: str,
         stage_id: int, t_prod: int,
@@ -1573,32 +1681,16 @@ class Coordinator:
         ))
         chunk_rows = int(self.config_options.get("stream_chunk_rows", 65536))
         prepared = self._prepare_stage_plan(producer)
-
-        def make_puller(task_number: int):
-            def body(worker, key, cancel):
-                for p, piece, est in worker.execute_task_partitions(
-                    key, exchange.key_names, t_cons, 0, t_cons,
-                    per_dest_capacity=exchange.per_dest_capacity,
-                    chunk_rows=chunk_rows, cancel=cancel,
-                ):
-                    yield (p, piece), est
-
-            def pull(cancel):
-                # `xfer` binds when the transfer span opens below, before
-                # any puller runs — pull spans nest under the transfer
-                yield from self._pull_task_with_retry(
-                    prepared, query_id, stage_id, task_number, t_prod,
-                    body, cancel, trace_parent=xfer.span_id,
-                )
-
-            return pull
-
         obs = self._chunk_observer(stage_id)
         tr = self._tr()
         with tr.span("transfer", "transfer", stage=stage_id,
                      plane="partition-stream") as xfer:
             chunks, stats = stream_stage_chunks(
-                [make_puller(i) for i in range(t_prod)], budget,
+                self._partition_stream_pullers(
+                    exchange, prepared, query_id, stage_id, t_prod,
+                    chunk_rows, xfer.span_id,
+                ),
+                budget,
                 max_concurrent=max(len(self.resolver.get_urls()), 1),
                 payload_rows=lambda pr: int(pr[1].num_rows),
                 on_chunk=(lambda pr: obs(pr[1])) if obs is not None
@@ -1616,6 +1708,10 @@ class Coordinator:
             "rows_per_s": round(stats.rows_per_s, 1),
             "bytes_per_s": round(stats.bytes_per_s, 1),
         }
+        self._record_exchange_bytes(
+            exchange, query_id, stage_id, stats.bytes_streamed,
+            "partition-stream",
+        )
         parts: list[list[Table]] = [[] for _ in range(t_cons)]
         for per in chunks:
             for p, tbl in per:
@@ -1632,6 +1728,185 @@ class Coordinator:
                     schema, 8, _leaf_dictionaries(producer, schema)
                 ))
         return slices
+
+    # -- pipelined shuffle plane ---------------------------------------------
+    def _pipelined_shuffle_enabled(self, exchange) -> bool:
+        """`SET distributed.pipelined_shuffle` (default on): stream the
+        shuffle's partition slices into a live PartitionFeed and release
+        the consumer stage at first slice. Requires the stage-DAG
+        scheduler (`stage_parallelism > 1` — `= 1` is the documented
+        materialized pre-scheduler behavior, the byte-identity baseline)
+        and no checkpointer (checkpoints snapshot MATERIALIZED
+        MemoryScan frontiers; a live feed has nothing restorable)."""
+        import os as _os
+
+        from datafusion_distributed_tpu.ops.table import parse_bool_knob
+
+        # env override wins over session config (the whole-suite A/B
+        # escape hatch, mirroring DFTPU_ZERO_COPY)
+        v = _os.environ.get("DFTPU_PIPELINED_SHUFFLE")
+        if v is None:
+            v = self.config_options.get(
+                "pipelined_shuffle",
+                PIPELINE_DEFAULTS["pipelined_shuffle"],
+            )
+        try:
+            enabled = parse_bool_knob(v)
+        except Exception:
+            enabled = bool(v)
+        if not enabled:
+            return False
+        if self.checkpoints is not None:
+            return False
+        return self._stage_parallelism() > 1
+
+    def _shuffle_stage_pipelined(
+        self, exchange, producer: ExecutionPlan, query_id: str,
+        stage_id: int, t_prod: int,
+    ) -> "StreamScanExec":
+        """Pipelined variant of `_shuffle_stage_partition_streams`: the
+        same per-producer multiplexed partition streams (same pullers,
+        same retry/hedge machinery, same shared byte budget), but demuxed
+        INCREMENTALLY into a `PartitionFeed` by a background feeder
+        thread. This method returns a `StreamScanExec` as soon as the
+        first slice lands — the boundary flips pending->ready while
+        producers are still emitting, and each consumer task's dispatch
+        blocks only until ITS partition closes. Byte identity with the
+        materialized plane holds because the feed preserves the exact
+        (producer, seq) merge order and capacity arithmetic."""
+        import threading as _threading
+
+        from datafusion_distributed_tpu.runtime.streams import (
+            PartitionFeed,
+            stream_partition_chunks,
+        )
+
+        t_cons = exchange.num_tasks
+        budget = int(self.config_options.get(
+            "worker_connection_buffer_budget_bytes", 64 << 20
+        ))
+        chunk_rows = int(self.config_options.get("stream_chunk_rows", 65536))
+        prepared = self._prepare_stage_plan(producer)
+        schema = producer.schema()
+        dicts = _leaf_dictionaries(producer, schema)
+        feed = PartitionFeed(t_cons, t_prod)
+        obs = self._chunk_observer(stage_id)
+        tr = self._tr()
+        # explicit start/end (no context manager): the transfer span
+        # covers the stream's full production window and is closed by the
+        # feeder thread at completion
+        xfer = tr.start_span(
+            "transfer", "transfer",
+            parent=tr.reserved_id(("stage", stage_id)),
+            stage=stage_id, plane="pipelined",
+        )
+        pullers = self._partition_stream_pullers(
+            exchange, prepared, query_id, stage_id, t_prod, chunk_rows,
+            xfer.span_id,
+        )
+        # visible immediately (plane attribution for stage spans recorded
+        # at first slice); the feeder overwrites with the full stats at
+        # completion
+        self.stream_metrics[(query_id, stage_id)] = {
+            "plane": "pipelined",
+            "partitions": t_cons,
+            "producers": t_prod,
+        }
+        max_conc = max(len(self.resolver.get_urls()), 1)
+
+        def run_feed() -> None:
+            try:
+                stats = stream_partition_chunks(
+                    pullers, budget, feed,
+                    max_concurrent=max_conc,
+                    on_chunk=obs,
+                    should_cancel=self._cancelled,
+                )
+            except BaseException as e:
+                # idempotent hardening: stream_partition_chunks fails
+                # the feed on its own error paths, but an exception from
+                # OUTSIDE them (a demux bug, a bad partition id) must
+                # also reach blocked consumers or an un-cancelled query
+                # would hang in wait_partition forever
+                feed.fail(e)
+                tr.end_span(xfer.set(error=type(e).__name__))
+                return
+            tr.end_span(xfer.set(
+                bytes=stats.bytes_streamed, rows=stats.rows,
+                chunks=stats.chunks,
+            ))
+            self.stream_metrics[(query_id, stage_id)] = {
+                "plane": "pipelined",
+                "bytes_streamed": stats.bytes_streamed,
+                "chunks": stats.chunks,
+                "peak_in_flight": stats.peak_in_flight,
+                "early_exit": stats.early_exit,
+                "rows": stats.rows,
+                "partitions": t_cons,
+                "producers": t_prod,
+                "rows_per_s": round(stats.rows_per_s, 1),
+                "bytes_per_s": round(stats.bytes_per_s, 1),
+                "pullers_leaked": stats.extra.get("pullers_leaked", 0),
+            }
+            self._record_exchange_bytes(
+                exchange, query_id, stage_id, stats.bytes_streamed,
+                "pipelined",
+            )
+
+        t = _threading.Thread(target=run_feed, daemon=True,
+                              name="dftpu-pipelined-feed")
+        if not hasattr(self, "_stream_feeds"):
+            # direct-call safety (tests materialize without execute)
+            self._stream_feeds = []
+        self._stream_feeds.append(t)
+        t.start()
+        # consumer release point: the first slice proves data is flowing
+        # (and surfaces an immediate producer failure HERE, on the stage
+        # job, exactly where the materialized plane would raise it)
+        feed.wait_first_chunk(self._cancelled)
+        return StreamScanExec(
+            feed, schema, dicts,
+            capacity_hint=t_prod * exchange.per_dest_capacity,
+            cancelled=self._cancelled,
+        )
+
+    def _record_exchange_bytes(self, exchange, query_id: str,
+                               stage_id: int, measured: int,
+                               plane: str) -> None:
+        """Predicted-vs-measured exchange accounting (the partial-agg
+        push-down feedback loop): the planner pass stamps
+        `predicted_exchange_bytes` on shuffles it rewrote from sampled
+        key-distribution statistics; the coordinator records both sides
+        into the process telemetry registry and the per-stage stream
+        metrics, so `dftpu_exchange_bytes` / `dftpu_exchange_predicted_
+        bytes` expose how good the prediction was. Host-side only, after
+        the stream resolved — never in traced code (DFTPU110)."""
+        predicted = getattr(exchange, "predicted_exchange_bytes", None)
+        sm = self.stream_metrics.setdefault(
+            (query_id, stage_id), {"plane": plane}
+        )
+        sm["exchange_bytes"] = int(measured)
+        if predicted is not None:
+            sm["predicted_exchange_bytes"] = int(predicted)
+        try:
+            from datafusion_distributed_tpu.runtime.telemetry import (
+                DEFAULT_REGISTRY,
+            )
+
+            DEFAULT_REGISTRY.counter(
+                "dftpu_exchange_bytes",
+                "Measured bytes crossing shuffle exchange boundaries.",
+                labels=("plane",),
+            ).inc(int(measured), plane=plane)
+            if predicted is not None:
+                DEFAULT_REGISTRY.counter(
+                    "dftpu_exchange_predicted_bytes",
+                    "Planner-predicted exchange bytes for shuffles "
+                    "rewritten by the partial-aggregate push-down.",
+                    labels=("plane",),
+                ).inc(int(predicted), plane=plane)
+        except Exception:
+            pass  # telemetry must never fail the exchange
 
     # -- task-count policy ---------------------------------------------------
     def _producer_task_count(self, exchange, producer) -> int:
@@ -1668,6 +1943,21 @@ class Coordinator:
             )
             if n.pinned_task is None and id(n) not in in_arm_peer
         ]
+        # pipelined-shuffle feeds (StreamScanExec): one partition per
+        # consumer task, and — like peer pull specs — every partition is
+        # a CONSUMPTION OBLIGATION: running fewer tasks than partitions
+        # would silently drop the untaken ones' rows
+        in_arm_stream = {
+            id(n)
+            for a in arms
+            for n in a.collect(lambda n: isinstance(n, StreamScanExec))
+        }
+        stream_scans = [
+            n for n in producer.collect(
+                lambda n: isinstance(n, StreamScanExec)
+            )
+            if id(n) not in in_arm_stream
+        ]
         need = 1 + max((a.assigned_task for a in arms), default=-1)
         partitioned = [s for s in scans if not s.replicated]
         partitioned_peer = [s for s in peer_scans if not s.replicated]
@@ -1677,10 +1967,11 @@ class Coordinator:
         need = max(
             need,
             max((len(s.pulls_per_task) for s in partitioned_peer), default=0),
+            max((s.num_partitions for s in stream_scans), default=0),
         )
         slice_counts = [len(s.tasks) for s in partitioned] + [
             len(s.pulls_per_task) for s in partitioned_peer
-        ]
+        ] + [s.num_partitions for s in stream_scans]
         if slice_counts:
             t = min(planned, max(slice_counts))
         elif scans or peer_scans:
@@ -3473,6 +3764,22 @@ def _task_specialized(plan: ExecutionPlan, task_number: int) -> ExecutionPlan:
     from datafusion_distributed_tpu.runtime.peer import PeerShuffleScanExec
 
     def walk(node: ExecutionPlan, in_arm: bool) -> ExecutionPlan:
+        if isinstance(node, StreamScanExec):
+            # pipelined-shuffle feed: resolve to THIS task's partition by
+            # blocking until it closes (the pipelined wait point — the
+            # feed keeps streaming the remaining partitions meanwhile).
+            # Inside an arm the sole consumer takes every partition,
+            # concatenated, mirroring the MemoryScan in-arm concat.
+            if in_arm:
+                slices = node.all_slices()
+                chosen = (slices[0] if len(slices) == 1 else concat_tables(
+                    slices, capacity=sum(s.capacity for s in slices)
+                ))
+            elif task_number < node.num_partitions:
+                chosen = node.task_slice(task_number)
+            else:
+                chosen = Table.empty(node.schema(), 8, node.dictionaries)
+            return MemoryScanExec([chosen], node.schema(), pinned=True)
         if isinstance(node, PeerShuffleScanExec):
             if node.pinned_task is not None or node.pull_all:
                 return node  # already specialized
@@ -3722,6 +4029,8 @@ def _leaf_dictionaries(plan: ExecutionPlan, schema) -> Optional[dict]:
         if isinstance(leaf, ParquetScanExec) and leaf.dictionaries:
             dicts = leaf.dictionaries
         elif isinstance(leaf, PeerShuffleScanExec) and leaf.dictionaries:
+            dicts = leaf.dictionaries
+        elif isinstance(leaf, StreamScanExec) and leaf.dictionaries:
             dicts = leaf.dictionaries
         elif isinstance(leaf, MemoryScanExec) and leaf.tasks:
             ref = leaf.tasks[0]

@@ -202,6 +202,10 @@ class PeerShuffleScanExec(ExecutionPlan):
             "rows": stats.rows,
             "producers": len(specs),
             "peak_in_flight": stats.peak_in_flight,
+            # abandoned puller threads (hung producers) — counted by the
+            # stream machinery into telemetry/eventlog; surfaced here so
+            # a consumer-side pull's leak is visible per boundary too
+            "pullers_leaked": stats.extra.get("pullers_leaked", 0),
         }
         if not flat:
             return Table.empty(self._schema, 8, self.dictionaries)

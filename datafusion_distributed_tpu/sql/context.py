@@ -216,13 +216,18 @@ class SessionConfig:
                         "the worker count)"
                     )
             elif key in ("fair_share", "zero_copy", "hedging",
-                         "checkpointing"):
+                         "checkpointing", "pipelined_shuffle",
+                         "partial_agg_pushdown"):
                 # boolean knobs: fair_share (serving scheduler policy),
                 # zero_copy (view-based data plane — `off` restores the
                 # copying plane everywhere), hedging (straggler
                 # speculative re-dispatch), checkpointing (query
-                # checkpoint/resume). One shared parser so SET-time
-                # coercion and runtime reads can't drift.
+                # checkpoint/resume), pipelined_shuffle (streaming
+                # first-slice shuffle boundaries — `off` restores the
+                # materialized plane), partial_agg_pushdown (statistics-
+                # driven pre-exchange partial aggregation). One shared
+                # parser so SET-time coercion and runtime reads can't
+                # drift.
                 from datafusion_distributed_tpu.ops.table import (
                     parse_bool_knob,
                 )

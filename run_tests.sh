@@ -179,8 +179,25 @@ if ! env DFTPU_LOCK_CHECK=1 python -m pytest tests/test_data_plane.py \
         -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
     FAILED+=("tests/test_data_plane.py[gate+lockcheck]")
 fi
+# Pipelined-shuffle gate (tests/test_pipelined_shuffle.py): shuffle
+# boundaries streaming partition slices into live feeds — byte-identical
+# pipelined-vs-materialized across TPC-H shapes on peer AND peerless
+# planes (incl. seeded chaos, membership churn, hedging), zero leaked
+# slices, plane toggle = zero new XLA traces, StreamBudget cancel-wake,
+# abandoned-puller accounting, and the statistics-driven partial-agg
+# push-down (plan rewrite, eligibility guards, predicted-vs-measured
+# exchange bytes). Runs under DFTPU_LOCK_CHECK=1: the feeder thread's
+# cross-thread slice handoff (PartitionFeed/StreamScanExec) is exactly
+# the schedule the PR 9 race harness exists for.
+echo "=== tests/test_pipelined_shuffle.py (pipelined-shuffle gate, DFTPU_LOCK_CHECK=1)"
+if ! env DFTPU_LOCK_CHECK=1 python -m pytest tests/test_pipelined_shuffle.py \
+        -q --no-header \
+        -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
+    FAILED+=("tests/test_pipelined_shuffle.py[gate+lockcheck]")
+fi
 for f in tests/test_*.py; do
     [ "$f" = "tests/test_recompile_budget.py" ] && continue  # ran above
+    [ "$f" = "tests/test_pipelined_shuffle.py" ] && continue  # ran above
     [ "$f" = "tests/test_plan_verify.py" ] && continue  # ran above (gate)
     [ "$f" = "tests/test_stage_scheduler.py" ] && continue  # ran above
     [ "$f" = "tests/test_serving.py" ] && continue  # ran above (gate)

@@ -1,0 +1,770 @@
+"""Pipelined streaming shuffle + partial-aggregate push-down (ISSUE 14).
+
+Shuffle boundaries on the coordinator-mediated partition-stream plane no
+longer materialize whole tables before consumers start: producers stream
+partition slices into a live `PartitionFeed` (runtime/streams.py), the
+stage-DAG scheduler releases the consumer stage at FIRST SLICE, and each
+consumer task's dispatch blocks only until ITS partition closes
+(`StreamScanExec` -> pinned MemoryScan at task specialization). On top,
+`DistributedConfig.partial_agg_pushdown` pushes decomposable partial
+aggregates (sum/count/min/max, avg via sum+count) below hash shuffles
+when the sampled NDV statistics predict the partial states shrink the
+exchange payload.
+
+Contracts pinned here:
+
+- PartitionFeed demux: deterministic (producer, seq) merge order (the
+  byte-identity anchor), per-partition completion, error + cancel wake.
+- StreamBudget cancel-notify: a blocked producer wakes on cancel without
+  the legacy 50 ms poll (CancelSignal hook).
+- Abandoned puller threads are COUNTED (stats.extra + telemetry +
+  structured event) instead of silently leaked.
+- Byte-identical results pipelined-vs-materialized across TPC-H shapes,
+  on both peer and peerless planes, under seeded chaos, membership
+  churn, and hedging; zero leaked TableStore slices.
+- Plane toggle performs ZERO new XLA traces (the consumer stage plans
+  are identical across planes by construction).
+- Checkpointing coordinators fall back to the materialized plane (a
+  live feed has no restorable frontier).
+- Push-down: plan rewrite + eligibility guards, predicted-vs-measured
+  exchange bytes through the telemetry registry, measured bytes reduced
+  on the aggregate-over-shuffle shape.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from datafusion_distributed_tpu.io.parquet import arrow_to_table
+from datafusion_distributed_tpu.ops.aggregate import (
+    PUSHDOWN_DECOMPOSABLE_FUNCS,
+    AggSpec,
+)
+from datafusion_distributed_tpu.ops.table import round_up_pow2
+from datafusion_distributed_tpu.parallel.exchange import partition_table
+from datafusion_distributed_tpu.plan.exchanges import ShuffleExchangeExec
+from datafusion_distributed_tpu.plan.physical import (
+    HashAggregateExec,
+    MemoryScanExec,
+)
+from datafusion_distributed_tpu.planner.distributed import (
+    DistributedConfig,
+    distribute_plan,
+)
+from datafusion_distributed_tpu.planner.statistics import (
+    expected_distinct,
+    predict_partial_agg_reduction,
+)
+from datafusion_distributed_tpu.runtime.chaos import (
+    FaultPlan,
+    FaultSpec,
+    MembershipEvent,
+    one_crash_per_stage,
+    wrap_cluster,
+)
+from datafusion_distributed_tpu.runtime.coordinator import (
+    Coordinator,
+    DynamicCluster,
+    InMemoryCluster,
+)
+from datafusion_distributed_tpu.runtime.streams import (
+    CancelSignal,
+    PartitionFeed,
+    StreamBudget,
+    _join_pullers,
+    StreamStats,
+)
+
+CHAOS_SEED = int(os.environ.get("DFTPU_CHAOS_SEED", "20260803"))
+
+FAST = {"task_retry_backoff_s": 0.001}
+
+TPCH_Q1 = """
+select l_returnflag, l_linestatus,
+       sum(l_quantity) as sum_qty,
+       sum(l_extendedprice) as sum_base_price,
+       avg(l_discount) as avg_disc,
+       count(*) as count_order
+from lineitem
+where l_shipdate <= date '1998-09-02'
+group by l_returnflag, l_linestatus
+order by l_returnflag, l_linestatus
+"""
+
+TPCH_Q3 = """
+select l_orderkey,
+       sum(l_extendedprice * (1 - l_discount)) as revenue,
+       o_orderdate, o_shippriority
+from customer, orders, lineitem
+where c_mktsegment = 'BUILDING'
+  and c_custkey = o_custkey
+  and l_orderkey = o_orderkey
+  and o_orderdate < date '1995-03-15'
+  and l_shipdate > date '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by revenue desc, o_orderdate
+limit 10
+"""
+
+TPCH_Q5 = """
+select n_name, sum(l_extendedprice * (1 - l_discount)) as revenue
+from customer, orders, lineitem, supplier, nation, region
+where c_custkey = o_custkey
+  and l_orderkey = o_orderkey
+  and l_suppkey = s_suppkey
+  and c_nationkey = s_nationkey
+  and s_nationkey = n_nationkey
+  and n_regionkey = r_regionkey
+  and r_name = 'ASIA'
+  and o_orderdate >= date '1994-01-01'
+  and o_orderdate < date '1995-01-01'
+group by n_name
+order by revenue desc
+"""
+
+TPCH_Q21 = """
+select s_name, count(*) as numwait
+from supplier, lineitem l1, orders, nation
+where s_suppkey = l1.l_suppkey
+  and o_orderkey = l1.l_orderkey
+  and o_orderstatus = 'F'
+  and l1.l_receiptdate > l1.l_commitdate
+  and exists (
+    select * from lineitem l2
+    where l2.l_orderkey = l1.l_orderkey
+      and l2.l_suppkey <> l1.l_suppkey
+  )
+  and not exists (
+    select * from lineitem l3
+    where l3.l_orderkey = l1.l_orderkey
+      and l3.l_suppkey <> l1.l_suppkey
+      and l3.l_receiptdate > l3.l_commitdate
+  )
+  and s_nationkey = n_nationkey
+  and n_name = 'SAUDI ARABIA'
+group by s_name
+order by numwait desc, s_name
+limit 100
+"""
+
+
+@pytest.fixture(scope="module")
+def tpch_ctx():
+    from datafusion_distributed_tpu.data.tpchgen import gen_tpch
+    from datafusion_distributed_tpu.sql.context import SessionContext
+
+    ctx = SessionContext()
+    ctx.config.distributed_options["bytes_per_task"] = 1  # force fan-out
+    ctx.config.distributed_options["broadcast_joins"] = False
+    for name, arrow in gen_tpch(sf=0.002, seed=7).items():
+        ctx.register_arrow(name, arrow)
+    return ctx
+
+
+def _coord(cluster, **opts):
+    return Coordinator(resolver=cluster, channels=cluster,
+                       config_options={**FAST, **opts})
+
+
+def _run(ctx, sql, cluster, **opts):
+    df = ctx.sql(sql)
+    coord = _coord(cluster, **opts)
+    out = df._strip_quals(
+        df.collect_coordinated_table(coordinator=coord, num_tasks=4)
+    ).to_pandas()
+    return out, coord
+
+
+def _assert_no_leaks(cluster):
+    for w in cluster.workers.values():
+        assert not w.table_store.tables, (
+            f"{w.url} leaked TableStore entries"
+        )
+        assert len(w.registry) == 0, f"{w.url} leaked registry entries"
+
+
+def _assert_frames_identical(got, base, label=""):
+    assert list(got.columns) == list(base.columns), label
+    for col in base.columns:
+        np.testing.assert_array_equal(
+            got[col].to_numpy(), base[col].to_numpy(),
+            err_msg=f"{label}.{col} diverged between planes",
+        )
+
+
+# ---------------------------------------------------------------------------
+# PartitionFeed / StreamBudget / leak-accounting units
+# ---------------------------------------------------------------------------
+
+
+class _Chunk:
+    """Table stand-in: the feed only forwards references."""
+
+    def __init__(self, tag):
+        self.tag = tag
+        self.num_rows = 1
+
+
+def test_partition_feed_merge_order_is_deterministic():
+    """Chunks of a partition return in (producer, seq) order — the
+    materialized collect's producer-major order — regardless of arrival
+    interleaving, and a partition closes once every producer moved past
+    it (or finished)."""
+    feed = PartitionFeed(num_partitions=2, num_producers=2)
+    a0, a1, b0 = _Chunk("a0"), _Chunk("a1"), _Chunk("b0")
+    # interleaved arrival: producer 1 lands its p0 chunk FIRST
+    feed.add(1, 0, b0)
+    feed.add(0, 0, a0)
+    feed.add(0, 0, a1)
+    # not ready: neither producer has moved past p0
+    ready = []
+    t = threading.Thread(
+        target=lambda: ready.append(feed.wait_partition(0)), daemon=True
+    )
+    t.start()
+    time.sleep(0.05)
+    assert not ready, "partition closed before producers moved past it"
+    feed.add(0, 1, _Chunk("a-p1"))  # producer 0 advances past p0
+    feed.producer_done(1)  # producer 1 finishes
+    t.join(2.0)
+    assert ready, "partition never closed"
+    assert [c.tag for c in ready[0]] == ["a0", "a1", "b0"]
+    # completion closes every remaining partition
+    feed.producer_done(0)
+    assert [c.tag for c in feed.wait_partition(1)] == ["a-p1"]
+    assert feed.wait_partition(1) == [], "chunks must drain exactly once"
+
+
+def test_partition_feed_error_and_cancel_wake():
+    feed = PartitionFeed(1, 1)
+    boom = RuntimeError("producer exploded")
+    woke = []
+    t = threading.Thread(
+        target=lambda: woke.append(
+            pytest.raises(RuntimeError, feed.wait_partition, 0)
+        ),
+        daemon=True,
+    )
+    t.start()
+    feed.fail(boom)
+    t.join(2.0)
+    assert woke, "waiter did not wake on feed failure"
+    # cancel predicate unblocks a fresh feed's waiter
+    from datafusion_distributed_tpu.runtime.errors import (
+        TaskCancelledError,
+    )
+
+    feed2 = PartitionFeed(1, 1)
+    with pytest.raises(TaskCancelledError):
+        feed2.wait_partition(0, cancelled=lambda: True)
+
+
+def test_stream_partition_chunks_fails_feed_on_producer_error():
+    """A producer error fails the feed IMMEDIATELY (before the failed
+    producer's trailing 'done' could mark its unfinished partitions
+    complete): waiters raise instead of building truncated slices, and
+    a later fatal error displaces an earlier retryable one (the stream
+    loops' rule, mirrored by PartitionFeed.fail)."""
+    from datafusion_distributed_tpu.runtime.errors import (
+        TransportError,
+        WorkerError,
+    )
+    from datafusion_distributed_tpu.runtime.streams import (
+        stream_partition_chunks,
+    )
+
+    rng = np.random.default_rng(2)
+    chunk = arrow_to_table(pa.table({"k": rng.integers(0, 4, 8)}))
+    boom = RuntimeError("producer died mid-stream")
+
+    def good(cancel):
+        for p in range(2):
+            yield (p, chunk), 64
+
+    def bad(cancel):
+        yield (0, chunk), 64
+        raise boom
+
+    feed = PartitionFeed(num_partitions=2, num_producers=2)
+    with pytest.raises(RuntimeError):
+        stream_partition_chunks([good, bad], 1 << 20, feed)
+    assert feed.error is boom
+    with pytest.raises(RuntimeError):
+        feed.wait_partition(1)
+    # fatal displaces retryable in the feed's stored error too
+    feed2 = PartitionFeed(1, 1)
+    feed2.fail(TransportError("flaky wire"))
+    fatal = WorkerError("semantic failure")
+    feed2.fail(fatal)
+    assert feed2.error is fatal
+
+
+def test_partition_feed_on_complete_fires_once():
+    feed = PartitionFeed(1, 1)
+    fired = []
+    feed.on_complete(lambda end: fired.append(end))
+    assert not fired
+    feed.producer_done(0)
+    feed.finish(StreamStats())
+    assert len(fired) == 1
+    # late registration on a completed feed fires immediately
+    feed.on_complete(lambda end: fired.append(end))
+    assert len(fired) == 2 and fired[0] == fired[1]
+
+
+def test_stream_budget_cancel_wakes_without_poll():
+    """A producer blocked in acquire() wakes the moment a BOUND cancel
+    sets — the CancelSignal hook notifies the condition, so the wait
+    carries no poll timeout (the satellite closing the 50 ms poll)."""
+    budget = StreamBudget(10)
+    cancel = CancelSignal()
+    budget.bind_cancel(cancel)
+    assert cancel in budget._bound
+    assert budget.acquire(8, cancel)
+    res = {}
+
+    def blocked():
+        t0 = time.perf_counter()
+        res["ok"] = budget.acquire(8, cancel)
+        res["dt"] = time.perf_counter() - t0
+
+    t = threading.Thread(target=blocked, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    t0 = time.perf_counter()
+    cancel.set()
+    t.join(2.0)
+    assert res["ok"] is False
+    # woke at cancellation latency, not at a poll tick after a long wait
+    assert time.perf_counter() - t0 < 1.0
+    # a hook registered AFTER set() still fires (registration race)
+    fired = []
+    cancel.add_hook(lambda: fired.append(1))
+    assert fired == [1]
+
+
+def test_abandoned_pullers_are_counted():
+    """`_join_pullers` counts stragglers into stats.extra, the process
+    telemetry registry (dftpu_stream_pullers_leaked_total) and the
+    structured event log — a hung producer is a visible signal now."""
+    from datafusion_distributed_tpu.runtime.eventlog import (
+        default_event_log,
+    )
+    from datafusion_distributed_tpu.runtime.telemetry import (
+        DEFAULT_REGISTRY,
+    )
+
+    ctr = DEFAULT_REGISTRY.counter(
+        "dftpu_stream_pullers_leaked",
+        "Stream puller threads abandoned after the join timeout "
+        "(a hung producer task the stream stopped waiting for).",
+    )
+    before = ctr.value()
+    hang = threading.Event()
+    threads = [
+        threading.Thread(target=hang.wait, daemon=True) for _ in range(2)
+    ]
+    for t in threads:
+        t.start()
+    stats = StreamStats()
+    _join_pullers(threads, stats, timeout_s=0.05)
+    hang.set()
+    assert stats.extra["pullers_leaked"] == 2
+    assert ctr.value() == before + 2
+    leaks = default_event_log().events(kind="stream_pullers_leaked")
+    assert leaks and leaks[-1]["count"] == 2
+
+
+def test_stream_scan_concurrent_slice_build_is_exactly_once():
+    """Feed chunks drain exactly once, so two threads resolving the SAME
+    consumer task (a hedged re-dispatch racing the primary's
+    specialization) must observe ONE built table — the claim protocol in
+    StreamScanExec.task_slice, not last-writer-wins."""
+    from datafusion_distributed_tpu.runtime.streams import StreamScanExec
+
+    rng = np.random.default_rng(11)
+    t = arrow_to_table(pa.table({"k": rng.integers(0, 4, 64)}))
+    feed = PartitionFeed(num_partitions=1, num_producers=1)
+    feed.add(0, 0, t)
+    feed.producer_done(0)
+    feed.finish(StreamStats())
+    scan = StreamScanExec(feed, t.schema())
+    got = []
+    threads = [
+        threading.Thread(target=lambda: got.append(scan.task_slice(0)))
+        for _ in range(4)
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(5.0)
+    assert len(got) == 4
+    assert all(g is got[0] for g in got), "slice build was not unique"
+    assert int(got[0].num_rows) == 64
+
+
+# ---------------------------------------------------------------------------
+# byte identity: pipelined vs materialized, across planes and faults
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("qname,sql", [
+    ("q1", TPCH_Q1), ("q3", TPCH_Q3), ("q5", TPCH_Q5),
+])
+def test_byte_identical_pipelined_vs_materialized(tpch_ctx, qname, sql):
+    """The acceptance anchor: the pipelined plane (peerless, DAG
+    scheduler) produces byte-identical results to the materialized
+    partition-stream plane AND to the peer plane, with zero leaks."""
+    cl = InMemoryCluster(4)
+    base, _ = _run(tpch_ctx, sql, cl, peer_shuffle=False,
+                   stage_parallelism=4, pipelined_shuffle=False)
+    _assert_no_leaks(cl)
+    cl = InMemoryCluster(4)
+    piped, coord = _run(tpch_ctx, sql, cl, peer_shuffle=False,
+                        stage_parallelism=4)
+    _assert_frames_identical(piped, base, f"{qname}-pipelined")
+    _assert_no_leaks(cl)
+    if qname == "q5":
+        # the bushy shape genuinely engaged the pipelined plane
+        planes = {v.get("plane") for v in coord.stream_metrics.values()}
+        assert "pipelined" in planes, coord.stream_metrics
+    # peer plane (knob inert there — consumers pull from producers
+    # directly): same bytes out
+    cl = InMemoryCluster(4)
+    peer, _ = _run(tpch_ctx, sql, cl, stage_parallelism=4)
+    _assert_frames_identical(peer, base, f"{qname}-peer")
+    _assert_no_leaks(cl)
+
+
+@pytest.mark.slow
+def test_byte_identical_q21_pipelined(tpch_ctx):
+    base, _ = _run(tpch_ctx, TPCH_Q21, InMemoryCluster(4),
+                   peer_shuffle=False, stage_parallelism=4,
+                   pipelined_shuffle=False)
+    got, _ = _run(tpch_ctx, TPCH_Q21, InMemoryCluster(4),
+                  peer_shuffle=False, stage_parallelism=4)
+    _assert_frames_identical(got, base, "q21")
+
+
+def test_pipelined_under_chaos_schedule(tpch_ctx):
+    """One injected crash per stage: the feeder's pull retry loops
+    re-dispatch producers and the result stays byte-identical to the
+    fault-free materialized run, zero leaks."""
+    base, _ = _run(tpch_ctx, TPCH_Q5, InMemoryCluster(4),
+                   peer_shuffle=False, stage_parallelism=4,
+                   pipelined_shuffle=False)
+    cluster = InMemoryCluster(4)
+    chaos = wrap_cluster(cluster, one_crash_per_stage(CHAOS_SEED))
+    got, coord = _run(tpch_ctx, TPCH_Q5, chaos,
+                      peer_shuffle=False, stage_parallelism=4)
+    _assert_frames_identical(got, base, "q5-chaos")
+    assert chaos.plan.fired, "chaos schedule never fired"
+    assert coord.faults.get("task_retries") >= 1
+    _assert_no_leaks(cluster)
+
+
+def test_pipelined_under_membership_churn(tpch_ctx):
+    """A worker leaves mid-query while its producers stream: the pull
+    retry loops reroute onto survivors; byte-identical, zero leaks."""
+    base, _ = _run(tpch_ctx, TPCH_Q3, InMemoryCluster(4),
+                   peer_shuffle=False, stage_parallelism=4,
+                   pipelined_shuffle=False)
+    cluster = DynamicCluster(4)
+    victim = cluster.get_urls()[-1]
+    chaos = wrap_cluster(cluster, FaultPlan(CHAOS_SEED, [], membership=[
+        MembershipEvent("leave", victim, site="execute", nth_call=1),
+    ]))
+    got, coord = _run(tpch_ctx, TPCH_Q3, chaos,
+                      peer_shuffle=False, stage_parallelism=4)
+    _assert_frames_identical(got, base, "q3-churn")
+    assert victim not in cluster.get_urls()
+    _assert_no_leaks(cluster)
+
+
+def test_pipelined_with_hedging(tpch_ctx):
+    """A sticky straggler worker under hedging: the streaming-plane
+    first-chunk hedge races inside the feeder's pullers; results stay
+    byte-identical and the loser's slices release."""
+    base, _ = _run(tpch_ctx, TPCH_Q3, InMemoryCluster(4),
+                   peer_shuffle=False, stage_parallelism=4,
+                   pipelined_shuffle=False)
+    cluster = InMemoryCluster(4)
+    chaos = wrap_cluster(cluster, FaultPlan(CHAOS_SEED, [
+        FaultSpec(site="execute", kind="straggler", delay_s=0.4,
+                  workers=["worker-1"], rate=1.0),
+    ], query_scoped=True))
+    got, coord = _run(
+        tpch_ctx, TPCH_Q3, chaos,
+        peer_shuffle=False, stage_parallelism=4,
+        hedging=True, hedge_floor_s=0.05, hedge_budget=4,
+    )
+    _assert_frames_identical(got, base, "q3-hedged")
+    assert coord.faults.get("hedges_issued") >= 1, coord.faults.as_dict()
+    _assert_no_leaks(cluster)
+
+
+def test_checkpointing_coordinator_stays_materialized():
+    """A coordinator wired with a checkpointer must NOT pipeline: the
+    checkpoint frontier is a materialized MemoryScan snapshot."""
+    coord = _coord(InMemoryCluster(4), stage_parallelism=4)
+    assert coord._pipelined_shuffle_enabled(None)
+    coord.checkpoints = object()
+    assert not coord._pipelined_shuffle_enabled(None)
+    coord.checkpoints = None
+    # sequential mode keeps the documented materialized behavior
+    coord.config_options["stage_parallelism"] = 1
+    assert not coord._pipelined_shuffle_enabled(None)
+    # knob off wins over everything
+    coord.config_options["stage_parallelism"] = 4
+    coord.config_options["pipelined_shuffle"] = "off"
+    assert not coord._pipelined_shuffle_enabled(None)
+
+
+def test_sequential_parallelism_never_pipelines(tpch_ctx):
+    _out, coord = _run(tpch_ctx, TPCH_Q5, InMemoryCluster(4),
+                       peer_shuffle=False, stage_parallelism=1)
+    planes = {v.get("plane") for v in coord.stream_metrics.values()}
+    assert "pipelined" not in planes
+
+
+def test_pipelined_stage_spans_cover_production(tpch_ctx):
+    """Pipelined stage spans record at FEED COMPLETION (the stage's full
+    production window), so overlap factor/explain_analyze stay
+    meaningful; the stream metrics carry the pipelined plane's counters
+    including the measured exchange bytes."""
+    _out, coord = _run(tpch_ctx, TPCH_Q5, InMemoryCluster(4),
+                       peer_shuffle=False, stage_parallelism=4)
+    piped = [
+        v for v in coord.stream_metrics.values()
+        if v.get("plane") == "pipelined"
+    ]
+    assert piped, coord.stream_metrics
+    for v in piped:
+        assert v.get("bytes_streamed", 0) > 0
+        assert v.get("exchange_bytes", 0) == v.get("bytes_streamed")
+        assert v.get("chunks", 0) >= 1
+        assert v.get("pullers_leaked", 0) == 0
+    # every pipelined stage recorded a scheduler span (at completion)
+    spans = coord.stage_metrics.stage_spans[coord.last_query_id]
+    assert any(s.get("plane") == "pipelined" for s in spans.values())
+
+
+def test_plane_toggle_causes_zero_new_traces(tpch_ctx):
+    """Recompile gate extension: the pipelined and materialized planes
+    build IDENTICAL consumer stage plans (same slice capacities), so
+    flipping the plane knob performs zero new XLA traces."""
+    from datafusion_distributed_tpu.plan import physical as phys
+
+    _run(tpch_ctx, TPCH_Q3, InMemoryCluster(4),
+         peer_shuffle=False, stage_parallelism=4)
+    before = phys.trace_count()
+    _run(tpch_ctx, TPCH_Q3, InMemoryCluster(4),
+         peer_shuffle=False, stage_parallelism=4,
+         pipelined_shuffle=False)
+    assert phys.trace_count() == before, (
+        "toggling pipelined_shuffle recompiled identical stage programs"
+    )
+
+
+# ---------------------------------------------------------------------------
+# partial-aggregate push-down
+# ---------------------------------------------------------------------------
+
+
+def _agg_over_shuffle_plan(n=1 << 13, ndv=8, aggs=None, keys=None,
+                           est_rows=None, pushdown=True, threshold=0.2):
+    """Hand-placed boundary shape: scan -> shuffle(k) -> single agg —
+    the aggregate-over-shuffle plan the push-down rewrites."""
+    rng = np.random.default_rng(3)
+    t = arrow_to_table(pa.table({
+        "k": rng.integers(0, ndv, n),
+        "v": rng.normal(size=n),
+        "w": rng.normal(size=n),
+    }))
+    scan = MemoryScanExec(partition_table(t, 4), t.schema())
+    ex = ShuffleExchangeExec(
+        scan, keys or ["k"], 4, round_up_pow2(max(4 * n // 4, 8))
+    )
+    agg = HashAggregateExec(
+        "single", ["k"],
+        aggs or [AggSpec("sum", "v", "sv"), AggSpec("avg", "w", "aw"),
+                 AggSpec("count_star", None, "c")],
+        ex,
+    )
+    agg.est_rows = est_rows if est_rows is not None else ndv
+    return distribute_plan(agg, DistributedConfig(
+        num_tasks=4, partial_agg_pushdown=pushdown,
+        partial_agg_pushdown_min_reduction=threshold,
+    ))
+
+
+def _agg_modes(plan):
+    return [
+        n.mode for n in plan.collect(
+            lambda n: isinstance(n, HashAggregateExec)
+        )
+    ]
+
+
+def test_pushdown_rewrites_single_over_shuffle():
+    plan = _agg_over_shuffle_plan(pushdown=True)
+    modes = _agg_modes(plan)
+    assert "partial" in modes and "final" in modes, modes
+    shuffles = plan.collect(
+        lambda n: type(n) is ShuffleExchangeExec
+    )
+    assert any(
+        s.predicted_exchange_bytes is not None
+        and isinstance(s.child, HashAggregateExec)
+        and s.child.mode == "partial"
+        for s in shuffles
+    )
+    # off: the single aggregate stays above the raw-row shuffle
+    off = _agg_over_shuffle_plan(pushdown=False)
+    assert _agg_modes(off) == ["single"]
+
+
+def test_pushdown_eligibility_guards():
+    # non-decomposable aggregate (variance family): never pushed
+    plan = _agg_over_shuffle_plan(
+        aggs=[AggSpec("stddev", "v", "sd")]
+    )
+    assert _agg_modes(plan) == ["single"]
+    assert "stddev" not in PUSHDOWN_DECOMPOSABLE_FUNCS
+    # shuffle keys not a subset of group keys: the final merge would not
+    # be partition-local — never pushed
+    plan = _agg_over_shuffle_plan(keys=["v"])
+    assert _agg_modes(plan) == ["single"]
+    # high-NDV keys (every row its own group): predicted reduction under
+    # the threshold — distribution-aware placement skips the push-down
+    plan = _agg_over_shuffle_plan(ndv=1 << 13, est_rows=1 << 13)
+    assert _agg_modes(plan) == ["single"]
+
+
+def test_pushdown_no_double_push_on_eager_split():
+    """The SQL planner's eager partial/final split stays a single
+    partial below the shuffle (no partial-over-partial), and the shuffle
+    gains the predicted-bytes stamp."""
+    rng = np.random.default_rng(5)
+    t = arrow_to_table(pa.table({
+        "k": rng.integers(0, 8, 4096), "v": rng.normal(size=4096),
+    }))
+    scan = MemoryScanExec([t], t.schema())
+    agg = HashAggregateExec(
+        "single", ["k"], [AggSpec("sum", "v", "sv")], scan, 64
+    )
+    agg.est_rows = 8
+    plan = distribute_plan(agg, DistributedConfig(
+        num_tasks=4, partial_agg_pushdown=True
+    ))
+    modes = _agg_modes(plan)
+    assert modes.count("partial") == 1, modes
+    stamped = [
+        s for s in plan.collect(lambda n: type(n) is ShuffleExchangeExec)
+        if s.predicted_exchange_bytes is not None
+    ]
+    assert stamped, "eager-split shuffle missed the predicted stamp"
+
+
+def test_pushdown_reduces_measured_exchange_bytes():
+    """The acceptance number: on the aggregate-over-shuffle shape the
+    push-down shrinks the measured exchange bytes by well over the
+    predicted margin, results agree (float reassociation tolerance — the
+    partial/final merge order differs from single's), and the predicted
+    stamp lands within 2x of the measured bytes."""
+    def run(plan):
+        cl = InMemoryCluster(4)
+        coord = _coord(cl, peer_shuffle=False, stage_parallelism=4)
+        out = coord.execute(plan).to_pandas()
+        out = out.sort_values("k").reset_index(drop=True)
+        stats = [
+            v for v in coord.stream_metrics.values()
+            if "exchange_bytes" in v
+        ]
+        _assert_no_leaks(cl)
+        return out, stats
+
+    off, s_off = run(_agg_over_shuffle_plan(pushdown=False))
+    on, s_on = run(_agg_over_shuffle_plan(pushdown=True))
+    np.testing.assert_array_equal(off["k"], on["k"])
+    np.testing.assert_array_equal(off["c"], on["c"])
+    assert np.allclose(off["sv"], on["sv"], rtol=1e-4, atol=1e-6)
+    assert np.allclose(off["aw"], on["aw"], rtol=1e-4, atol=1e-6)
+    bytes_off = sum(v["exchange_bytes"] for v in s_off)
+    bytes_on = sum(v["exchange_bytes"] for v in s_on)
+    assert bytes_on * 5 < bytes_off, (bytes_on, bytes_off)
+    pred = [v["predicted_exchange_bytes"] for v in s_on
+            if "predicted_exchange_bytes" in v]
+    assert pred, "predicted bytes never recorded"
+    meas = [v["exchange_bytes"] for v in s_on
+            if "predicted_exchange_bytes" in v]
+    for p, m in zip(pred, meas):
+        assert m / 2 <= p <= m * 2, (p, m)
+
+
+def test_pushdown_telemetry_counters():
+    from datafusion_distributed_tpu.runtime.telemetry import (
+        DEFAULT_REGISTRY,
+    )
+
+    meas = DEFAULT_REGISTRY.counter(
+        "dftpu_exchange_bytes",
+        "Measured bytes crossing shuffle exchange boundaries.",
+        labels=("plane",),
+    )
+    pred = DEFAULT_REGISTRY.counter(
+        "dftpu_exchange_predicted_bytes",
+        "Planner-predicted exchange bytes for shuffles "
+        "rewritten by the partial-aggregate push-down.",
+        labels=("plane",),
+    )
+    m0 = meas.value(plane="pipelined")
+    p0 = pred.value(plane="pipelined")
+    cl = InMemoryCluster(4)
+    coord = _coord(cl, peer_shuffle=False, stage_parallelism=4)
+    coord.execute(_agg_over_shuffle_plan(pushdown=True))
+    assert meas.value(plane="pipelined") > m0
+    assert pred.value(plane="pipelined") > p0
+
+
+def test_expected_distinct_prediction():
+    assert expected_distinct(0, 100) == 0.0
+    assert expected_distinct(1000, 1) == pytest.approx(1.0)
+    # full coverage at n >> ndv, near-linear at n << ndv
+    assert expected_distinct(10_000, 8) == pytest.approx(8.0, rel=1e-6)
+    assert expected_distinct(10, 1_000_000) == pytest.approx(10.0,
+                                                            rel=1e-2)
+    r = predict_partial_agg_reduction(80_000, 8, 4)
+    assert r.reduction > 0.99
+    r2 = predict_partial_agg_reduction(1000, 1000, 4)
+    assert r2.reduction < 0.3  # high NDV: nearly nothing collapses
+
+
+def test_pushdown_sql_tpch_results_hold(tpch_ctx):
+    """q1-shaped SQL (aggregate over the lineitem scan) with push-down
+    ON: results match the OFF plan within float-merge tolerance, exact
+    for integer outputs — the eager split already aggregates below the
+    exchange, so the pass only re-sizes/stamps (never corrupts)."""
+    import datafusion_distributed_tpu.sql.context as _cx
+
+    base, _ = _run(tpch_ctx, TPCH_Q1, InMemoryCluster(4),
+                   peer_shuffle=False, stage_parallelism=4)
+    tpch_ctx.config.set_option("distributed.partial_agg_pushdown", "on")
+    try:
+        got, coord = _run(tpch_ctx, TPCH_Q1, InMemoryCluster(4),
+                          peer_shuffle=False, stage_parallelism=4)
+    finally:
+        tpch_ctx.config.set_option(
+            "distributed.partial_agg_pushdown", "off"
+        )
+    assert list(got.columns) == list(base.columns)
+    for col in base.columns:
+        g, b = got[col].to_numpy(), base[col].to_numpy()
+        if g.dtype.kind in "fc":
+            assert np.allclose(g, b, rtol=1e-4, atol=1e-6), col
+        else:
+            np.testing.assert_array_equal(g, b, err_msg=col)
