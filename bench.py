@@ -602,6 +602,16 @@ def _serving_bench() -> None:
             timeout=1800.0,
         )
         slo = srv.slo_snapshot()
+        # aggregate staged-byte peak across the arm's worker stores
+        # (bench_compare's direction-aware peak_staged_bytes column;
+        # each arm builds a fresh cluster, so this is the arm's own peak)
+        try:
+            peak_staged = sum(
+                s.get("peak_nbytes", 0)
+                for s in srv.stats()["memory"]["workers"].values()
+            )
+        except Exception:
+            peak_staged = None
         srv.close()
         if res["errors"]:
             print(f"serving bench errors: {res['errors']}",
@@ -619,6 +629,7 @@ def _serving_bench() -> None:
             # rolling SLO attainment vs BENCH_SLO_P99_MS (telemetry.py)
             "slo_latency_attainment": slo.get("latency_attainment"),
             "slo_p99_ok": slo.get("p99_ok"),
+            "peak_staged_bytes": peak_staged,
         }
 
     # ---- injected-straggler arm (the ROADMAP serving-hardening gate):
@@ -733,6 +744,7 @@ def _serving_bench() -> None:
         "straggler_p99_ms_on": straggler_on["p99_ms"],
         "slo_p99_target_ms": slo_p99_ms,
         "slo_latency_attainment": fair["slo_latency_attainment"],
+        "peak_staged_bytes": fair["peak_staged_bytes"],
         "clients": clients, "sf": sf, "delay_ms": delay_ms,
         "straggler_ms": straggler_ms, "platform": platform,
         # just the three arm dicts: the config scalars live at the top

@@ -311,6 +311,74 @@ def main() -> None:
     })
     print(json.dumps(results[-1]), flush=True)
 
+    # ---- memory pressure: enforced worker budget + host spill -------------
+    # The q5 fan-out shape (same plan as the stage-overlap case) run
+    # twice on ONE cluster: an unconstrained warm-up + measured arm
+    # (reset_peak between them isolates the per-phase peak from the
+    # warm-up's), then the SAME cluster re-budgeted at 0.5x the measured
+    # per-worker peak — the spill path must absorb the difference.
+    # Reported: per-arm wall + peak staged MB, spilled MB, spill GB/s.
+    def mem_cluster():
+        cluster = InMemoryCluster(4)
+        coord = Coordinator(
+            resolver=cluster, channels=cluster,
+            config_options={"stage_parallelism": 4, "peer_shuffle": False},
+        )
+        return cluster, coord
+
+    def mem_run(cluster, coord):
+        df = sctx.sql(q5)
+        t0 = time.perf_counter()
+        df.collect_coordinated_table(coordinator=coord, num_tasks=4)
+        return time.perf_counter() - t0
+
+    def mem_stores(cluster):
+        return [
+            cluster.get_worker(u).table_store for u in cluster.get_urls()
+        ]
+
+    mp_cluster, mp_coord = mem_cluster()
+    mem_run(mp_cluster, mp_coord)  # warm the compile caches
+    for s in mem_stores(mp_cluster):
+        s.reset_peak()  # per-phase peak: the warm-up's must not leak in
+    t_unbounded = mem_run(mp_cluster, mp_coord)
+    peaks = [s.stats()["peak_nbytes"] for s in mem_stores(mp_cluster)]
+    peak_worker = max(peaks)
+    results.append({
+        "bench": "memory_pressure_unbounded",
+        "ms": round(t_unbounded * 1e3, 1),
+        "peak_staged_mb": round(sum(peaks) / 1e6, 2),
+        "peak_worker_mb": round(peak_worker / 1e6, 2),
+        "workers": 4,
+    })
+    print(json.dumps(results[-1]), flush=True)
+    mp_budget = max(peak_worker // 2, 1)
+    for s in mem_stores(mp_cluster):
+        s.reset_peak()
+        s.set_budget(mp_budget)
+    t_budgeted = mem_run(mp_cluster, mp_coord)
+    mp_stats = [s.stats() for s in mem_stores(mp_cluster)]
+    spilled = sum(st["spilled_total_bytes"] for st in mp_stats)
+    results.append({
+        "bench": "memory_pressure_budgeted",
+        "ms": round(t_budgeted * 1e3, 1),
+        "budget_mb": round(mp_budget / 1e6, 2),
+        "peak_staged_mb": round(
+            sum(st["peak_nbytes"] for st in mp_stats) / 1e6, 2
+        ),
+        "spilled_mb": round(spilled / 1e6, 2),
+        "spills": sum(st["spills"] for st in mp_stats),
+        "refaults": sum(st["refaults"] for st in mp_stats),
+        "spill_gbps": round(spilled / max(t_budgeted, 1e-9) / 1e9, 3),
+        "slowdown_vs_unbounded": round(
+            t_budgeted / max(t_unbounded, 1e-9), 2
+        ),
+        "workers": 4,
+    })
+    print(json.dumps(results[-1]), flush=True)
+    for s in mem_stores(mp_cluster):
+        s.set_budget(0)  # unconstrain: later cases share the process
+
     # ---- pipelined streaming shuffle --------------------------------------
     # q5-shaped two-stage shuffle (peerless coordinator tier, DAG
     # scheduler): a fact table hash-shuffled to 8 consumer tasks over 4

@@ -174,7 +174,8 @@ class Console:
         # fan-out per refresh (ObservabilityService.get_data_plane is the
         # standalone programmatic surface for the same numbers)
         dp = {"nbytes": 0, "entries": 0, "views": 0, "peak_nbytes": 0,
-              "dedup_hits": 0}
+              "dedup_hits": 0, "budget_bytes": 0, "spilled_nbytes": 0,
+              "spills": 0, "refaults": 0, "spill_files": 0}
         for w in workers:
             st = w.get("store")
             if isinstance(st, dict):
@@ -277,6 +278,17 @@ class Console:
                 f"({dp.get('views', 0)} views, "
                 f"{dp.get('dedup_hits', 0)} dedup)  "
                 f"{_DIM}peak {_fmt_bytes(dp.get('peak_nbytes', 0))}{_RESET}"
+            )
+        # enforced-budget line only once a budget or spill activity
+        # exists (a quiet unbudgeted tier adds no noise)
+        if dp.get("budget_bytes") or dp.get("spills"):
+            lines.append(
+                f"{_BOLD}memory{_RESET}     budget "
+                f"{_fmt_bytes(dp.get('budget_bytes', 0))}  spilled "
+                f"{_fmt_bytes(dp.get('spilled_nbytes', 0))} in "
+                f"{dp.get('spill_files', 0)} files  "
+                f"{_DIM}{dp.get('spills', 0)} spills / "
+                f"{dp.get('refaults', 0)} refaults{_RESET}"
             )
 
     def _render_telemetry(self, lines: list, shared: dict) -> None:

@@ -61,16 +61,24 @@ class FaultSpec:
     """One fault family: where, what, how often, and bounds."""
 
     site: str  # "set_plan" | "execute"
-    #: "crash" | "transport" | "delay" | "corrupt_plan" | "straggler".
-    #: "delay" rolls per CALL (uniform injected latency); "straggler" is
-    #: WORKER-PINNED: one seeded decision per (query, url) makes that
-    #: worker sticky-slow for the REST of the query at every matching
-    #: call — the real tail-latency pathology (one slow machine, not a
-    #: uniformly slow cluster) the hedger exists to beat. Caps count
-    #: straggler WORKERS elected, not delayed calls.
+    #: "crash" | "transport" | "delay" | "corrupt_plan" | "straggler" |
+    #: "oom". "delay" rolls per CALL (uniform injected latency);
+    #: "straggler" is WORKER-PINNED: one seeded decision per (query, url)
+    #: makes that worker sticky-slow for the REST of the query at every
+    #: matching call — the real tail-latency pathology (one slow machine,
+    #: not a uniformly slow cluster) the hedger exists to beat. Caps
+    #: count straggler WORKERS elected, not delayed calls. "oom"
+    #: COLLAPSES the target worker's enforced memory budget mid-query
+    #: (TableStore.set_budget to ``budget_bytes``, or half its current
+    #: resident bytes when unset) and delegates the call: the spill/
+    #: backpressure/shedding machinery must absorb it — results stay
+    #: byte-identical, zero leaked slices, zero leaked spill files.
     kind: str = "crash"
     rate: float = 1.0  # per-call probability (seed-hashed, deterministic)
     delay_s: float = 0.0  # for kind="delay"/"straggler": injected latency
+    #: for kind="oom": the collapsed budget (None = half the worker's
+    #: resident staged bytes at injection time, minimum 1)
+    budget_bytes: Optional[int] = None
     #: restrict to these worker urls (substring match); None = any worker
     workers: Optional[Sequence[str]] = None
     #: restrict to these stage ids; None = any stage
@@ -463,6 +471,8 @@ class ChaosWorker:
         if spec is not None:
             if spec.kind in ("delay", "straggler"):
                 _interruptible_sleep(spec.delay_s, cancel)
+            elif spec.kind == "oom":
+                self._apply_oom(spec)
             elif spec.kind == "corrupt_plan":
                 # in-transit corruption: a DEEP copy is mutated (the
                 # in-process transport shares the dict object with the
@@ -476,12 +486,30 @@ class ChaosWorker:
         return self._inner.set_plan(key, plan_obj, task_count, **kw)
 
     # -- intercepted data plane ---------------------------------------------
+    def _apply_oom(self, spec: FaultSpec) -> None:
+        """Collapse this worker's enforced memory budget (seeded
+        per-worker budget collapse): spill engages immediately on the
+        resident entries, and subsequent staging runs under the
+        collapsed budget. No error is raised — memory pressure is a
+        DEGRADATION fault, and the resilience machinery (spill,
+        backpressure, shedding) must absorb it without changing
+        results."""
+        store = getattr(self._inner, "table_store", None)
+        if store is None or not hasattr(store, "set_budget"):
+            return
+        budget = spec.budget_bytes
+        if budget is None:
+            budget = max(store.nbytes() // 2, 1)
+        store.set_budget(budget)
+
     def _execute_fault(self, key, cancel=None):
         self._membership("execute", key)
         spec = self._plan.decide("execute", self.url, key)
         if spec is not None:
             if spec.kind in ("delay", "straggler"):
                 _interruptible_sleep(spec.delay_s, cancel)
+            elif spec.kind == "oom":
+                self._apply_oom(spec)
             else:
                 _raise_for(spec, "execute", self.url, key)
 

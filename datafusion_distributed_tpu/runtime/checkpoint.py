@@ -98,13 +98,25 @@ class CheckpointStore:
     fan-out threads while the serving tier admits/releases concurrently.
     Slice staging/fetching runs OUTSIDE the lock (worker TableStore calls
     block on their own locks); only record bookkeeping is held under it.
+
+    Memory accounting: checkpoint slices stage through `TableStore.
+    put_as` — the ACCOUNTED surface — so they count against each
+    worker's staged bytes, enforced budget, and spill machinery like any
+    other entry (before the budget work they were visible but uncapped
+    demand). ``budget_bytes`` additionally caps the store's OWN total:
+    past it, the oldest recoverable checkpoints evict (slices released,
+    resume degrades to re-execution, `checkpoint_evicted_budget`
+    counter) instead of growing unbounded; the just-saved checkpoint is
+    protected so a single over-cap stage still makes progress.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, budget_bytes: int = 0) -> None:
         self._lock = threading.Lock()
         self._records: dict[str, QueryRecord] = {}  # guarded-by: _lock
         self.saves = 0  # guarded-by: _lock
         self.restores = 0  # guarded-by: _lock
+        self.budget_bytes = max(int(budget_bytes or 0), 0)
+        self.evicted_budget = 0  # guarded-by: _lock
 
     # -- query lifecycle -----------------------------------------------------
     def admit(self, sql: str, priority: int = 0) -> str:
@@ -236,7 +248,47 @@ class CheckpointStore:
                 except Exception:
                     pass
             return None
+        self._enforce_budget(channels, protect=(record_id,
+                                                (exec_index, stage_id)))
         return total
+
+    def _enforce_budget(self, channels, protect=None) -> None:
+        """Evict the OLDEST recoverable checkpoints while the store's
+        total staged bytes exceed ``budget_bytes`` (0 = uncapped).
+        ``protect`` — (record_id, stage_key) of the just-saved
+        checkpoint — is never evicted, so one over-cap stage still
+        lands. Slice release runs outside the lock."""
+        if not self.budget_bytes:
+            return
+        while True:
+            evicted = None
+            with self._lock:
+                total = sum(
+                    nb
+                    for r in self._records.values()
+                    for ck in r.stages.values()
+                    for _u, _t, nb in ck.slices
+                )
+                if total <= self.budget_bytes:
+                    return
+                cands = [
+                    (ck.saved_s, rid, key)
+                    for rid, r in self._records.items()
+                    for key, ck in r.stages.items()
+                    if (rid, key) != protect
+                ]
+                if not cands:
+                    return  # only the protected save remains: keep it
+                _, rid, key = min(cands)
+                evicted = self._records[rid].stages.pop(key)
+                self.evicted_budget += 1
+            for url, tid, _nb in evicted.slices:
+                try:
+                    getattr(channels.get_worker(url), "table_store").remove(
+                        [tid]
+                    )
+                except Exception:
+                    pass  # departed worker: its slices died with it
 
     def restore_stage(self, record_id: str, exec_index: int,
                       stage_id: int, fingerprint: Optional[str],
@@ -305,6 +357,8 @@ class CheckpointStore:
                 ),
                 "saves": self.saves,
                 "restores": self.restores,
+                "budget_bytes": self.budget_bytes,
+                "checkpoint_evicted_budget": self.evicted_budget,
             }
         return out
 

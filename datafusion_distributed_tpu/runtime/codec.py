@@ -67,18 +67,80 @@ def _table_nbytes(table) -> int:
     return table_nbytes(table)
 
 
+def _spill_event(name: str, tid: str, nbytes: int) -> None:
+    """Structured spill/refault trace event (runtime/eventlog.py) —
+    best-effort: observability must never fail the staging path."""
+    try:
+        from datafusion_distributed_tpu.runtime.eventlog import log_event
+
+        log_event(name, table_id=tid, nbytes=int(nbytes))
+    except Exception:
+        pass
+
+
 class _EntryMeta:
     """Accounting record of one store entry. ``base`` is None for an entry
     that OWNS its buffers (counted once in the store's byte total) and the
     owning entry's id for a view/alias (shares buffers, counted zero);
-    ``refs`` counts the aliases of an owning entry."""
+    ``refs`` counts the aliases of an owning entry. ``spilled`` holds the
+    entry's on-disk SpillSlot while its buffers live in the host spill
+    segment (runtime/spill.py) instead of memory; ``owner_query`` is the
+    query id staging attribution captured at insert (the serving tier's
+    estimate-vs-measured loop reads per-query peaks from it)."""
 
-    __slots__ = ("nbytes", "base", "refs")
+    __slots__ = ("nbytes", "base", "refs", "spilled", "owner_query")
 
-    def __init__(self, nbytes: int, base: Optional[str] = None):
+    def __init__(self, nbytes: int, base: Optional[str] = None,
+                 owner_query: Optional[str] = None):
         self.nbytes = int(nbytes)
         self.base = base
         self.refs = 0
+        self.spilled = None
+        self.owner_query = owner_query
+
+
+class _SpilledSentinel:
+    """Placeholder value a spilled entry's table id maps to: the entry is
+    LIVE (it still counts as staged, releases normally, leaks if leaked)
+    but its buffers are on disk until `get` refaults them."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return "<spilled>"
+
+
+_SPILLED = _SpilledSentinel()
+
+#: staging-attribution context (thread-local): while set, owned bytes
+#: inserted into ANY TableStore on this thread are attributed to the
+#: query id — the coordinator wraps dispatch encodes, the worker wraps
+#: decode + partition staging. Per-query peaks close the serving tier's
+#: estimate-vs-measured admission loop.
+_staging_attr = threading.local()
+
+
+class staging_attribution:
+    """``with staging_attribution(query_id): ...`` — attribute owned-byte
+    inserts on this thread to ``query_id`` (None = unattributed)."""
+
+    __slots__ = ("qid", "prev")
+
+    def __init__(self, qid: Optional[str]):
+        self.qid = qid
+        self.prev = None
+
+    def __enter__(self):
+        self.prev = getattr(_staging_attr, "qid", None)
+        _staging_attr.qid = self.qid
+        return self
+
+    def __exit__(self, *exc):
+        _staging_attr.qid = self.prev
+
+
+def _current_attribution() -> Optional[str]:
+    return getattr(_staging_attr, "qid", None)
 
 
 class _TableDict(dict):
@@ -173,9 +235,20 @@ class TableStore:
       entry/view counts and the high-water mark — the observability
       service's actual-staged-bytes surface, and the recorded entry sizes
       (`entry_nbytes`) are what dispatch encode spans attribute, so store
-      accounting and trace bytes can never disagree."""
+      accounting and trace bytes can never disagree.
+    - Budget-ENFORCED: when ``budget_bytes`` is set (constructor,
+      `set_budget`, the `DFTPU_WORKER_MEM_BUDGET` env, or the
+      `distributed.worker_memory_budget_bytes` knob shipped with task
+      configs), staging past the budget spills the coldest unreferenced
+      owned entries to a host-disk segment (runtime/spill.py) and `get`
+      refaults them transparently — byte-exact, original capacity
+      preserved. Entries pinned by views/aliases are unspillable (their
+      buffers are shared); `under_pressure()` reports residency still
+      over budget after spilling, which is what the stream planes'
+      producer backpressure keys on. Spill/refault file I/O always runs
+      OUTSIDE the store lock (DFTPU205)."""
 
-    def __init__(self) -> None:
+    def __init__(self, budget_bytes: Optional[int] = None) -> None:
         self._lock = threading.RLock()
         # every mutation of `tables` routes through _TableDict, which
         # takes this store's lock itself — the guarded fields below are
@@ -187,13 +260,33 @@ class TableStore:
         self.peak_nbytes = 0  # guarded-by: _lock
         self.put_count = 0  # guarded-by: _lock
         self.dedup_hits = 0  # guarded-by: _lock
+        # -- enforced memory budget (0 = unlimited) --------------------------
+        if budget_bytes is None:
+            import os
+
+            try:
+                budget_bytes = int(float(
+                    os.environ.get("DFTPU_WORKER_MEM_BUDGET", "0")
+                ))
+            except (TypeError, ValueError):
+                budget_bytes = 0
+        self.budget_bytes = max(int(budget_bytes or 0), 0)  # guarded-by: _lock
+        self._spill = None  # SpillManager, lazy  # guarded-by: _lock
+        self._spilling: set = set()  # tids mid-spill  # guarded-by: _lock
+        self.spilled_nbytes = 0  # live bytes in the segment  # guarded-by: _lock
+        self.spill_count = 0  # guarded-by: _lock
+        self.refault_count = 0  # guarded-by: _lock
+        # -- per-query staging attribution (logical demand, spill-blind) ----
+        self._query_bytes: dict[str, int] = {}  # guarded-by: _lock
+        self._query_peak: dict[str, int] = {}  # guarded-by: _lock
 
     # -- accounting core (callers hold self._lock) ---------------------------
     def _insert_locked(self, tid: str, table: Table,
                        base: Optional[str] = None,
                        nbytes: Optional[int] = None) -> str:
         meta = _EntryMeta(
-            _table_nbytes(table) if nbytes is None else nbytes, base=base
+            _table_nbytes(table) if nbytes is None else nbytes, base=base,
+            owner_query=_current_attribution(),
         )
         dict.__setitem__(self.tables, tid, table)
         self._meta[tid] = meta
@@ -201,11 +294,39 @@ class TableStore:
             self._by_identity[id(table)] = tid
             self._owned_nbytes += meta.nbytes
             self.peak_nbytes = max(self.peak_nbytes, self._owned_nbytes)
+            self._attr_add_locked(meta)
         else:
             b = self._meta.get(base)
             if b is not None:
                 b.refs += 1
         return tid
+
+    def _attr_add_locked(self, meta: _EntryMeta) -> None:
+        """Charge an OWNING insert's logical bytes to its query (spill-
+        blind: attribution measures staging DEMAND, which is what the
+        admission re-cost loop needs, not residency). Bounded: a
+        long-lived worker sheds the oldest query's attribution instead
+        of growing per-query dicts forever (sweep_query_attribution is
+        the cooperative path)."""
+        qid = meta.owner_query
+        if not qid or not meta.nbytes:
+            return
+        cur = self._query_bytes.get(qid, 0) + meta.nbytes
+        self._query_bytes[qid] = cur
+        if cur > self._query_peak.get(qid, 0):
+            self._query_peak[qid] = cur
+        while len(self._query_peak) > 512:
+            old = next(iter(self._query_peak))
+            self._query_peak.pop(old, None)
+            self._query_bytes.pop(old, None)
+
+    def _attr_sub_locked(self, meta: _EntryMeta) -> None:
+        qid = meta.owner_query
+        if not qid or not meta.nbytes:
+            return
+        cur = self._query_bytes.get(qid)
+        if cur is not None:
+            self._query_bytes[qid] = max(cur - meta.nbytes, 0)
 
     def _release_locked(self, tid: str) -> None:
         meta = self._meta.pop(tid, None)
@@ -220,9 +341,21 @@ class TableStore:
             if b is not None:
                 b.refs = max(b.refs - 1, 0)
             return
-        self._owned_nbytes -= meta.nbytes
-        if table is not None and self._by_identity.get(id(table)) == tid:
-            del self._by_identity[id(table)]
+        if meta.spilled is not None:
+            # spilled owner: its bytes live in the segment, not the
+            # resident total — release the disk slot instead (unlink,
+            # idempotent, O(1): not a registered blocking call). A view
+            # registered against it in put_view's unlocked window still
+            # promotes below: the view holds its own pre-spill buffers.
+            self.spilled_nbytes -= meta.nbytes
+            self._attr_sub_locked(meta)
+            if self._spill is not None:
+                self._spill.release(meta.spilled)
+        else:
+            self._owned_nbytes -= meta.nbytes
+            self._attr_sub_locked(meta)
+            if table is not None and self._by_identity.get(id(table)) == tid:
+                del self._by_identity[id(table)]
         if meta.refs > 0:
             # views/aliases still reference the buffers: promote the first
             # one to owner so shared staged bytes stay accounted until the
@@ -247,6 +380,7 @@ class TableStore:
                 self.peak_nbytes = max(
                     self.peak_nbytes, self._owned_nbytes
                 )
+                self._attr_add_locked(hm)
 
     def _canonical(self, tid: str) -> str:
         m = self._meta.get(tid)
@@ -269,12 +403,15 @@ class TableStore:
                                     nbytes=self._meta[canon].nbytes)
             else:
                 self._insert_locked(tid, table)
+        self.enforce_budget()
         return tid
 
     def put_as(self, tid: str, table: Table) -> str:
         """Stage under a caller-chosen id (the wire receive path — the
-        shipping side minted the id and the plan references it)."""
+        shipping side minted the id and the plan references it — and the
+        checkpoint store's accounted staging surface)."""
         self.tables[tid] = table
+        self.enforce_budget()
         return tid
 
     def put_view(self, base_tid: str, table: Optional[Table] = None,
@@ -283,18 +420,25 @@ class TableStore:
         shares the base buffers (zero owned bytes; the base stays pinned by
         refcount until the last view drops). ``table`` may be a view the
         caller already built over the entry's buffers; otherwise rows
-        [lo, lo+count) are sliced here via `get_slice`."""
+        [lo, lo+count) are sliced here via `get_slice`. The base resolves
+        BEFORE the lock is taken: a spilled base refaults in `get`, whose
+        file I/O must never run under the store lock (DFTPU205)."""
+        if table is None:
+            base_table = self.get(base_tid)  # refaults a spilled base
+            if count is None:
+                count = int(base_table.num_rows) - lo
+            table = self.get_slice(base_tid, lo, count)
         with self._lock:
-            if table is None:
-                base_table = self.get(base_tid)
-                if count is None:
-                    count = int(base_table.num_rows) - lo
-                table = self.get_slice(base_tid, lo, count)
             canon = self._canonical(base_tid)
             if canon not in self._meta:
                 raise CodecError(
                     f"table {base_tid} not in shipment store"
                 )
+            # the base may have (re-)spilled inside the unlocked window
+            # above: registering the view is still correct — the view
+            # holds its own (pre-spill) buffers, and the spilled-owner
+            # release path promotes surviving views exactly like the
+            # resident path, so nothing leaks accounting either way
             tid = uuid.uuid4().hex
             self.put_count += 1
             self._insert_locked(tid, table, base=canon)
@@ -304,7 +448,193 @@ class TableStore:
         with self._lock:
             if not dict.__contains__(self.tables, tid):
                 raise CodecError(f"table {tid} not in shipment store")
-            return dict.__getitem__(self.tables, tid)
+            val = dict.__getitem__(self.tables, tid)
+            m = self._meta.get(tid)
+            if m is not None:
+                # LRU touch: budget victim selection walks _meta in
+                # order, so a re-read entry moves to the hot end
+                self._meta[tid] = self._meta.pop(tid)
+            if val is not _SPILLED or m is None:
+                return val
+            slot = m.spilled
+        return self._refault(tid, slot)
+
+    def _refault(self, tid: str, slot) -> Table:
+        """Restore a spilled entry's buffers from the segment (file read
+        OUTSIDE the lock) and re-install them; a raced second refault or
+        a raced release both resolve to one consistent winner."""
+        from datafusion_distributed_tpu.runtime.spill import SpillError
+
+        try:
+            table = self._spill_manager().read_spill(slot)
+        except SpillError:
+            # a raced WINNER may have refaulted + released (unlinked)
+            # the slot between our locked read and this open: re-check
+            # under the lock and serve the winner's resident table — the
+            # entry is live and recoverable, never an error. A vanished
+            # ENTRY (raced remove) keeps the not-in-store contract.
+            with self._lock:
+                m = self._meta.get(tid)
+                if m is None:
+                    raise CodecError(
+                        f"table {tid} not in shipment store"
+                    )
+                if dict.__contains__(self.tables, tid):
+                    cur = dict.__getitem__(self.tables, tid)
+                    if cur is not _SPILLED:
+                        return cur
+                new_slot = m.spilled
+            if new_slot is not None and new_slot is not slot:
+                # re-spilled under a fresh slot mid-race: read that one
+                return self._refault(tid, new_slot)
+            raise
+        release_slot = None
+        with self._lock:
+            m = self._meta.get(tid)
+            if m is None or m.spilled is not slot:
+                # released (return the content that was live at call
+                # time) or already refaulted by a sibling (serve theirs)
+                if m is not None and dict.__contains__(self.tables, tid):
+                    cur = dict.__getitem__(self.tables, tid)
+                    if cur is not _SPILLED:
+                        table = cur
+            else:
+                dict.__setitem__(self.tables, tid, table)
+                m.spilled = None
+                self._owned_nbytes += m.nbytes
+                self.peak_nbytes = max(self.peak_nbytes, self._owned_nbytes)
+                self.spilled_nbytes -= m.nbytes
+                self.refault_count += 1
+                self._by_identity.setdefault(id(table), tid)
+                release_slot = slot
+        if release_slot is not None:
+            self._spill.release(release_slot)
+            _spill_event("store_refault", tid,
+                         self.entry_nbytes(tid))
+            # the refault may push residency back over budget: rebalance
+            # by spilling colder entries (never this one — it is now the
+            # hottest by LRU order)
+            self.enforce_budget()
+        return table
+
+    # -- enforced memory budget ---------------------------------------------
+    def _spill_manager(self):
+        with self._lock:
+            if self._spill is None:
+                from datafusion_distributed_tpu.runtime.spill import (
+                    SpillManager,
+                )
+
+                self._spill = SpillManager()
+            return self._spill
+
+    def set_budget(self, budget_bytes) -> None:
+        """Set/replace the enforced byte budget (0/None = unlimited) and
+        rebalance immediately — the chaos `kind="oom"` collapse path."""
+        try:
+            b = max(int(float(budget_bytes or 0)), 0)
+        except (TypeError, ValueError):
+            return
+        with self._lock:
+            self.budget_bytes = b
+        self.enforce_budget()
+
+    def under_pressure(self) -> bool:
+        """Residency still over budget AFTER spilling (every remaining
+        entry is pinned by refs or mid-spill): the producer-backpressure
+        signal the stream planes consult."""
+        with self._lock:
+            return bool(self.budget_bytes) and (
+                self._owned_nbytes > self.budget_bytes
+            )
+
+    def enforce_budget(self) -> int:
+        """Spill coldest unreferenced owned entries until resident owned
+        bytes fit the budget; -> bytes spilled. Victims are chosen under
+        the lock; the file WRITE runs outside it (DFTPU205), then the
+        entry swaps to the spilled sentinel if it is still live and
+        unchanged. No-op without a budget. A disk failure degrades to an
+        unenforced budget — never a failed staging."""
+        from datafusion_distributed_tpu.runtime.spill import SpillError
+
+        spilled_total = 0
+        while True:
+            with self._lock:
+                if not self.budget_bytes or (
+                    self._owned_nbytes <= self.budget_bytes
+                ):
+                    break
+                victim = next(
+                    (t for t, m in self._meta.items()
+                     if m.base is None and m.spilled is None
+                     and m.refs == 0 and t not in self._spilling
+                     and dict.get(self.tables, t) is not None),
+                    None,
+                )
+                if victim is None:
+                    break  # everything left is pinned: backpressure takes over
+                self._spilling.add(victim)
+                table = dict.__getitem__(self.tables, victim)
+                nbytes = self._meta[victim].nbytes
+            try:
+                slot = self._spill_manager().write_spill(table, nbytes)
+            except SpillError:
+                with self._lock:
+                    self._spilling.discard(victim)
+                break  # disk trouble: leave resident, stop trying
+            with self._lock:
+                self._spilling.discard(victim)
+                m = self._meta.get(victim)
+                live = (
+                    m is not None and m.base is None
+                    and m.spilled is None
+                    and dict.get(self.tables, victim) is table
+                )
+                if not live or m.refs > 0:
+                    # released/replaced/aliased while the write ran: the
+                    # slot is orphaned — drop it (release is idempotent)
+                    release_orphan = slot
+                else:
+                    release_orphan = None
+                    dict.__setitem__(self.tables, victim, _SPILLED)
+                    m.spilled = slot
+                    self._owned_nbytes -= m.nbytes
+                    self.spilled_nbytes += m.nbytes
+                    self.spill_count += 1
+                    spilled_total += m.nbytes
+                    if self._by_identity.get(id(table)) == victim:
+                        del self._by_identity[id(table)]
+            if release_orphan is not None:
+                self._spill.release(release_orphan)
+            else:
+                _spill_event("store_spill", victim, nbytes)
+        return spilled_total
+
+    def reset_peak(self) -> int:
+        """Reset the high-water mark to the CURRENT residency and return
+        the previous peak — per-phase peaks for bench arms (the lifetime
+        peak was monotone and made them unmeasurable)."""
+        with self._lock:
+            prev = self.peak_nbytes
+            self.peak_nbytes = self._owned_nbytes
+            return prev
+
+    # -- per-query staging attribution ---------------------------------------
+    def query_peak_nbytes(self, query_id: str) -> int:
+        """Peak logical bytes this query ever had staged here (demand,
+        spill-blind) — the measured side of the admission re-cost loop."""
+        with self._lock:
+            return self._query_peak.get(query_id, 0)
+
+    def query_current_nbytes(self, query_id: str) -> int:
+        with self._lock:
+            return self._query_bytes.get(query_id, 0)
+
+    def sweep_query_attribution(self, query_id: str) -> int:
+        """Drop a resolved query's attribution state; -> its peak."""
+        with self._lock:
+            self._query_bytes.pop(query_id, None)
+            return self._query_peak.pop(query_id, 0)
 
     def get_slice(self, tid: str, lo: int, count: int) -> Table:
         """Zero-copy row-range view of a staged entry (not registered —
@@ -337,14 +667,32 @@ class TableStore:
             views = sum(
                 1 for m in self._meta.values() if m.base is not None
             )
-            return {
+            out = {
                 "entries": len(self._meta),
                 "nbytes": self._owned_nbytes,
                 "views": views,
                 "peak_nbytes": self.peak_nbytes,
                 "puts": self.put_count,
                 "dedup_hits": self.dedup_hits,
+                "budget_bytes": self.budget_bytes,
+                "spilled_nbytes": self.spilled_nbytes,
+                "spills": self.spill_count,
+                "refaults": self.refault_count,
             }
+            spill = self._spill
+        # the spill manager's lock nests AFTER the store lock everywhere
+        # else; reading its counters outside ours keeps the static
+        # order graph a tree
+        if spill is not None:
+            ss = spill.stats()
+            out["spill_files"] = ss["spill_files"]
+            out["spilled_total_bytes"] = ss["spill_bytes"]
+            out["refaulted_total_bytes"] = ss["refault_bytes"]
+        else:
+            out["spill_files"] = 0
+            out["spilled_total_bytes"] = 0
+            out["refaulted_total_bytes"] = 0
+        return out
 
     def telemetry_families(self) -> list:
         """Typed-registry adapter (runtime/telemetry.py): the staged-byte
@@ -373,6 +721,23 @@ class TableStore:
             family("dftpu_store_dedup_hits", "counter",
                    "Identity-dedup hits (zero-byte aliases).",
                    [({}, s["dedup_hits"])]),
+            family("dftpu_store_budget_bytes", "gauge",
+                   "Enforced worker memory budget (0 = unlimited).",
+                   [({}, s["budget_bytes"])]),
+            family("dftpu_store_spilled_bytes", "gauge",
+                   "Live staged bytes resident in the host spill "
+                   "segment instead of memory.",
+                   [({}, s["spilled_nbytes"])]),
+            family("dftpu_store_spills", "counter",
+                   "Entries ever spilled to the host segment.",
+                   [({}, s["spills"])]),
+            family("dftpu_store_refaults", "counter",
+                   "Spilled entries refaulted back on get().",
+                   [({}, s["refaults"])]),
+            family("dftpu_store_spill_files", "gauge",
+                   "Spill files currently on disk (0 once drained — "
+                   "the zero-leak gate's file half).",
+                   [({}, s["spill_files"])]),
         ]
 
 

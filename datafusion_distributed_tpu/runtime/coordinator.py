@@ -447,6 +447,11 @@ class Coordinator:
     # exchange boundary snapshots its consumer slices on completion and
     # restores them — fingerprint-validated — on a resumed execute
     checkpoints: "object" = None
+    # measured peak staged bytes attributed to this coordinator's
+    # executes across the workers' TableStores (harvested by
+    # sweep_query): the MEASURED side of the serving tier's
+    # estimate-vs-reality admission loop
+    staged_peak_bytes: int = 0
 
     #: declarative concurrency model (tools/check_concurrency.py): these
     #: per-execute caches are shared by sibling-stage fan-out threads and
@@ -522,6 +527,13 @@ class Coordinator:
         # query's spans (a long-lived coordinator holds spans for many)
         plan._last_query_id = query_id
         self.last_query_id = query_id
+        # push the enforced worker memory budget (when configured) to the
+        # in-process workers BEFORE the first dispatch: dispatch encodes
+        # stage slices into the destination store ahead of set_plan, so
+        # the budget must be live by then (gRPC workers apply it from the
+        # shipped task config instead). Not a trace-relevant key — knob
+        # flips never recompile.
+        self._apply_worker_budgets()
         # distributed tracing (runtime/tracing.py): NULL_TRACER when off
         trace_store = self.trace_store or DEFAULT_TRACE_STORE
         try:
@@ -685,13 +697,82 @@ class Coordinator:
                 except Exception:
                     pass  # sweep hook must not mask the query's error
 
+    def _apply_worker_budgets(self) -> None:
+        """Apply `distributed.worker_memory_budget_bytes` (when present
+        in the session config) to every reachable in-process worker
+        store. Best-effort and idempotent; absent knob leaves env-set
+        budgets untouched."""
+        budget = self.config_options.get("worker_memory_budget_bytes")
+        if budget is None:
+            return
+        try:
+            urls = self.resolver.get_urls()
+        except Exception:
+            return
+        for url in urls:
+            try:
+                store = getattr(self.channels.get_worker(url),
+                                "table_store", None)
+                if store is not None and hasattr(store, "set_budget"):
+                    store.set_budget(budget)
+            except Exception:
+                pass  # a departed/wire worker: config ships it instead
+
+    def _store_pressure_probe(self):
+        """Producer-backpressure probe over the live workers' stores
+        (None when no store exposes one — wire transports): True while
+        ANY destination store is over its enforced budget, which the
+        stream planes' StreamBudget turns into trickle-paced producers
+        instead of a budget overrun."""
+        try:
+            urls = list(self.resolver.get_urls())
+        except Exception:
+            return None
+        stores = []
+        for url in urls:
+            try:
+                store = getattr(self.channels.get_worker(url),
+                                "table_store", None)
+            except Exception:
+                continue
+            if store is not None and hasattr(store, "under_pressure"):
+                stores.append(store)
+        if not stores:
+            return None
+
+        def probe() -> bool:
+            return any(s.under_pressure() for s in stores)
+
+        return probe
+
     def sweep_query(self, query_id: str) -> None:
         """Drop THIS query's accumulated per-task/stream metrics — the
         unbounded per-query dicts a long-lived serving coordinator would
         otherwise grow forever (stage spans are separately LRU-bounded in
         MetricsStore and stay for explain_analyze). Callers that want the
         data harvest it before sweeping; the serving tier calls this from
-        `on_query_end` once the QueryHandle captured its summary."""
+        `on_query_end` once the QueryHandle captured its summary.
+        Also harvests the query's per-store staging attribution into
+        `staged_peak_bytes` (summed across workers, maxed across this
+        coordinator's executes) — the measured peak the serving tier
+        re-costs admission with."""
+        peak = 0
+        try:
+            urls = list(self.resolver.get_urls())
+        except Exception:
+            urls = []
+        for url in urls:
+            try:
+                store = getattr(self.channels.get_worker(url),
+                                "table_store", None)
+                if store is not None and hasattr(
+                    store, "sweep_query_attribution"
+                ):
+                    peak += store.sweep_query_attribution(query_id)
+            except Exception:
+                pass  # departed worker: its attribution died with it
+        if peak > self.staged_peak_bytes:
+            self.staged_peak_bytes = peak
         # list() snapshots are taken in C (no GIL release) so sweeping one
         # query never races another in-flight query's inserts
         for key in [k for k in list(self.metrics) if k.query_id == query_id]:
@@ -1695,6 +1776,7 @@ class Coordinator:
                 payload_rows=lambda pr: int(pr[1].num_rows),
                 on_chunk=(lambda pr: obs(pr[1])) if obs is not None
                 else None,
+                pressure=self._store_pressure_probe(),
             )
             xfer.set(bytes=stats.bytes_streamed, rows=stats.rows,
                      chunks=stats.chunks)
@@ -1813,6 +1895,7 @@ class Coordinator:
             "producers": t_prod,
         }
         max_conc = max(len(self.resolver.get_urls()), 1)
+        pressure_probe = self._store_pressure_probe()
 
         def run_feed() -> None:
             try:
@@ -1821,6 +1904,7 @@ class Coordinator:
                     max_concurrent=max_conc,
                     on_chunk=obs,
                     should_cancel=self._cancelled,
+                    pressure=pressure_probe,
                 )
             except BaseException as e:
                 # idempotent hardening: stream_partition_chunks fails
@@ -2079,6 +2163,7 @@ class Coordinator:
                 max_concurrent=max(len(self.resolver.get_urls()), 1),
                 on_progress=progress,
                 on_chunk=self._chunk_observer(stage_id),
+                pressure=self._store_pressure_probe(),
             )
             xfer.set(bytes=stats.bytes_streamed, rows=stats.rows,
                      chunks=stats.chunks, early_exit=stats.early_exit)
@@ -3136,9 +3221,17 @@ class Coordinator:
         with tr.span("dispatch", "dispatch", parent=trace_parent,
                      stage=stage_id, task=task_number, worker=url) as dsp:
             with tr.span("encode", "codec", stage=stage_id) as esp:
-                plan_obj = encode_plan(
-                    _task_specialized(stage_plan, task_number), store
+                from datafusion_distributed_tpu.runtime.codec import (
+                    staging_attribution,
                 )
+
+                # per-query staged-byte attribution (estimate-vs-measured
+                # loop): owned bytes this encode stages into the worker
+                # store are charged to this query id
+                with staging_attribution(query_id):
+                    plan_obj = encode_plan(
+                        _task_specialized(stage_plan, task_number), store
+                    )
                 if tr.active:
                     from datafusion_distributed_tpu.runtime.codec import (
                         collect_table_ids as _ctids,
@@ -3282,9 +3375,15 @@ class Coordinator:
                 urls = self.resolver.get_urls()
                 url = urls[(stage_id + span) % len(urls)]
                 worker = self.channels.get_worker(url)
-                plan_obj = encode_plan(
-                    span_specialized(stage_plan, lo, hi), worker.table_store
+                from datafusion_distributed_tpu.runtime.codec import (
+                    staging_attribution,
                 )
+
+                with staging_attribution(query_id):
+                    plan_obj = encode_plan(
+                        span_specialized(stage_plan, lo, hi),
+                        worker.table_store,
+                    )
                 try:
                     worker.set_stage_plan(
                         query_id, stage_id, lo, hi, task_count, plan_obj,

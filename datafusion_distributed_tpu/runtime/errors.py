@@ -126,6 +126,20 @@ class TaskCancelledError(QueryError):
     retryable = False
 
 
+class QueryPreemptedError(TaskCancelledError):
+    """The serving tier SHED this query under memory pressure: a worker
+    crossed the hard red-line (resident staged bytes over budget x
+    `distributed.worker_memory_redline`) and this was the lowest-priority
+    running query. A TaskCancelledError subclass — preemption rides the
+    existing cancel path, charges no worker's health and no SLO error
+    budget — but typed so callers can distinguish shedding from a user
+    cancel: the query's checkpoint frontier is RETAINED and
+    `ServingSession.recover()` resumes it byte-identically once pressure
+    clears."""
+
+    retryable = False
+
+
 class PlanIntegrityError(WorkerError):
     """A shipped plan failed its integrity check: the decoded plan's
     structural fingerprint (plan/fingerprint.py) does not match the

@@ -98,11 +98,17 @@ def _handlers(worker: Worker):
             # materialize shipped table slices into the worker's store at
             # their ORIGINAL padded capacities (see the client-side comment
             # on table_caps: re-padding would change the plan fingerprint);
-            # put_as routes through the store's byte accounting
-            for tid, raw in blobs.items():
-                worker.table_store.put_as(
-                    tid, decode_table(raw, capacity=caps.get(tid))
-                )
+            # put_as routes through the store's byte accounting AND the
+            # enforced-budget gate, attributed to the shipping query
+            from datafusion_distributed_tpu.runtime.codec import (
+                staging_attribution,
+            )
+
+            with staging_attribution(key.query_id):
+                for tid, raw in blobs.items():
+                    worker.table_store.put_as(
+                        tid, decode_table(raw, capacity=caps.get(tid))
+                    )
             worker.set_plan(key, header["plan"], header["task_count"],
                             config=header.get("config"),
                             headers=header.get("headers"),

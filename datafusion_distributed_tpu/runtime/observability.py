@@ -186,7 +186,8 @@ class ObservabilityService:
         `get_cluster_workers`."""
         workers: dict = {}
         totals = {"nbytes": 0, "entries": 0, "views": 0, "peak_nbytes": 0,
-                  "dedup_hits": 0}
+                  "dedup_hits": 0, "budget_bytes": 0, "spilled_nbytes": 0,
+                  "spills": 0, "refaults": 0, "spill_files": 0}
         for url in self.resolver.get_urls():
             try:
                 info = self.channels.get_worker(url).get_info()

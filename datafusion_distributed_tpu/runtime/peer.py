@@ -193,8 +193,18 @@ class PeerShuffleScanExec(ExecutionPlan):
 
             return pull
 
+        # producer backpressure (enforced worker memory budget): while
+        # the CONSUMER worker's store is over budget, pulls trickle
+        # instead of piling pulled chunks onto an already-pressured host
+        local_store = getattr(self._local_worker, "table_store", None)
+        pressure = (
+            local_store.under_pressure
+            if local_store is not None
+            and hasattr(local_store, "under_pressure") else None
+        )
         chunks, stats = stream_stage_chunks(
-            [make_puller(s) for s in specs], self.budget_bytes
+            [make_puller(s) for s in specs], self.budget_bytes,
+            pressure=pressure,
         )
         flat = [c for per in chunks for c in per]
         self.last_pull_stats = {

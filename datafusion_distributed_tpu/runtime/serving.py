@@ -77,6 +77,10 @@ RUNNING = "running"
 DONE = "done"
 FAILED = "failed"
 CANCELLED = "cancelled"
+#: shed under memory pressure (red-line load shedding): resolved with a
+#: typed QueryPreemptedError, checkpoint frontier RETAINED so recover()
+#: resumes the query when pressure clears
+PREEMPTED = "preempted"
 
 #: serving knob defaults, settable per session via `SET distributed.<knob>`
 #: (validated at SET time, sql/context.py). The ADMISSION knobs
@@ -101,6 +105,13 @@ SERVING_DEFAULTS = {
     #: (the tracker still reports the rolling p99/error rate).
     "slo_p99_ms": None,
     "slo_error_rate": None,
+    #: red-line load shedding (with the enforced worker memory budget,
+    #: `SET distributed.worker_memory_budget_bytes`): a worker whose
+    #: RESIDENT staged bytes stay over budget x this factor — i.e. spill
+    #: already failed to relieve it — triggers preemption of the
+    #: lowest-priority running query (typed QueryPreemptedError, its
+    #: checkpoint frontier retained for recover()). 0 disables shedding.
+    "worker_memory_redline": 1.25,
 }
 
 
@@ -134,14 +145,37 @@ class QueryHandle:
         # checkpoint-store record id (runtime/checkpoint.py) when the
         # session checkpoints; pre-set by recover() for resumed queries
         self._ckpt_record: Optional[str] = None
+        # red-line load shedding (the session's memory monitor): set
+        # BEFORE the cancel event fires so _drive classifies the
+        # resulting TaskCancelledError as preemption, not a user cancel
+        self._preempted = False
+        # measured peak staged bytes (TableStore attribution summed
+        # across workers), harvested when the query resolves — the
+        # measured side of the est_bytes admission loop
+        self.peak_staged_bytes = 0
         # the coordinator-internal query id of the MAIN execute (stamped
         # by the driver) — the key into the distributed-tracing store,
         # isolating this handle's trace from every concurrent query's
         self.trace_query_id: Optional[str] = None
 
     # -- inspection ---------------------------------------------------------
-    def status(self) -> str:
-        return self._state
+    def status(self, detail: bool = False):
+        """Lifecycle state string; ``detail=True`` returns a dict adding
+        the admission estimate, the MEASURED per-query peak staged bytes
+        (populated once the query resolves; the serving tier re-costs
+        later admissions of the same SQL from it), and the preemption
+        flag."""
+        if not detail:
+            return self._state
+        return {
+            "state": self._state,
+            "priority": self.priority,
+            "est_bytes": self.est_bytes,
+            "peak_staged_bytes": self.peak_staged_bytes,
+            "preempted": self._preempted,
+            "queue_wait_s": self.queue_wait_s(),
+            "wall_s": self.wall_s(),
+        }
 
     def done(self) -> bool:
         return self._done.is_set()
@@ -600,7 +634,13 @@ class ServingSession:
                 CheckpointStore,
             )
 
-            checkpoints = CheckpointStore()
+            try:
+                ckpt_cap = int(float(
+                    self._opt("checkpoint_budget_bytes", 0) or 0
+                ))
+            except (TypeError, ValueError):
+                ckpt_cap = 0
+            checkpoints = CheckpointStore(budget_bytes=ckpt_cap)
         self.checkpoints = checkpoints
         # one cluster-wide speculative-attempt budget shared by every
         # per-query coordinator (the hedge stampede bound)
@@ -634,8 +674,14 @@ class ServingSession:
         self._running: dict[str, QueryHandle] = {}  # guarded-by: _lock
         self._drivers: dict[str, threading.Thread] = {}  # guarded-by: _lock
         self._admitted_total = 0  # guarded-by: _lock
-        self._completed = {DONE: 0, FAILED: 0, CANCELLED: 0}  # guarded-by: _lock
+        self._completed = {DONE: 0, FAILED: 0, CANCELLED: 0,
+                           PREEMPTED: 0}  # guarded-by: _lock
         self._closed = False  # guarded-by: _lock
+        # estimate-vs-reality admission loop: SQL text -> last MEASURED
+        # peak staged bytes (TableStore attribution); queued admission
+        # decisions re-cost from it, replacing the static
+        # plan_device_bytes estimate once a real run measured the query
+        self._measured_bytes: dict = {}  # guarded-by: _lock
         # cluster-wide telemetry (runtime/telemetry.py): ONE typed
         # registry is the exposition sink for every counter this tier
         # already keeps — faults, hedge budget, breaker state, latency
@@ -679,6 +725,17 @@ class ServingSession:
             capacity=int(self._opt("telemetry_history_points", 240)),
             resolution_s=float(self._opt("telemetry_resolution_s", 1.0)),
         )
+        # red-line memory monitor (load shedding): a daemon sampler over
+        # the in-process workers' TableStores. Cheap when no store has a
+        # budget set (a handful of int reads per tick); preempts the
+        # lowest-priority running query when residency stays over
+        # budget x `worker_memory_redline` AFTER spilling already ran.
+        self._monitor_stop = threading.Event()
+        self._monitor = threading.Thread(
+            target=self._memory_monitor, daemon=True,
+            name="dftpu-mem-monitor",
+        )
+        self._monitor.start()
 
     # -- telemetry adapters (runtime/telemetry.py) --------------------------
     def _serving_families(self) -> list:
@@ -710,6 +767,10 @@ class ServingSession:
             family("dftpu_serving_queued_bytes", "gauge",
                    "Admission-estimate bytes of queued queries.",
                    [({}, queued_bytes)]),
+            family("dftpu_queries_preempted", "counter",
+                   "Queries preempted by red-line load shedding "
+                   "(checkpoint frontier retained for recover()).",
+                   [({}, completed.get(PREEMPTED, 0))]),
         ]
 
     def _slo_families(self) -> list:
@@ -796,13 +857,32 @@ class ServingSession:
         return handle
 
     # -- admission control --------------------------------------------------
+    def _recost_locked(self, h: QueryHandle) -> int:
+        """Re-cost a queued admission decision from MEASURED reality:
+        once a prior run of the same SQL measured its peak staged bytes
+        (TableStore attribution), that replaces the static
+        plan_device_bytes estimate — mis-estimated queries stop
+        over/under-admitting on their second appearance."""
+        measured = self._measured_bytes.get(h.sql)
+        if measured is not None and measured > 0 and (
+            measured != h.est_bytes
+        ):
+            h.est_bytes = int(measured)
+        return h.est_bytes
+
     def _admissible_locked(self, h: QueryHandle) -> bool:
         if len(self._running) >= self._max_concurrent():
             return False
+        if self._running and self._redline_hot():
+            # a worker is over the hard red-line with queries running:
+            # queue instead of piling more demand onto a pressured pool
+            # (the monitor sheds if pressure persists)
+            return False
         budget = self._budget_bytes()
         if budget and budget > 0:
+            est = self._recost_locked(h)
             in_use = sum(r.est_bytes for r in self._running.values())
-            if in_use + h.est_bytes > budget and self._running:
+            if in_use + est > budget and self._running:
                 # over budget with peers running -> wait; an EMPTY pool
                 # always admits the head (a query bigger than the whole
                 # budget must not starve forever)
@@ -912,17 +992,45 @@ class ServingSession:
             )
             h._finish(DONE, result=out)
         except TaskCancelledError as e:
-            h._finish(CANCELLED, error=e)
+            if h._preempted:
+                # red-line load shedding rode the cancel path: surface
+                # the TYPED error and keep the checkpoint frontier —
+                # recover() resumes this query when pressure clears
+                from datafusion_distributed_tpu.runtime.errors import (
+                    QueryPreemptedError,
+                )
+
+                h._finish(PREEMPTED, error=QueryPreemptedError(
+                    f"query {h.query_id[:8]} preempted by memory "
+                    "red-line load shedding; its checkpoint frontier "
+                    "is retained — ServingSession.recover() resumes it"
+                ))
+            else:
+                h._finish(CANCELLED, error=e)
         except BaseException as e:
             h._finish(FAILED, error=e)
         finally:
+            # measured side of the admission loop: the coordinator's
+            # sweep harvested per-store staging attribution into
+            # staged_peak_bytes; bind it to the handle and (for resolved
+            # runs) re-cost future admissions of this SQL from it
+            peak = int(getattr(coord, "staged_peak_bytes", 0) or 0)
+            h.peak_staged_bytes = peak
+            if h._state == DONE and peak > 0:
+                with self._lock:
+                    self._measured_bytes[h.sql] = peak
+                    while len(self._measured_bytes) > 256:
+                        self._measured_bytes.pop(
+                            next(iter(self._measured_bytes))
+                        )
             if self.checkpoints is not None and h._ckpt_record is not None:
                 if h._state in (DONE, CANCELLED):
                     # resolved: the record and its staged slices are
                     # dead weight (and would leak) — release them.
-                    # FAILED stays recoverable: an interrupted/failed
-                    # query's completed-stage frontier is exactly what
-                    # recover() resumes from.
+                    # FAILED stays recoverable — and PREEMPTED stays
+                    # recoverable ON PURPOSE: the retained completed-
+                    # stage frontier is what recover() resumes from
+                    # after load shedding.
                     self.checkpoints.release(h._ckpt_record, self.cluster)
             self._stamp_trace(h, coord)
             self.scheduler.unregister_query(h.query_id)
@@ -1023,6 +1131,119 @@ class ServingSession:
             )
         return handles
 
+    # -- memory red-line monitor / load shedding -----------------------------
+    def _redline_factor(self) -> float:
+        try:
+            return float(self._opt_over("worker_memory_redline"))
+        except (TypeError, ValueError):
+            return float(SERVING_DEFAULTS["worker_memory_redline"])
+
+    def _worker_stores(self) -> list:
+        """The in-process workers' TableStores (wire workers report via
+        their own budget enforcement; the monitor cannot see them)."""
+        stores = []
+        try:
+            urls = self.cluster.get_urls()
+        except Exception:
+            return stores
+        for url in urls:
+            try:
+                s = getattr(self.cluster.get_worker(url), "table_store",
+                            None)
+            except Exception:
+                continue
+            if s is not None and hasattr(s, "under_pressure"):
+                stores.append((url, s))
+        return stores
+
+    def _redline_hot(self) -> bool:
+        """Any worker's RESIDENT staged bytes over budget x red-line
+        (spill already failed to relieve it)? Plain int reads only —
+        this runs on the 50 ms monitor tick and under the admission
+        lock, so it must never walk a store's full stats()."""
+        factor = self._redline_factor()
+        if factor <= 0:
+            return False
+        for _url, s in self._worker_stores():
+            b = getattr(s, "budget_bytes", 0)
+            if b and s.nbytes() > b * factor:
+                return True
+        return False
+
+    def _memory_monitor(self) -> None:
+        """Daemon sampler: while any worker store sits over the hard
+        red-line, shed load — preempt the LOWEST-PRIORITY running query
+        (largest measured staged bytes within the class) through the
+        existing cancel path, typed as QueryPreemptedError with its
+        checkpoint frontier retained. One preemption in flight at a
+        time: the next only fires if pressure persists after the victim
+        resolved (natural hysteresis)."""
+        while not self._monitor_stop.wait(0.05):
+            try:
+                self._check_redline()
+            except Exception:
+                pass  # the monitor must never die mid-session
+
+    @staticmethod
+    def _current_staged(h: QueryHandle, stores) -> int:
+        """Bytes currently attributed to ``h``'s main execute across the
+        worker stores (the over-budget tie-break among equal-priority
+        shed candidates)."""
+        qid = getattr(h._coordinator, "last_query_id", None)
+        if not qid:
+            return 0
+        total = 0
+        for _url, s in stores:
+            try:
+                total += s.query_current_nbytes(qid)
+            except Exception:
+                pass
+        return total
+
+    def _check_redline(self) -> None:
+        factor = self._redline_factor()
+        if factor <= 0:
+            return
+        hot = []
+        stores = self._worker_stores()
+        for url, s in stores:
+            # budget_bytes is a plain attribute and nbytes() a two-line
+            # locked int read: the 20 Hz tick must not contend the
+            # store lock with a stats() walk over every staged entry
+            b = getattr(s, "budget_bytes", 0)
+            if b:
+                n = s.nbytes()
+                if n > b * factor:
+                    hot.append((url, n, b))
+        if not hot:
+            return
+        with self._lock:
+            running = list(self._running.values())
+            if any(h._preempted for h in running):
+                return  # a shed is already unwinding: wait for it
+            candidates = [h for h in running if not h.done()]
+            if not candidates:
+                return
+            victim = min(candidates, key=lambda h: (
+                h.priority,
+                -self._current_staged(h, stores),
+                -(h.admitted_s or 0.0),
+            ))
+            victim._preempted = True
+        self.faults.bump("queries_preempted")
+        from datafusion_distributed_tpu.runtime.eventlog import log_event
+
+        log_event(
+            "query_preempt_requested",
+            serving_query_id=victim.query_id, priority=victim.priority,
+            hot_workers=[u for u, _n, _b in hot],
+            resident_bytes=max(n for _u, n, _b in hot),
+            budget_bytes=max(b for _u, _n, b in hot),
+        )
+        # the existing cancel path does the unwinding (slice release,
+        # coordinator teardown); _drive types the result as PREEMPTED
+        victim._cancel_event.set()
+
     # -- cancellation -------------------------------------------------------
     def _cancel(self, h: QueryHandle) -> bool:
         with self._lock:
@@ -1069,6 +1290,21 @@ class ServingSession:
         out["scheduler"] = self.scheduler.stats()
         out["latency"] = self.query_latency.summary()
         out["hedging"] = self.hedge_budget.stats()
+        # enforced-memory surface: per-worker residency vs budget plus
+        # spill counters (in-process stores only) and the red-line factor
+        out["memory"] = {
+            "redline_factor": self._redline_factor(),
+            "measured_queries": len(self._measured_bytes),
+            "workers": {
+                url: {
+                    k: v for k, v in s.stats().items()
+                    if k in ("nbytes", "peak_nbytes", "budget_bytes",
+                             "spilled_nbytes", "spills", "refaults",
+                             "spill_files")
+                }
+                for url, s in self._worker_stores()
+            },
+        }
         # rolling SLO attainment vs the live targets (empty targets
         # still report the window's p99/error rate)
         out["slo"] = self.slo_snapshot()
@@ -1112,6 +1348,7 @@ class ServingSession:
             for h in stuck:
                 self._cancel(h)
             self.drain(timeout=10.0)
+        self._monitor_stop.set()
         self.scheduler.close()
 
     def __enter__(self) -> "ServingSession":

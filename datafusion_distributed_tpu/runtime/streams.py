@@ -75,13 +75,27 @@ class StreamBudget:
     """Bounds the BYTES of chunks produced but not yet consumed (the
     connection-buffer budget role). Producers block in acquire() until the
     consumer releases; a chunk larger than the whole budget is admitted
-    alone (large-but-valid rows must stream through, never deadlock)."""
+    alone (large-but-valid rows must stream through, never deadlock).
 
-    def __init__(self, budget_bytes: int):
+    ``pressure``: optional callable — the destination worker stores'
+    memory-pressure probe (TableStore.under_pressure). While it reads
+    True, producers with chunks still in flight BLOCK even when the
+    stream's own budget has room: the stream degrades to trickle pace
+    (one chunk at a time) so a pipelined shuffle slows down instead of
+    overrunning an enforced worker memory budget. Like the byte budget,
+    pressure never blocks a producer with ZERO bytes in flight —
+    guaranteed progress, so a store pinned over budget by live
+    consumers can still drain. A bound CancelSignal wakes blocked
+    producers immediately either way (cancel-notify); pressure-clear is
+    observed at the 50 ms poll."""
+
+    def __init__(self, budget_bytes: int, pressure=None):
         self.budget = max(int(budget_bytes), 1)
+        self.pressure = pressure
         self._cv = threading.Condition()
         self._in_flight = 0  # guarded-by: _cv
         self.peak_in_flight = 0  # guarded-by: _cv
+        self.pressure_waits = 0  # guarded-by: _cv
         # cancel events whose set() notifies _cv (bind_cancel): acquire
         # may then wait WITHOUT a poll timeout — a blocked producer wakes
         # at cancellation latency instead of the next 50 ms tick
@@ -97,16 +111,36 @@ class StreamBudget:
         with self._cv:
             self._cv.notify_all()
 
+    def _under_pressure(self) -> bool:
+        if self.pressure is None:
+            return False
+        try:
+            return bool(self.pressure())
+        except Exception:
+            return False  # a broken probe must never wedge the stream
+
     def acquire(self, nbytes: int, cancel: threading.Event) -> bool:
         with self._cv:
             # a bound CancelSignal notifies this condition on set(), so
-            # the wait needs no poll timeout; an unbound plain Event
-            # keeps the legacy 50 ms poll as a safety net
-            timeout = None if cancel in self._bound else 0.05
-            while (
-                self._in_flight > 0
-                and self._in_flight + nbytes > self.budget
+            # the wait needs no poll timeout; an unbound plain Event —
+            # or an installed pressure probe, which nothing notifies —
+            # keeps the 50 ms poll as the progress check
+            timeout = (
+                None if cancel in self._bound and self.pressure is None
+                else 0.05
+            )
+            noted_pressure = False
+            while self._in_flight > 0 and (
+                self._in_flight + nbytes > self.budget
+                or self._under_pressure()
             ):
+                if not noted_pressure and (
+                    self._in_flight + nbytes <= self.budget
+                ):
+                    # blocked by store pressure alone: count it once per
+                    # acquire (the backpressure-engaged signal)
+                    self.pressure_waits += 1
+                    noted_pressure = True
                 if cancel.is_set():
                     return False
                 self._cv.wait(timeout=timeout)
@@ -188,6 +222,7 @@ def stream_stage_chunks(
     on_progress: Optional[Callable[[int, int, int, int], None]] = None,
     payload_rows: Optional[Callable] = None,
     on_chunk: Optional[Callable] = None,
+    pressure: Optional[Callable[[], bool]] = None,
 ) -> tuple[list[list], StreamStats]:
     """Run one chunk stream per producer task concurrently under a shared
     byte budget; -> (per-task chunk lists, stats).
@@ -214,13 +249,16 @@ def stream_stage_chunks(
     as it arrives — the per-column half of the reference's LoadInfo
     (NDV %% / null %% sampled from in-flight batches, `sampler.rs:30-42`);
     the adaptive coordinator feeds a mid-stream column sampler from it.
+
+    ``pressure``: destination-store memory-pressure probe
+    (StreamBudget's producer backpressure — see its docstring).
     """
     import queue as _q
 
     if payload_rows is None:
         payload_rows = lambda p: int(p.num_rows)  # noqa: E731
     t_start = time.perf_counter()
-    budget = StreamBudget(budget_bytes)
+    budget = StreamBudget(budget_bytes, pressure=pressure)
     cancel = CancelSignal()
     budget.bind_cancel(cancel)
     out_q: _q.Queue = _q.Queue()
@@ -311,6 +349,8 @@ def stream_stage_chunks(
     if error is not None:
         raise error
     stats.peak_in_flight = budget.peak_in_flight
+    if budget.pressure_waits:
+        stats.extra["pressure_waits"] = budget.pressure_waits
     stats.elapsed_s = max(time.perf_counter() - t_start, 1e-9)
     stats.rows_per_s = stats.rows / stats.elapsed_s
     stats.bytes_per_s = stats.bytes_streamed / stats.elapsed_s
@@ -495,6 +535,7 @@ def stream_partition_chunks(
     max_concurrent: Optional[int] = None,
     on_chunk: Optional[Callable] = None,
     should_cancel: Optional[Callable[[], bool]] = None,
+    pressure: Optional[Callable[[], bool]] = None,
 ) -> StreamStats:
     """Incremental variant of `stream_stage_chunks` for per-(task,
     partition) streams: each puller yields ((partition, chunk), est_bytes)
@@ -504,11 +545,13 @@ def stream_partition_chunks(
     the stream stats; on failure it is failed with the first error (fatal
     displaces retryable, as in stream_stage_chunks) and the error
     re-raises. ``should_cancel``: external cancel predicate (the
-    per-query cancel) polled in the consumer loop."""
+    per-query cancel) polled in the consumer loop. ``pressure``:
+    destination-store memory-pressure probe — producers slow to trickle
+    pace while the worker stores are over their enforced budget."""
     import queue as _q
 
     t_start = time.perf_counter()
-    budget = StreamBudget(budget_bytes)
+    budget = StreamBudget(budget_bytes, pressure=pressure)
     cancel = CancelSignal()
     budget.bind_cancel(cancel)
     out_q: _q.Queue = _q.Queue()
@@ -593,6 +636,8 @@ def stream_partition_chunks(
             cancel.set()
     _join_pullers(threads, stats)
     stats.peak_in_flight = budget.peak_in_flight
+    if budget.pressure_waits:
+        stats.extra["pressure_waits"] = budget.pressure_waits
     stats.elapsed_s = max(time.perf_counter() - t_start, 1e-9)
     stats.rows_per_s = stats.rows / stats.elapsed_s
     stats.bytes_per_s = stats.bytes_streamed / stats.elapsed_s

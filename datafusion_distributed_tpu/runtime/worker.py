@@ -529,6 +529,18 @@ class Worker:
                 raise
         if headers:
             validate_passthrough_headers(headers)
+        # enforced worker memory budget: the knob rides the task config
+        # (`SET distributed.worker_memory_budget_bytes`) — apply it to
+        # THIS worker's store before decode stages anything, so wire
+        # workers enforce the same budget the coordinator's in-process
+        # push covers locally. Not trace-relevant: never a compile key.
+        if config and "worker_memory_budget_bytes" in config:
+            try:
+                self.table_store.set_budget(
+                    config["worker_memory_budget_bytes"]
+                )
+            except Exception:
+                pass
         # idle-worker retention bound: stage-compile slots pin decoded
         # plans (incl. store-held device tables); access-driven TTL alone
         # never fires on a worker that stops executing, so sweep on the
@@ -854,7 +866,14 @@ class Worker:
             # re-partition under a NEW (keys, P) spec: the previous
             # regrouped buffer's ids must not stay pinned/double-counted
             self.table_store.remove(data.staged_partition_ids)
-        staged = [self.table_store.put(s) for s in data.partition_slices]
+        from datafusion_distributed_tpu.runtime.codec import (
+            staging_attribution,
+        )
+
+        with staging_attribution(key.query_id):
+            staged = [
+                self.table_store.put(s) for s in data.partition_slices
+            ]
         data.staged_partition_ids = staged
         if self.registry.get(key) is not data:
             # evicted while we staged: nobody will fire the exit hook for

@@ -208,6 +208,37 @@ class SessionConfig:
                         "admission_budget_bytes must be >= 0 (0 = "
                         "unlimited)"
                     )
+            elif key == "worker_memory_budget_bytes":
+                # enforced per-worker staging budget (runtime/codec.py
+                # TableStore + runtime/spill.py): validated at SET time
+                # like the admission knobs; 0 = unlimited. Deliberately
+                # NOT trace-relevant — flipping it never recompiles.
+                value = float(value)
+                if value < 0:
+                    raise ValueError(
+                        "worker_memory_budget_bytes must be >= 0 (0 = "
+                        "unlimited)"
+                    )
+            elif key == "worker_memory_redline":
+                # red-line shedding factor (runtime/serving.py): resident
+                # bytes over budget x factor preempt the lowest-priority
+                # running query; 0 disables shedding
+                value = float(value)
+                if value != 0 and value < 1.0:
+                    raise ValueError(
+                        "worker_memory_redline must be 0 (shedding off) "
+                        "or >= 1.0 (a red-line below the budget would "
+                        "shed before spill/backpressure even engage)"
+                    )
+            elif key == "checkpoint_budget_bytes":
+                # CheckpointStore byte cap (runtime/checkpoint.py):
+                # oldest recoverable checkpoints evict past it
+                value = float(value)
+                if value < 0:
+                    raise ValueError(
+                        "checkpoint_budget_bytes must be >= 0 (0 = "
+                        "uncapped)"
+                    )
             elif key == "serving_stage_slots":
                 value = int(value)
                 if value < 0:

@@ -117,6 +117,21 @@ if ! env DFTPU_LOCK_CHECK=1 python -m pytest tests/test_hedging_recovery.py \
         -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
     FAILED+=("tests/test_hedging_recovery.py[gate+lockcheck]")
 fi
+# Memory-pressure gate (tests/test_memory_pressure.py): the enforced
+# worker byte budget — spill-to-host + byte-exact refault, stream
+# backpressure under store pressure, the serving pressure matrix
+# (8-thread mixed TPC-H under a budget below the unconstrained peak:
+# byte-identical, spill engaged, residency bounded), red-line load
+# shedding (preempt -> recover() byte-identical), chaos kind="oom",
+# checkpoint byte cap, zero leaked slices AND spill files. Runs under
+# DFTPU_LOCK_CHECK=1: spill swaps, the red-line monitor, and producer
+# backpressure are cross-thread schedules.
+echo "=== tests/test_memory_pressure.py (memory-pressure gate, DFTPU_LOCK_CHECK=1)"
+if ! env DFTPU_LOCK_CHECK=1 python -m pytest tests/test_memory_pressure.py \
+        -q --no-header \
+        -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
+    FAILED+=("tests/test_memory_pressure.py[gate+lockcheck]")
+fi
 # Telemetry gate (tests/test_telemetry.py): the cluster-wide telemetry
 # pipeline — typed registry units, OpenMetrics exposition-format golden
 # test, cross-transport get_metrics merge (in-process AND gRPC, with
@@ -196,6 +211,7 @@ if ! env DFTPU_LOCK_CHECK=1 python -m pytest tests/test_pipelined_shuffle.py \
     FAILED+=("tests/test_pipelined_shuffle.py[gate+lockcheck]")
 fi
 for f in tests/test_*.py; do
+    [ "$f" = "tests/test_memory_pressure.py" ] && continue  # ran above
     [ "$f" = "tests/test_recompile_budget.py" ] && continue  # ran above
     [ "$f" = "tests/test_pipelined_shuffle.py" ] && continue  # ran above
     [ "$f" = "tests/test_plan_verify.py" ] && continue  # ran above (gate)

@@ -1,0 +1,600 @@
+"""Memory-pressure resilience (enforced worker byte budgets, host spill,
+stream backpressure, shedding admission).
+
+Contracts pinned here:
+
+- Enforced TableStore budget: staging past
+  `distributed.worker_memory_budget_bytes` spills the coldest
+  unreferenced owned entries to the host spill segment
+  (runtime/spill.py) and `get` refaults them BYTE-EXACTLY with the
+  original padded capacity; view-pinned entries are unspillable;
+  draining a store leaves zero spill files.
+- Backpressure: `StreamBudget` producers with bytes in flight block
+  while the destination-store pressure probe reads True (trickle pace
+  instead of a budget overrun), and a bound cancel still wakes them
+  immediately.
+- TPC-H stays byte-identical with spill engaged: q18 + q21 under a
+  worker budget below their unconstrained peak staged bytes complete
+  identically to the unconstrained run, with spill provably engaged and
+  zero leaked slices / spill files — including under the seeded chaos
+  `kind="oom"` mid-query budget collapse.
+- Serving pressure matrix: 8 concurrent clients of mixed TPC-H under a
+  budget below the unconstrained aggregate peak stay byte-identical,
+  spill engages, resident staged bytes never grow past budget + slack,
+  and preempted queries resume byte-identically via recover() with the
+  typed QueryPreemptedError surfaced.
+- Estimate-vs-measured admission: a resolved query's measured peak
+  staged bytes re-costs the next admission of the same SQL.
+- CheckpointStore byte cap: oldest recoverable checkpoints evict past
+  the cap (`checkpoint_evicted_budget`), never the just-saved one.
+- `reset_peak()` makes per-phase peaks measurable; budget-knob flips
+  perform zero new XLA traces.
+
+Named gate in run_tests.sh, run under DFTPU_LOCK_CHECK=1 (spill swaps,
+the red-line monitor, and stream backpressure are cross-thread
+schedules).
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from datafusion_distributed_tpu.io.parquet import arrow_to_table
+from datafusion_distributed_tpu.plan import physical as phys
+from datafusion_distributed_tpu.runtime.chaos import (
+    FaultPlan,
+    FaultSpec,
+    wrap_cluster,
+)
+from datafusion_distributed_tpu.runtime.checkpoint import CheckpointStore
+from datafusion_distributed_tpu.runtime.codec import (
+    TableStore,
+    staging_attribution,
+)
+from datafusion_distributed_tpu.runtime.coordinator import (
+    Coordinator,
+    InMemoryCluster,
+)
+from datafusion_distributed_tpu.runtime.errors import QueryPreemptedError
+from datafusion_distributed_tpu.runtime.serving import (
+    DONE,
+    PREEMPTED,
+    ServingSession,
+)
+from datafusion_distributed_tpu.runtime.streams import (
+    CancelSignal,
+    StreamBudget,
+)
+
+CHAOS_SEED = int(os.environ.get("DFTPU_CHAOS_SEED", "20260803"))
+
+_QDIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmarks", "queries", "tpch")
+
+
+def _q(name: str) -> str:
+    with open(os.path.join(_QDIR, f"{name}.sql")) as f:
+        return f.read()
+
+
+TPCH_Q6 = _q("q6")
+TPCH_Q18 = _q("q18")
+TPCH_Q21 = _q("q21")
+MIX = {"q1": _q("q1"), "q6": TPCH_Q6, "q18": TPCH_Q18}
+
+
+@pytest.fixture(scope="module")
+def tpch_ctx():
+    from datafusion_distributed_tpu.data.tpchgen import gen_tpch
+    from datafusion_distributed_tpu.sql.context import SessionContext
+
+    ctx = SessionContext()
+    ctx.config.distributed_options["bytes_per_task"] = 1  # force fan-out
+    ctx.config.distributed_options["broadcast_joins"] = False
+    ctx.config.distributed_options["task_retry_backoff_s"] = 0.001
+    for name, arrow in gen_tpch(sf=0.002, seed=7).items():
+        ctx.register_arrow(name, arrow)
+    return ctx
+
+
+@pytest.fixture(scope="module")
+def reference(tpch_ctx):
+    """name -> pandas frame from unconstrained coordinated runs."""
+    out = {}
+    for name, sql in {**MIX, "q21": TPCH_Q21}.items():
+        out[name] = tpch_ctx.sql(sql).collect_coordinated(
+            coordinator=_coord(InMemoryCluster(4)), num_tasks=4
+        ).to_pandas()
+    return out
+
+
+def _coord(cluster, **opts):
+    return Coordinator(
+        resolver=cluster, channels=cluster,
+        config_options={"bytes_per_task": 1, "broadcast_joins": False,
+                        "task_retry_backoff_s": 0.001, **opts},
+    )
+
+
+def _tab(n: int, seed: int):
+    rng = np.random.default_rng(seed)
+    return arrow_to_table(pa.table({
+        "k": rng.integers(0, 1 << 10, n), "v": rng.normal(size=n),
+    }))
+
+
+def _assert_frames_identical(got, base, label=""):
+    assert list(got.columns) == list(base.columns), label
+    for col in base.columns:
+        np.testing.assert_array_equal(
+            got[col].to_numpy(), base[col].to_numpy(),
+            err_msg=f"{label}.{col} diverged",
+        )
+
+
+def _inner_workers(cluster):
+    inner = getattr(cluster, "inner", cluster)
+    return inner.workers.values()
+
+
+def _assert_no_leaks(cluster):
+    for w in _inner_workers(cluster):
+        st = w.table_store.stats()
+        assert not w.table_store.tables, (
+            f"{w.url} leaked TableStore entries"
+        )
+        assert st["spill_files"] == 0, f"{w.url} leaked spill files"
+        assert st["spilled_nbytes"] == 0, f"{w.url} leaked spilled bytes"
+        assert len(w.registry) == 0, f"{w.url} leaked registry entries"
+
+
+def _cluster_spills(cluster) -> int:
+    return sum(
+        w.table_store.stats()["spills"] for w in _inner_workers(cluster)
+    )
+
+
+# ---------------------------------------------------------------------------
+# TableStore: enforced budget, spill, refault
+# ---------------------------------------------------------------------------
+
+
+def test_budget_spills_coldest_and_refaults_byte_exact():
+    s = TableStore()
+    t1, t2, t3 = _tab(4096, 1), _tab(4096, 2), _tab(4096, 3)
+    i1, i2, i3 = s.put(t1), s.put(t2), s.put(t3)
+    per = s.stats()["nbytes"] // 3
+    s.set_budget(per * 2)
+    st = s.stats()
+    assert st["spills"] == 1 and st["spill_files"] == 1, st
+    assert st["nbytes"] <= st["budget_bytes"], st
+    assert st["spilled_nbytes"] == per, st
+    # the COLDEST entry (first inserted, never touched) spilled
+    assert s.tables[i1].__class__.__name__ == "_SpilledSentinel"
+    # refault: byte-exact values, original capacity, file reclaimed
+    g1 = s.get(i1)
+    assert int(g1.capacity) == int(t1.capacity)
+    for ci in range(2):
+        np.testing.assert_array_equal(
+            np.asarray(g1.columns[ci].data),
+            np.asarray(t1.columns[ci].data),
+        )
+    st = s.stats()
+    assert st["refaults"] == 1, st
+    # the refault rebalanced: residency is back under budget
+    assert st["nbytes"] <= st["budget_bytes"], st
+    s.remove([i1, i2, i3])
+    st = s.stats()
+    assert st["entries"] == 0 and st["spill_files"] == 0, st
+    assert st["nbytes"] == 0 and st["spilled_nbytes"] == 0, st
+
+
+def test_view_pinned_entries_are_unspillable():
+    s = TableStore()
+    t1 = _tab(4096, 1)
+    i1 = s.put(t1)
+    v1 = s.put_view(i1, lo=0, count=128)  # pins t1's buffers
+    s.set_budget(1)  # absurdly tight: nothing may spill anyway
+    st = s.stats()
+    assert st["spills"] == 0, st
+    assert s.get(i1) is t1  # still resident
+    assert s.under_pressure()  # pinned residency over budget
+    s.remove([v1])
+    # the pin dropped: enforcement can now spill it
+    s.enforce_budget()
+    assert s.stats()["spills"] == 1
+    s.remove([i1])
+    assert s.stats()["spill_files"] == 0
+
+
+def test_put_view_refaults_spilled_base():
+    s = TableStore()
+    t1, t2 = _tab(4096, 1), _tab(2048, 2)
+    i1 = s.put(t1)
+    i2 = s.put(t2)
+    s.set_budget(s.entry_nbytes(i2) + 1)  # spills t1 (coldest)
+    assert s.stats()["spills"] >= 1
+    v = s.put_view(i1, lo=8, count=16)  # must refault the base first
+    got = s.get(v)
+    np.testing.assert_array_equal(
+        np.asarray(got.columns[1].data)[:16],
+        np.asarray(t1.columns[1].data)[8:24],
+    )
+    s.remove([v, i1, i2])
+    assert s.stats()["spill_files"] == 0
+
+
+def test_refault_race_loser_serves_winners_table():
+    """Two threads racing get() on one spilled entry: the winner
+    refaults and RELEASES (unlinks) the slot; the loser's file read
+    fails but must serve the winner's resident table — a live entry
+    never errors."""
+    s = TableStore()
+    t1, t2 = _tab(4096, 1), _tab(4096, 2)
+    i1, i2 = s.put(t1), s.put(t2)
+    s.set_budget(s.entry_nbytes(i2) + 1)  # spills i1
+    with s._lock:
+        stale_slot = s._meta[i1].spilled
+    assert stale_slot is not None
+    winner = s.get(i1)  # refaults + unlinks the slot
+    got = s._refault(i1, stale_slot)  # the loser's stale read
+    np.testing.assert_array_equal(
+        np.asarray(got.columns[1].data), np.asarray(winner.columns[1].data)
+    )
+    s.remove([i1, i2])
+    assert s.stats()["spill_files"] == 0
+
+
+def test_reset_peak_gives_per_phase_peaks():
+    s = TableStore()
+    i1 = s.put(_tab(8192, 1))
+    big = s.stats()["peak_nbytes"]
+    s.remove([i1])
+    assert s.stats()["peak_nbytes"] == big  # monotone for the phase
+    assert s.reset_peak() == big
+    i2 = s.put(_tab(512, 2))
+    st = s.stats()
+    assert 0 < st["peak_nbytes"] < big  # the SECOND phase's own peak
+    s.remove([i2])
+
+
+def test_query_attribution_peaks_and_sweep():
+    s = TableStore()
+    with staging_attribution("qA"):
+        ia = s.put(_tab(4096, 1))
+    with staging_attribution("qB"):
+        ib1, ib2 = s.put(_tab(4096, 2)), s.put(_tab(4096, 3))
+    assert s.query_peak_nbytes("qB") == 2 * s.query_peak_nbytes("qA")
+    assert s.query_current_nbytes("qA") == s.query_peak_nbytes("qA")
+    s.remove([ia])
+    assert s.query_current_nbytes("qA") == 0
+    peak = s.sweep_query_attribution("qB")
+    assert peak == 2 * s.query_peak_nbytes("qA") or peak > 0
+    assert s.query_peak_nbytes("qB") == 0
+    s.remove([ib1, ib2])
+
+
+def test_store_telemetry_exposes_spill_families():
+    """The satellite telemetry golden: the spill families ride the
+    store's typed-registry adapter (and the OpenMetrics exposition names
+    the ISSUE pins: dftpu_store_spilled_bytes)."""
+    from datafusion_distributed_tpu.runtime.telemetry import MetricRegistry
+
+    s = TableStore()
+    i1 = s.put(_tab(4096, 1))
+    i2 = s.put(_tab(4096, 2))
+    s.set_budget(s.entry_nbytes(i2) + 1)
+    r = MetricRegistry()
+    r.register_collector(s.telemetry_families)
+    snap = r.snapshot()
+    for name in ("dftpu_store_spilled_bytes", "dftpu_store_spills",
+                 "dftpu_store_refaults", "dftpu_store_spill_files",
+                 "dftpu_store_budget_bytes"):
+        assert name in snap, name
+    assert snap["dftpu_store_spilled_bytes"]["samples"][0][1] > 0
+    text = r.render_openmetrics()
+    assert "dftpu_store_spilled_bytes " in text
+    assert "dftpu_store_spills_total " in text
+    s.remove([i1, i2])
+
+
+# ---------------------------------------------------------------------------
+# stream backpressure
+# ---------------------------------------------------------------------------
+
+
+def test_stream_budget_blocks_on_pressure_and_cancel_wakes():
+    hot = threading.Event()
+    hot.set()
+    budget = StreamBudget(1 << 20, pressure=hot.is_set)
+    cancel = CancelSignal()
+    budget.bind_cancel(cancel)
+    assert budget.acquire(100, cancel)  # zero in flight: always admits
+    admitted = threading.Event()
+
+    def producer():
+        if budget.acquire(100, cancel):
+            admitted.set()
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    time.sleep(0.15)
+    assert not admitted.is_set(), "producer ran through store pressure"
+    hot.clear()  # pressure relieved: the 50 ms poll admits it
+    t.join(timeout=2.0)
+    assert admitted.is_set()
+    assert budget.pressure_waits >= 1
+
+    # a cancelled producer under pressure unwinds immediately
+    hot.set()
+    got = []
+
+    def cancelled_producer():
+        got.append(budget.acquire(100, cancel))
+
+    t2 = threading.Thread(target=cancelled_producer, daemon=True)
+    t2.start()
+    time.sleep(0.05)
+    cancel.set()
+    t2.join(timeout=2.0)
+    assert got == [False]
+
+
+# ---------------------------------------------------------------------------
+# checkpoint byte cap
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_store_evicts_oldest_past_cap():
+    cluster = InMemoryCluster(2)
+    tables = [_tab(2048, i) for i in range(3)]
+    nb = sum(
+        int(c.data.nbytes) + (int(c.validity.nbytes) if c.validity is not
+                              None else 0)
+        for c in tables[0].columns
+    )
+    store = CheckpointStore(budget_bytes=int(nb * 2.5))
+    rid = store.admit("select 1")
+    for sid in range(3):
+        assert store.save_stage(
+            rid, 0, sid, f"fp{sid}", [tables[sid]], False, False, 1,
+            cluster, cluster,
+        ) is not None
+    st = store.stats()
+    # cap fits two stages: the OLDEST evicted, the latest save survived
+    assert st["checkpoint_evicted_budget"] == 1, st
+    assert st["stages"] == 2, st
+    restored, why = store.restore_stage(rid, 0, 0, "fp0", cluster)
+    assert restored is None and why == "miss"
+    restored, why = store.restore_stage(rid, 0, 2, "fp2", cluster)
+    assert why == "hit"
+    store.release(rid, cluster)
+    for w in cluster.workers.values():
+        assert not w.table_store.tables
+
+
+# ---------------------------------------------------------------------------
+# TPC-H byte identity with spill engaged (q18 + q21)
+# ---------------------------------------------------------------------------
+
+
+def _unconstrained_peak(tpch_ctx, sql) -> int:
+    cluster = InMemoryCluster(4)
+    tpch_ctx.sql(sql).collect_coordinated_table(
+        coordinator=_coord(cluster), num_tasks=4
+    )
+    return max(
+        w.table_store.stats()["peak_nbytes"] for w in cluster.workers.values()
+    )
+
+
+@pytest.mark.parametrize("qname,sql", [("q18", TPCH_Q18),
+                                       ("q21", TPCH_Q21)])
+def test_tpch_byte_identical_under_budget(tpch_ctx, reference, qname, sql):
+    peak = _unconstrained_peak(tpch_ctx, sql)
+    assert peak > 0
+    cluster = InMemoryCluster(4)
+    coord = _coord(cluster, worker_memory_budget_bytes=max(peak // 2, 1))
+    got = tpch_ctx.sql(sql).collect_coordinated(
+        coordinator=coord, num_tasks=4
+    ).to_pandas()
+    _assert_frames_identical(got, reference[qname], qname)
+    assert _cluster_spills(cluster) > 0, (
+        "budget below peak but spill never engaged"
+    )
+    _assert_no_leaks(cluster)
+
+
+def test_chaos_oom_budget_collapse_byte_identical(tpch_ctx, reference):
+    """Seeded per-worker budget collapse mid-query (`kind="oom"`): the
+    spill machinery absorbs it — byte-identical q18, zero leaked slices,
+    zero leaked spill files."""
+    cluster = wrap_cluster(InMemoryCluster(4), FaultPlan(CHAOS_SEED, [
+        FaultSpec(site="execute", kind="oom", rate=1.0, max_total=2,
+                  budget_bytes=64 << 10),
+    ]))
+    coord = _coord(cluster)
+    got = tpch_ctx.sql(TPCH_Q18).collect_coordinated(
+        coordinator=coord, num_tasks=4
+    ).to_pandas()
+    _assert_frames_identical(got, reference["q18"], "oom/q18")
+    fired = [f for f in cluster.plan.fired if f["kind"] == "oom"]
+    assert len(fired) == 2, fired
+    assert _cluster_spills(cluster) > 0
+    _assert_no_leaks(cluster)
+
+
+def test_budget_knob_flip_zero_new_traces(tpch_ctx):
+    """`SET distributed.worker_memory_budget_bytes` is not a
+    trace-relevant key: flipping it recompiles nothing."""
+    cluster = InMemoryCluster(2)
+    base = tpch_ctx.sql(TPCH_Q6).collect_coordinated(
+        coordinator=_coord(cluster), num_tasks=2
+    ).to_pandas()
+    n0 = phys.trace_count()
+    for budget in (1 << 40, None):  # huge budget on, then off
+        opts = {} if budget is None else {
+            "worker_memory_budget_bytes": budget
+        }
+        got = tpch_ctx.sql(TPCH_Q6).collect_coordinated(
+            coordinator=_coord(cluster, **opts), num_tasks=2
+        ).to_pandas()
+        _assert_frames_identical(got, base, "q6/knob-flip")
+    assert phys.trace_count() == n0, (
+        "worker_memory_budget_bytes flip forced an XLA retrace"
+    )
+    for w in cluster.workers.values():
+        w.table_store.set_budget(0)
+
+
+# ---------------------------------------------------------------------------
+# serving pressure matrix
+# ---------------------------------------------------------------------------
+
+
+def test_serving_pressure_matrix_spills_not_overruns(tpch_ctx, reference):
+    """8 concurrent clients of mixed TPC-H under a worker budget below
+    the unconstrained aggregate peak: byte-identical results, spill
+    engaged, resident staged bytes bounded by budget + slack, zero
+    leaks. Shedding is disabled (redline 0) so this pins the
+    spill/backpressure half in isolation."""
+    # measure the unconstrained aggregate peak once
+    probe = InMemoryCluster(4)
+    with ServingSession(tpch_ctx, cluster=probe, num_tasks=4) as srv0:
+        hs = [srv0.submit(sql) for sql in MIX.values()]
+        for h in hs:
+            h.result(timeout=300)
+    peak = max(
+        w.table_store.stats()["peak_nbytes"] for w in probe.workers.values()
+    )
+    assert peak > 0
+    budget = max(peak // 2, 1 << 16)
+    slack = max(budget, 1 << 20)  # enforce-after-insert transient
+    opts = tpch_ctx.config.distributed_options
+    opts["worker_memory_budget_bytes"] = budget
+    opts["worker_memory_redline"] = 0  # spill/backpressure only
+    cluster = InMemoryCluster(4)
+    high_water = [0]
+    stop = threading.Event()
+
+    def sampler():
+        while not stop.wait(0.005):
+            for w in cluster.workers.values():
+                high_water[0] = max(
+                    high_water[0], w.table_store.nbytes()
+                )
+
+    t = threading.Thread(target=sampler, daemon=True)
+    t.start()
+    try:
+        with ServingSession(tpch_ctx, cluster=cluster, num_tasks=4,
+                            max_concurrent_queries=8) as srv:
+            handles = [
+                (name, srv.submit(sql))
+                for _ in range(3) for name, sql in MIX.items()
+            ]
+            for name, h in handles:
+                got = h.result(timeout=300).to_pandas()
+                _assert_frames_identical(got, reference[name],
+                                         f"pressure/{name}")
+            st = srv.stats()
+            assert st["memory"]["workers"], st["memory"]
+    finally:
+        stop.set()
+        t.join(timeout=2.0)
+        opts.pop("worker_memory_budget_bytes", None)
+        opts.pop("worker_memory_redline", None)
+    assert _cluster_spills(cluster) > 0, (
+        "aggregate demand above budget but spill never engaged"
+    )
+    assert high_water[0] <= budget + slack, (
+        f"resident {high_water[0]} grew past budget {budget} + slack"
+    )
+    _assert_no_leaks(cluster)
+
+
+def _pin_pressure(store, budget: int = 1):
+    """Make a store's residency irreducibly over budget: a view pins the
+    base, so spill cannot relieve it — the red-line monitor must shed."""
+    base = store.put(_tab(1 << 15, 99))
+    view = store.put_view(base, lo=0, count=64)
+    store.set_budget(budget)
+    return [base, view]
+
+
+def test_redline_preempts_lowest_priority_and_recovers(
+    tpch_ctx, reference,
+):
+    """A worker pinned over the red-line sheds the lowest-priority
+    running query through the existing cancel path: typed
+    QueryPreemptedError, `query_preempted` event, preempted counter,
+    checkpoint frontier retained — and recover() resumes it
+    byte-identically once pressure clears."""
+    from datafusion_distributed_tpu.runtime.eventlog import (
+        default_event_log,
+    )
+
+    store = CheckpointStore()
+    # slow the query so the 50 ms monitor reliably sees it running
+    cluster = wrap_cluster(InMemoryCluster(4), FaultPlan(CHAOS_SEED, [
+        FaultSpec(site="execute", kind="delay", delay_s=0.1, rate=1.0),
+    ], query_scoped=True))
+    srv = ServingSession(tpch_ctx, cluster=cluster, num_tasks=4,
+                         checkpoints=store)
+    pinned = []
+    w0 = next(iter(_inner_workers(cluster)))
+    try:
+        h = srv.submit(MIX["q18"])
+        deadline = time.monotonic() + 30
+        while h.status() == "queued" and time.monotonic() < deadline:
+            time.sleep(0.005)
+        pinned = _pin_pressure(w0.table_store)
+        with pytest.raises(QueryPreemptedError):
+            h.result(timeout=300)
+        assert h.status() == PREEMPTED
+        assert h.status(detail=True)["preempted"] is True
+        assert srv.stats()["completed"].get(PREEMPTED) == 1
+        snap = srv.telemetry.snapshot()
+        assert snap["dftpu_queries_preempted"]["samples"] == [[{}, 1]]
+        log = default_event_log()
+        assert log.events(kind="query_preempt_requested") or log.events(
+            kind="query_preempted"
+        ), "no preemption events logged"
+        # the frontier is RETAINED: the record stays recoverable
+        assert store.stats()["recoverable"] == 1, store.stats()
+        # pressure clears; recover() resumes byte-identically
+        w0.table_store.remove(pinned)
+        pinned = []
+        w0.table_store.set_budget(0)
+        handles = srv.recover()
+        assert len(handles) == 1
+        got = handles[0].result(timeout=300).to_pandas()
+        _assert_frames_identical(got, reference["q18"], "recover/q18")
+    finally:
+        if pinned:
+            w0.table_store.remove(pinned)
+        w0.table_store.set_budget(0)
+        srv.close()
+    assert store.stats()["recoverable"] == 0, store.stats()
+    _assert_no_leaks(cluster)
+
+
+def test_admission_recost_uses_measured_peak(tpch_ctx):
+    """The est_bytes -> measured loop: once a run of the same SQL
+    measured its peak staged bytes, a queued admission decision re-costs
+    from the measurement instead of the static plan estimate."""
+    with ServingSession(tpch_ctx, num_workers=2, num_tasks=2) as srv:
+        h1 = srv.submit(TPCH_Q6)
+        h1.result(timeout=300)
+        assert h1.status() == DONE
+        assert h1.peak_staged_bytes > 0
+        h2 = srv.submit(TPCH_Q6)
+        h2.result(timeout=300)
+        # the SECOND admission re-cost the estimate to the measurement
+        assert h2.est_bytes == h1.peak_staged_bytes
+        assert h2.status(detail=True)["est_bytes"] == h1.peak_staged_bytes
+    _assert_no_leaks(srv.cluster)

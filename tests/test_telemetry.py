@@ -231,6 +231,10 @@ def test_get_metrics_merges_in_process_cluster():
     text = obs.render_openmetrics()
     assert text.endswith("# EOF\n")
     assert "dftpu_worker_tasks_executed_total{" in text
+    # the memory-budget families ride the same store adapter (golden
+    # names pinned by the memory-pressure work: spilled bytes gauge)
+    assert "dftpu_store_spilled_bytes" in m
+    assert "dftpu_store_spilled_bytes{" in text
 
 
 def test_get_metrics_degrades_per_worker():
@@ -514,6 +518,12 @@ def test_serving_slo_and_registry(serving_ctx):
             merged = obs.get_metrics()["metrics"]
             assert "dftpu_serving_admitted" in merged
             assert "dftpu_worker_tasks_executed" in merged
+            # golden names for the memory-pressure work: the preemption
+            # counter (exposition appends _total) and spill gauge
+            assert "dftpu_queries_preempted" in merged
+            assert "dftpu_queries_preempted_total 0" in (
+                obs.render_openmetrics()
+            )
         finally:
             srv.close()
     finally:

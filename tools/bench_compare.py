@@ -7,6 +7,8 @@ Compares, wherever both files carry them:
 - per-query wall seconds (``per_query_s``; ``--queries`` restricts)
 - suite total (``total_s``)
 - warm-repeat walls (``warm_repeat_s``)
+- peak staged bytes (``peak_staged_bytes``, direction-aware: LOWER is
+  better — memory regressions are flagged even when walls hold)
 - serving metrics folded into ``meta.serving`` by `bench.py --serving`
   (qps: HIGHER is better; cheap/straggler p99 ms: LOWER is better; SLO
   latency attainment: HIGHER is better)
@@ -95,6 +97,11 @@ def compare(baseline: dict, current: dict, threshold: float = 0.10,
             "per_query_s:", min_value=min_seconds)
     section(baseline.get("warm_repeat_s"), current.get("warm_repeat_s"),
             "warm_repeat_s:", min_value=min_seconds)
+    # direction-aware memory column: peak staged bytes per query/arm
+    # (LOWER is better — a growing staged peak is a data-plane
+    # regression even when walls hold)
+    section(baseline.get("peak_staged_bytes"),
+            current.get("peak_staged_bytes"), "peak_staged_bytes:")
     if baseline.get("total_s") is not None and (
         current.get("total_s") is not None
     ):
@@ -110,6 +117,7 @@ def compare(baseline: dict, current: dict, threshold: float = 0.10,
         "cheap_p50_ms": False,
         "straggler_p99_ms_on": False,
         "slo_latency_attainment": True,
+        "peak_staged_bytes": False,
     }
     for name, hib in serving_metrics.items():
         if bs.get(name) is not None and cs.get(name) is not None:

@@ -131,6 +131,11 @@ _BLOCKING_TAIL = {
     "execute_task_partitions", "execute_plan", "block_until_ready",
     "_execute_attempt", "_dispatch_hedge", "_hedged_execute",
     "_hedged_first_chunk",
+    # spill-segment I/O entry points (runtime/spill.py): encoding a
+    # table to disk / decoding it back must never run under a store
+    # lock — the TableStore picks victims locked, does the I/O
+    # unlocked, then re-acquires to swap the entry
+    "write_spill", "read_spill",
 }
 #: receiver hints for ``.wait()`` / ``.result()`` blocking calls — an
 #: ``Event.wait`` or ``Future.result`` under a lock stalls every other
