@@ -144,6 +144,31 @@ def _vs_baseline(suite: str, sf: float, per_query: dict, total: float) -> float:
 # Child: owns JAX. Streams events (one JSON object per line) to _EVENTS.
 # --------------------------------------------------------------------------
 
+def _wire_counter_totals():
+    """Summed `dftpu_wire_bytes` / `dftpu_wire_bytes_saved` across data
+    planes — sampled before/after each query so the per-query event can
+    carry the wire delta. Best-effort: 0s when telemetry isn't up."""
+    try:
+        from datafusion_distributed_tpu.runtime.telemetry import (
+            DEFAULT_REGISTRY,
+        )
+
+        wire = DEFAULT_REGISTRY.counter(
+            "dftpu_wire_bytes",
+            "Payload bytes that crossed the wire, by data plane",
+            labels=("plane",),
+        )
+        saved = DEFAULT_REGISTRY.counter(
+            "dftpu_wire_bytes_saved",
+            "Wire bytes avoided (shm references, compression delta)",
+            labels=("plane",),
+        )
+        return (sum(v for _labels, v in wire.samples()),
+                sum(v for _labels, v in saved.samples()))
+    except Exception:
+        return (0.0, 0.0)
+
+
 def _emit(fh, **kw):
     kw["ts"] = round(time.time(), 3)
     fh.write(json.dumps(kw) + "\n")
@@ -315,6 +340,7 @@ def _child_main() -> None:
             df = ctx.sql(sql)
             runs = []
             best = float("inf")
+            wire0, saved0 = _wire_counter_totals()
             # warm-up run compiles; second run measures steady-state
             # latency (the reference reports p50 of repeat runs)
             for _attempt in range(2):
@@ -358,6 +384,14 @@ def _child_main() -> None:
                 "runs": runs, "bytes_in": bytes_in,
                 "gbps": round(gbps, 2), "platform": platform,
             }
+            # per-query wire accounting (summed across planes): bytes a
+            # socket actually carried vs bytes the shm plane / adaptive
+            # compression kept off it. Zero for single-process runs —
+            # the counters only move on the cross-process planes.
+            wire1, saved1 = _wire_counter_totals()
+            if wire1 > wire0 or saved1 > saved0:
+                ev["wire_bytes"] = int(wire1 - wire0)
+                ev["wire_bytes_saved"] = int(saved1 - saved0)
             if warm_s is not None:
                 ev["warm_s"] = warm_s
             if hbm_gbps:
@@ -1057,7 +1091,8 @@ def main() -> None:
                 state["meta"].setdefault(f"{plat}_queries", {})[ev["q"]] = {
                     k: ev[k] for k in
                     ("runs", "warm_s", "bytes_in", "gbps",
-                     "pct_hbm_roofline")
+                     "pct_hbm_roofline", "wire_bytes",
+                     "wire_bytes_saved")
                     if k in ev}
                 print(f"  [{plat}] {ev['q']}: {ev['secs']}s "
                       f"({ev.get('gbps', '?')} GB/s, "

@@ -669,7 +669,10 @@ def main() -> None:
         cfg = {"zero_copy": zero_copy}
         best = float("inf")
         for rep in range(args.repeats + 1):  # rep 0 warms the compile
-            key = TaskKey(f"dp{int(zero_copy)}", 0, rep)
+            # vary the QUERY id per rep: TaskKey's third field is the
+            # task INDEX — rep as task index made every rep>0 scan an
+            # empty range of this 1-task plan (timing an empty hop)
+            key = TaskKey(f"dp{int(zero_copy)}r{rep}", 0, 0)
             plan_obj = encode_plan(
                 MemoryScanExec([dp_t], dp_t.schema()), w.table_store
             )
@@ -716,9 +719,85 @@ def main() -> None:
     })
     print(json.dumps(results[-1]), flush=True)
 
+    # ---- shm segment plane ------------------------------------------------
+    # The same producer fan-out, but each partition piece crosses a
+    # process boundary BY REFERENCE: DFSP-framed into a SegmentPool
+    # segment (tmpfs), the consumer opens + decodes it from the
+    # producer's pool dir. `copied_mb` is what a socket would have
+    # carried — zero here; the unary plane ships the full payload — and
+    # is the number tools/bench_compare.py tracks against the copy arm.
+    from datafusion_distributed_tpu.runtime.codec import (
+        decode_table,
+        encode_table,
+    )
+    from datafusion_distributed_tpu.runtime.shm_plane import SegmentPool
+
+    def dp_shm_arm():
+        os.environ["DFTPU_ZERO_COPY"] = "0"
+        w = Worker(url="mem://dp-shm")
+        pool = SegmentPool()
+        pdir = pool.descriptor()["dir"]
+        best = float("inf")
+        payload_bytes = 0
+        try:
+            for rep in range(args.repeats + 1):  # rep 0 warms the compile
+                key = TaskKey(f"dpshm{rep}", 0, 0)
+                plan_obj = encode_plan(
+                    MemoryScanExec([dp_t], dp_t.schema()), w.table_store
+                )
+                w.set_plan(key, plan_obj, 1, config={"zero_copy": False})
+                t0 = time.perf_counter()
+                parts = [[] for _ in range(N_DEST)]
+                payload_bytes = 0
+                for p, piece, _est in w.execute_task_partitions(
+                    key, ["k"], N_DEST, 0, N_DEST,
+                    per_dest_capacity=n, chunk_rows=65536,
+                ):
+                    # producer side: frame + publish by reference
+                    blob = encode_table(piece)
+                    payload_bytes += len(blob)
+                    name, token = pool.publish(blob)
+                    # consumer side: open from the pool dir, decode
+                    from datafusion_distributed_tpu.runtime import (
+                        shm_plane,
+                    )
+                    data, _cap = shm_plane.open_segment_at(pdir, name)
+                    parts[p].append(decode_table(data))
+                    shm_plane.release_at(pdir, name, token)
+                outs = [concat_tables(c, capacity=n) for c in parts if c]
+                for o in outs:
+                    np.asarray(o.columns[0].data)
+                dt = time.perf_counter() - t0
+                if rep:
+                    best = min(best, dt)
+        finally:
+            pool.shutdown()
+            if dp_env_saved is None:
+                os.environ.pop("DFTPU_ZERO_COPY", None)
+            else:
+                os.environ["DFTPU_ZERO_COPY"] = dp_env_saved
+        return best, payload_bytes
+
+    t_dp_shm, shm_payload = dp_shm_arm()
+    results.append({
+        "bench": "data_plane_shm",
+        "ms": round(t_dp_shm * 1e3, 2),
+        "gbps": round(dp_bytes / t_dp_shm / 1e9, 3),
+        "copied_mb": 0.0,  # segments cross by reference, not by socket
+        "payload_mb": round(shm_payload / 1e6, 2),
+        "fanout": N_DEST,
+    })
+    print(json.dumps(results[-1]), flush=True)
+    # the copy plane's socket bytes for the same hop: the full payload
+    results.append({
+        "bench": "data_plane_copy_wire",
+        "copied_mb": round(shm_payload / 1e6, 2),
+        "fanout": N_DEST,
+    })
+    print(json.dumps(results[-1]), flush=True)
+
     # ---- transport framing ------------------------------------------------
     from datafusion_distributed_tpu.runtime import transport
-    from datafusion_distributed_tpu.runtime.codec import encode_table
 
     blob = encode_table(t)
     for codec in ("zstd", "none"):
@@ -733,6 +812,29 @@ def main() -> None:
             "ratio": round(len(frame) / max(len(blob), 1), 3),
         })
         print(json.dumps(results[-1]), flush=True)
+
+    # ---- lz4 wire arm -----------------------------------------------------
+    # lz4 is an OPTIONAL codec (absent from some images, including this
+    # one's default build): when importable, measure the same framed
+    # roundtrip; when not, emit a skipped record so bench_compare can
+    # tell "not run" from "regressed" across baselines.
+    if "lz4" in transport.supported_codecs():
+        t0 = time.perf_counter()
+        frame = transport.pack_frame({"k": 1}, {"t": blob}, codec="lz4")
+        _, blobs = transport.unpack_frame(frame)
+        dt = time.perf_counter() - t0
+        results.append({
+            "bench": "data_plane_wire_lz4",
+            "ms": round(dt * 1e3, 3),
+            "mb_per_s": round(len(blob) / dt / 1e6, 1),
+            "ratio": round(len(frame) / max(len(blob), 1), 3),
+        })
+    else:
+        results.append({
+            "bench": "data_plane_wire_lz4",
+            "skipped": "lz4 module unavailable on this image",
+        })
+    print(json.dumps(results[-1]), flush=True)
 
     summary = {
         "metric": "micro_bench_suite",

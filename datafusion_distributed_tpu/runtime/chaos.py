@@ -10,7 +10,10 @@ A seeded `FaultPlan` wraps a cluster's workers (`wrap_cluster` /
              PlanIntegrityError instead of wrong results
   execute    crash-mid-execute / transient transport errors / slow-worker
              delays, applied uniformly to execute_task,
-             execute_task_stream and execute_task_partitions
+             execute_task_stream, execute_task_partitions and
+             transfer_partitions; kind="segment_lost" (transfer-only)
+             tears the next shm segment mid-stream, asserting the pull
+             degrades to the wire path instead of failing the query
 
 Membership churn (`MembershipEvent`): seeded `leave`/`join`/`drain`
 events scheduled by site/stage/task like the fault kinds above, applied
@@ -510,6 +513,16 @@ class ChaosWorker:
                 _interruptible_sleep(spec.delay_s, cancel)
             elif spec.kind == "oom":
                 self._apply_oom(spec)
+            elif spec.kind == "segment_lost":
+                # transfer-specific: ARM the client's tear-next-segment
+                # hook and delegate — the fault manifests mid-stream as
+                # a vanished shm segment (the window a dying producer
+                # leaves behind), and the assertion is that the pull
+                # DEGRADES to the wire path, not that this call raises.
+                # On clients without the hook (in-process workers, other
+                # data-plane calls) the schedule slot is a no-op.
+                if hasattr(self._inner, "_chaos_tear_next_segment"):
+                    self._inner._chaos_tear_next_segment = True
             else:
                 _raise_for(spec, "execute", self.url, key)
 
@@ -536,6 +549,15 @@ class ChaosWorker:
     def execute_task_partitions(self, key, *a, **kw):
         self._execute_fault(key, kw.get("cancel"))
         return self._inner.execute_task_partitions(key, *a, **kw)
+
+    def transfer_partitions(self, key, *a, **kw):
+        # explicit proxy (NOT __getattr__ passthrough) so transfer pulls
+        # sit under the same execute-site fault schedule as the other
+        # data-plane calls — including kind="segment_lost", which arms
+        # the client's tear hook in _execute_fault and lets the stream
+        # proceed into the torn-segment window
+        self._execute_fault(key, kw.get("cancel"))
+        return self._inner.transfer_partitions(key, *a, **kw)
 
     # -- transparent delegation ---------------------------------------------
     def __getattr__(self, name):

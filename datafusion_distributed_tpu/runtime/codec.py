@@ -1267,3 +1267,99 @@ def decode_table(data, capacity: Optional[int] = None) -> Table:
     with pa.ipc.open_stream(pa.BufferReader(data)) as r:
         arrow = r.read_all()
     return arrow_to_table(arrow, capacity=capacity)
+
+
+# ---------------------------------------------------------------------------
+# adaptive per-column wire compression (remote hops only)
+# ---------------------------------------------------------------------------
+
+#: rows sampled per column when choosing its wire codec — enough to see
+#: repetition without paying a full-column unique() on wide exchanges
+WIRE_SAMPLE_ROWS = 512
+#: payloads under this ship as one plainly-compressed blob: per-column
+#: IPC framing has fixed schema overhead that only pays on real payloads
+ADAPTIVE_MIN_BYTES = 1 << 12
+
+
+def choose_column_codec(column, available) -> str:
+    """Wire codec for ONE arrow column from sampled statistics — the
+    adaptive half of the remote data plane. Dictionary/string columns
+    are dominated by repeated values and codes: the strongest available
+    codec (zstd) wins. Repetitive columns (sampled unique ratio <= 0.5)
+    prefer the cheapest negotiated codec (lz4 beats zstd on speed when
+    both ends speak it). High-entropy floats ship raw — compressing
+    random mantissas burns CPU to save nothing. ``available`` is the
+    NEGOTIATED codec set (both endpoints), not this process's."""
+    import pyarrow as pa
+
+    avail = set(available or ())
+
+    def best(*prefs: str) -> str:
+        for p in prefs:
+            if p in avail:
+                return p
+        return "none"
+
+    t = column.type
+    if pa.types.is_dictionary(t) or pa.types.is_string(t) or (
+        pa.types.is_large_string(t)
+    ):
+        return best("zstd", "lz4")
+    sample = column.slice(0, min(len(column), WIRE_SAMPLE_ROWS))
+    try:
+        ratio = len(sample.unique()) / max(len(sample), 1)
+    except pa.ArrowInvalid:
+        ratio = 1.0
+    if ratio <= 0.5:
+        return best("lz4", "zstd")
+    if pa.types.is_floating(t):
+        return "none"
+    return best("zstd", "lz4")
+
+
+def encode_table_adaptive(table: Table, available) -> tuple[dict, dict]:
+    """Table -> per-column Arrow IPC blobs with per-column codec picks;
+    -> (blobs {"c<i>": payload}, codecs {"c<i>": codec}). Each column is
+    its own single-column IPC stream so the transport's per-blob
+    ``comp`` framing (self-describing) carries a MIXED-codec frame; the
+    decoder reassembles the columns into one table. Returns ({}, {})
+    for a zero-column table — callers fall back to `encode_table`."""
+    import pyarrow as pa
+
+    from datafusion_distributed_tpu.io.parquet import table_to_arrow
+
+    arrow = table_to_arrow(table, dictionary_gc=True,
+                           logical_metadata=True)
+    blobs: dict = {}
+    codecs: dict = {}
+    for i in range(arrow.num_columns):
+        single = arrow.select([i])
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, single.schema) as w:
+            w.write_table(single)
+        name = f"c{i}"
+        blobs[name] = memoryview(sink.getvalue())
+        codecs[name] = choose_column_codec(arrow.column(i), available)
+    return blobs, codecs
+
+
+def decode_table_adaptive(blobs: dict, num_cols: int,
+                          capacity: Optional[int] = None) -> Table:
+    """Reassemble `encode_table_adaptive` blobs into one Table: the
+    single-column arrow tables are re-joined and decoded through the
+    SAME `arrow_to_table` call as the single-blob path, so both wire
+    shapes build byte-identical tables."""
+    import pyarrow as pa
+
+    from datafusion_distributed_tpu.io.parquet import arrow_to_table
+
+    if num_cols <= 0:
+        raise CodecError("adaptive frame with zero columns")
+    parts = []
+    for i in range(num_cols):
+        with pa.ipc.open_stream(pa.BufferReader(blobs[f"c{i}"])) as r:
+            parts.append(r.read_all())
+    arrow = parts[0]
+    for t in parts[1:]:
+        arrow = arrow.append_column(t.schema.field(0), t.column(0))
+    return arrow_to_table(arrow, capacity=capacity)

@@ -1335,7 +1335,7 @@ class Coordinator:
                 plan, query_id, stage_id,
                 sum(int(o.num_rows) for o in outputs)
                 * row_width(producer.schema()),
-                "bulk",
+                "unary" if self._data_plane() == "unary" else "bulk",
             )
             # consumer-count decision + regroup are overridable together:
             # the adaptive coordinator defers co-shuffled siblings so a
@@ -1397,6 +1397,27 @@ class Coordinator:
         AdaptiveCoordinator returns a ColumnStreamSampler.observe."""
         return None
 
+    # -- data-plane selection ------------------------------------------------
+    def _data_plane(self) -> str:
+        """`SET distributed.data_plane` (default ``auto``): which
+        cross-process plane serves exchange boundaries. ``auto`` keeps
+        the existing ladder (peer pulls -> partition streams -> bulk);
+        ``stream``/``shm`` force every shuffle through the streaming
+        TransferPartitions RPC (shm additionally offering the co-located
+        segment plane); ``unary`` forces the bulk whole-table plane.
+        Plane choice is EXECUTION routing only — never traced, never
+        part of the plan fingerprint — so toggling it recompiles
+        nothing and must not change a single result byte."""
+        return str(self.config_options.get("data_plane", "auto")).lower()
+
+    def _forced_plane_label(self, default: str) -> str:
+        """Telemetry label for an exchange: the forced plane name when
+        `data_plane` is pinned to stream/shm, else the ladder's own
+        label — so `dftpu_exchange_bytes{plane=...}` separates forced
+        planes from auto routing."""
+        plane = self._data_plane()
+        return plane if plane in ("stream", "shm") else default
+
     # -- peer-to-peer data plane ---------------------------------------------
     def _peer_plane_enabled(self, exchange) -> bool:
         """Default plane for shuffle/broadcast/N:M-coalesce boundaries when
@@ -1408,6 +1429,11 @@ class Coordinator:
         on the coordinator). RangeShuffle keeps the host plane for its exact
         global sort. `SET distributed.peer_shuffle = false` restores the
         coordinator-mediated plane everywhere."""
+        if self._data_plane() != "auto":
+            # a forced plane (unary/stream/shm) routes every boundary
+            # through the coordinator-mediated paths the toggle names;
+            # peer pulls would bypass the selection
+            return False
         if not bool(self.config_options.get("peer_shuffle", True)):
             return False
         if isinstance(exchange, RangeShuffleExchangeExec):
@@ -1699,6 +1725,10 @@ class Coordinator:
         coordinator overrides to False: it resizes consumer task counts
         from exact materialized outputs, while a partition stream fixes
         the partition count in the request."""
+        if self._data_plane() == "unary":
+            # forced unary: the bulk whole-table plane, the byte-identity
+            # baseline every streaming plane is gated against
+            return False
         try:
             return all(
                 hasattr(self.channels.get_worker(u),
@@ -1722,14 +1752,36 @@ class Coordinator:
         planes or their byte-identity contract drifts. Each puller
         yields ((partition, chunk), est_bytes)."""
         t_cons = exchange.num_tasks
+        plane = self._data_plane()
+        wire_mode = str(
+            self.config_options.get("wire_compression", "auto")
+        ).lower()
+        use_transfer = plane in ("stream", "shm")
 
         def make_puller(task_number: int):
             def body(worker, key, cancel):
-                for p, piece, est in worker.execute_task_partitions(
-                    key, exchange.key_names, t_cons, 0, t_cons,
-                    per_dest_capacity=exchange.per_dest_capacity,
-                    chunk_rows=chunk_rows, cancel=cancel,
-                ):
+                if use_transfer and hasattr(worker, "transfer_partitions"):
+                    # forced stream/shm plane: the streaming
+                    # TransferPartitions RPC — same request shape and
+                    # yield contract (the server delegates to
+                    # execute_task_partitions), so retries reroute
+                    # through _pull_task_with_retry unchanged. After a
+                    # SegmentError the client marks shm broken and the
+                    # re-pull lands here again, wire-only.
+                    it = worker.transfer_partitions(
+                        key, exchange.key_names, t_cons, 0, t_cons,
+                        per_dest_capacity=exchange.per_dest_capacity,
+                        chunk_rows=chunk_rows, cancel=cancel,
+                        wire_compression=wire_mode,
+                        shm=(plane == "shm"),
+                    )
+                else:
+                    it = worker.execute_task_partitions(
+                        key, exchange.key_names, t_cons, 0, t_cons,
+                        per_dest_capacity=exchange.per_dest_capacity,
+                        chunk_rows=chunk_rows, cancel=cancel,
+                    )
+                for p, piece, est in it:
                     yield (p, piece), est
 
             def pull(cancel):
@@ -1763,9 +1815,10 @@ class Coordinator:
         chunk_rows = int(self.config_options.get("stream_chunk_rows", 65536))
         prepared = self._prepare_stage_plan(producer)
         obs = self._chunk_observer(stage_id)
+        plane_label = self._forced_plane_label("partition-stream")
         tr = self._tr()
         with tr.span("transfer", "transfer", stage=stage_id,
-                     plane="partition-stream") as xfer:
+                     plane=plane_label) as xfer:
             chunks, stats = stream_stage_chunks(
                 self._partition_stream_pullers(
                     exchange, prepared, query_id, stage_id, t_prod,
@@ -1792,7 +1845,7 @@ class Coordinator:
         }
         self._record_exchange_bytes(
             exchange, query_id, stage_id, stats.bytes_streamed,
-            "partition-stream",
+            plane_label,
         )
         parts: list[list[Table]] = [[] for _ in range(t_cons)]
         for per in chunks:
@@ -1877,10 +1930,11 @@ class Coordinator:
         # explicit start/end (no context manager): the transfer span
         # covers the stream's full production window and is closed by the
         # feeder thread at completion
+        plane_label = self._forced_plane_label("pipelined")
         xfer = tr.start_span(
             "transfer", "transfer",
             parent=tr.reserved_id(("stage", stage_id)),
-            stage=stage_id, plane="pipelined",
+            stage=stage_id, plane=plane_label,
         )
         pullers = self._partition_stream_pullers(
             exchange, prepared, query_id, stage_id, t_prod, chunk_rows,
@@ -1890,7 +1944,7 @@ class Coordinator:
         # at first slice); the feeder overwrites with the full stats at
         # completion
         self.stream_metrics[(query_id, stage_id)] = {
-            "plane": "pipelined",
+            "plane": plane_label,
             "partitions": t_cons,
             "producers": t_prod,
         }
@@ -1920,7 +1974,7 @@ class Coordinator:
                 chunks=stats.chunks,
             ))
             self.stream_metrics[(query_id, stage_id)] = {
-                "plane": "pipelined",
+                "plane": plane_label,
                 "bytes_streamed": stats.bytes_streamed,
                 "chunks": stats.chunks,
                 "peak_in_flight": stats.peak_in_flight,
@@ -1934,7 +1988,7 @@ class Coordinator:
             }
             self._record_exchange_bytes(
                 exchange, query_id, stage_id, stats.bytes_streamed,
-                "pipelined",
+                plane_label,
             )
 
         t = _threading.Thread(target=run_feed, daemon=True,

@@ -232,6 +232,104 @@ def _handlers(worker: Worker):
             worker.registry.invalidate(key)
             worker.table_store.remove(msg.get("table_ids", []))
 
+    def transfer_partitions(request: bytes, context):
+        """Server-streaming DoGet-style transfer (the Arrow Flight layer
+        of SURVEY.md §L3): serves the SAME partition-chunk sequence as
+        `execute_task` partition multiplexing — the planes' byte-identity
+        contract — but classifies the hop first:
+
+        co-located (client hostname == ours): each chunk's Arrow IPC
+        payload is PUBLISHED into the worker's segment pool and the
+        stream carries only an S-frame reference {dir, seg, token} —
+        zero payload bytes on the wire; the consumer mmap-reads the
+        segment and drops its reference.
+
+        remote: chunks ship as wire frames with ADAPTIVE per-column
+        compression (A-frames, runtime/codec.encode_table_adaptive)
+        under the codec set both ends negotiated, falling back to
+        single-blob P-frames for tiny payloads or forced codecs."""
+        from datafusion_distributed_tpu.runtime.codec import (
+            ADAPTIVE_MIN_BYTES,
+            encode_table_adaptive,
+        )
+        from datafusion_distributed_tpu.runtime.shm_plane import (
+            SegmentError,
+            SegmentPool,
+        )
+
+        msg = json.loads(request.decode())
+        key = _key_from_obj(msg["key"])
+        chunk_rows = int(msg.get("chunk_rows", 65536)) or 65536
+        parts = msg["partitions"]
+        peer_codecs = msg.get("wire_codecs") or None
+        wire_mode = msg.get("wire_compression", "auto")
+        base = transport.negotiate_codec(
+            msg.get("compression", "zstd"), peer_codecs
+        )
+        if wire_mode in ("zstd", "lz4"):
+            base = transport.negotiate_codec(wire_mode, peer_codecs)
+        elif wire_mode == "off":
+            base = "none"
+        # adaptive picks only from codecs BOTH ends decode
+        allowed = [
+            c for c in transport.supported_codecs()
+            if peer_codecs is None or c in peer_codecs
+        ]
+        pool = worker.segment_pool
+        serve_shm = SegmentPool.same_host(msg.get("shm"))
+        try:
+            for p, piece, est in worker.execute_task_partitions(
+                key, parts["keys"], int(parts["num"]),
+                int(parts["lo"]), int(parts["hi"]),
+                per_dest_capacity=int(parts.get("per_dest_cap", 0)),
+                chunk_rows=chunk_rows,
+            ):
+                if not context.is_active():  # cancelled: stop producing
+                    return
+                if serve_shm:
+                    payload = encode_table(piece)
+                    try:
+                        name, token = pool.publish(
+                            payload, int(getattr(piece, "capacity", 0))
+                        )
+                    except SegmentError:
+                        # pool unusable (tmpfs full/gone): degrade the
+                        # REST of the stream to the wire path
+                        serve_shm = False
+                    else:
+                        yield b"S" + json.dumps({
+                            "part": p, "seg": name, "token": token,
+                            "dir": pool.descriptor()["dir"],
+                            "nbytes": len(payload),
+                        }).encode()
+                        continue
+                if wire_mode == "auto" and est > ADAPTIVE_MIN_BYTES:
+                    blobs, col_codecs = encode_table_adaptive(
+                        piece, allowed
+                    )
+                    if blobs:
+                        yield b"A" + transport.pack_frame(
+                            {"part": p, "cols": len(blobs)}, blobs,
+                            codec=base, codecs=col_codecs,
+                        )
+                        continue
+                yield b"P" + transport.pack_frame(
+                    {"part": p}, {"table": encode_table(piece)},
+                    codec=base,
+                )
+            yield b"H" + json.dumps(
+                {"progress": worker.task_progress(key)}
+            ).encode()
+        except WorkerError as e:
+            yield b"E" + json.dumps(e.to_dict()).encode()
+        except Exception as e:
+            yield b"E" + json.dumps(
+                wrap_worker_exception(e, worker.url, key).to_dict()
+            ).encode()
+        finally:
+            if worker.partitions_remaining(key) in (None, 0):
+                worker.table_store.remove(msg.get("table_ids", []))
+
     def get_info(request: bytes, context) -> bytes:
         return json.dumps(worker.get_info()).encode()
 
@@ -268,6 +366,12 @@ def _handlers(worker: Worker):
     }
     method_handlers["ExecuteTask"] = grpc.unary_stream_rpc_method_handler(
         execute_task, request_deserializer=None, response_serializer=None
+    )
+    method_handlers["TransferPartitions"] = (
+        grpc.unary_stream_rpc_method_handler(
+            transfer_partitions,
+            request_deserializer=None, response_serializer=None,
+        )
     )
     return grpc.method_handlers_generic_handler(_SERVICE, method_handlers)
 
@@ -326,6 +430,32 @@ class GrpcWorkerClient:
         self.registry = _NullRegistry()
         self._shipped_ids: dict[TaskKey, list] = {}
         self._progress_cache: dict[TaskKey, Optional[dict]] = {}
+        # per-CONNECTION negotiated codec (None until the first data
+        # call asks the server what it decodes)
+        self._negotiated_codec: Optional[str] = None
+        # set after a SegmentError: the shm plane stays off for this
+        # connection (retries re-pull over the wire path)
+        self._shm_broken = False
+        # chaos hook (runtime/chaos.py kind="segment_lost"): tear the
+        # next S-frame's segment before opening it
+        self._chaos_tear_next_segment = False
+
+    def _wire_codec(self) -> str:
+        """The codec this connection puts on the wire: the constructor's
+        request intersected with the SERVER's advertised `wire_codecs`
+        (GetInfo), negotiated once per connection. A server without the
+        field (version skew) or an unreachable GetInfo falls back to this
+        end's `effective_codec` alone — the frame stays self-describing
+        either way, so a mistaken pick degrades, never corrupts."""
+        cached = self._negotiated_codec
+        if cached is None:
+            try:
+                peer = self.get_info().get("wire_codecs")
+            except Exception:
+                peer = None
+            cached = transport.negotiate_codec(self.compression, peer)
+            self._negotiated_codec = cached
+        return cached
 
     def _call(self, method: str, payload: dict,
               timeout: Optional[float] = None) -> dict:
@@ -385,7 +515,7 @@ class GrpcWorkerClient:
                 },
             },
             blobs,
-            codec=self.compression,
+            codec=self._wire_codec(),
         )
         rpc = self._channel.unary_unary(
             f"/{_SERVICE}/SetPlan",
@@ -428,7 +558,7 @@ class GrpcWorkerClient:
         req = json.dumps({
             "key": _key_to_obj(key),
             "table_ids": self._shipped_ids.pop(key, []),
-            "compression": self.compression,
+            "compression": self._wire_codec(),
             "chunk_bytes": self.chunk_bytes,
         }).encode()
         stream = rpc(req, timeout=timeout)
@@ -469,7 +599,7 @@ class GrpcWorkerClient:
         req = json.dumps({
             "key": _key_to_obj(key),
             "table_ids": self._shipped_ids.pop(key, []),
-            "compression": self.compression,
+            "compression": self._wire_codec(),
             "chunk_rows": int(chunk_rows),
         }).encode()
         stream = rpc(req)
@@ -512,7 +642,7 @@ class GrpcWorkerClient:
         req = json.dumps({
             "key": _key_to_obj(key),
             "table_ids": self._shipped_ids.pop(key, []),
-            "compression": self.compression,
+            "compression": self._wire_codec(),
             "chunk_rows": int(chunk_rows),
             "partitions": {
                 "keys": list(key_names), "num": int(num_partitions),
@@ -521,6 +651,7 @@ class GrpcWorkerClient:
             },
         }).encode()
         stream = rpc(req)
+        completed = False
         try:
             import grpc
 
@@ -532,6 +663,9 @@ class GrpcWorkerClient:
                             json.loads(body.decode())
                         )
                     if tag == b"H":
+                        # trails the last chunk: the stream fully drained
+                        # and the server's drop-driven release already ran
+                        completed = True
                         self._progress_cache[key] = json.loads(
                             body.decode()
                         ).get("progress")
@@ -545,6 +679,175 @@ class GrpcWorkerClient:
                 raise _map_rpc_error(e, self.url, key) from e
         finally:
             stream.cancel()
+            self._release_incomplete(key, completed)
+
+    def transfer_partitions(self, key: TaskKey, key_names,
+                            num_partitions: int, part_lo: int,
+                            part_hi: int, per_dest_capacity: int = 0,
+                            chunk_rows: int = 65536, cancel=None,
+                            wire_compression: str = "auto",
+                            shm: bool = True):
+        """Streaming DoGet-style pull (the TransferPartitions RPC):
+        same yield contract as `execute_task_partitions` —
+        (partition_id, chunk Table, wire_bytes) — but the server
+        classifies the hop and picks the cheapest plane per chunk:
+        S-frames carry a shared-memory segment reference (co-located,
+        zero payload bytes on the wire), A-frames adaptive per-column
+        compressed payloads, P-frames the plain single-blob fallback.
+        A torn segment marks the shm plane broken for this connection
+        and raises a RETRYABLE TransportError — the coordinator's
+        normal retry re-pulls the partition over the wire path."""
+        import os
+
+        from datafusion_distributed_tpu.runtime import shm_plane
+        from datafusion_distributed_tpu.runtime.codec import (
+            decode_table_adaptive,
+        )
+        from datafusion_distributed_tpu.runtime.telemetry import (
+            DEFAULT_REGISTRY,
+        )
+
+        wire_ctr = DEFAULT_REGISTRY.counter(
+            "dftpu_wire_bytes",
+            "Payload bytes that crossed the wire, by data plane",
+            labels=("plane",),
+        )
+        saved_ctr = DEFAULT_REGISTRY.counter(
+            "dftpu_wire_bytes_saved",
+            "Wire bytes avoided (shm references, compression delta)",
+            labels=("plane",),
+        )
+        rpc = self._channel.unary_stream(
+            f"/{_SERVICE}/TransferPartitions",
+            request_serializer=None, response_deserializer=None,
+        )
+        req = {
+            "key": _key_to_obj(key),
+            "table_ids": self._shipped_ids.pop(key, []),
+            "compression": self._wire_codec(),
+            "wire_compression": wire_compression,
+            "wire_codecs": transport.supported_codecs(),
+            "chunk_rows": int(chunk_rows),
+            "partitions": {
+                "keys": list(key_names), "num": int(num_partitions),
+                "lo": int(part_lo), "hi": int(part_hi),
+                "per_dest_cap": int(per_dest_capacity),
+            },
+        }
+        if shm and not self._shm_broken:
+            # only the hostname ships: the server reachability-checks
+            # its OWN pool dir, the client checks the dir the S-frame
+            # names — neither trusts a stale descriptor
+            import socket
+
+            req["shm"] = {"host": socket.gethostname()}
+        stream = rpc(json.dumps(req).encode())
+        completed = False
+        try:
+            import grpc
+
+            try:
+                for piece in stream:
+                    tag, body = piece[:1], piece[1:]
+                    if tag == b"E":
+                        raise WorkerError.from_dict(
+                            json.loads(body.decode())
+                        )
+                    if tag == b"H":
+                        # trails the last chunk: the stream fully drained
+                        # and the server's drop-driven release already ran
+                        completed = True
+                        self._progress_cache[key] = json.loads(
+                            body.decode()
+                        ).get("progress")
+                        continue
+                    if tag == b"S":
+                        info = json.loads(body.decode())
+                        if self._chaos_tear_next_segment:
+                            # chaos kind="segment_lost": tear the segment
+                            # between publish and open (the crash window
+                            # a dying producer process leaves behind)
+                            self._chaos_tear_next_segment = False
+                            try:
+                                os.unlink(os.path.join(
+                                    info["dir"], info["seg"] + ".seg"
+                                ))
+                            except OSError:
+                                pass
+                        try:
+                            payload, _cap = shm_plane.open_segment_at(
+                                info["dir"], info["seg"]
+                            )
+                        except shm_plane.SegmentError as e:
+                            # release what we failed to read (idempotent
+                            # on a gone segment), then degrade: wire-only
+                            # for this connection, retryable for this pull
+                            shm_plane.release_at(
+                                info["dir"], info["seg"], info["token"]
+                            )
+                            self._shm_broken = True
+                            DEFAULT_REGISTRY.counter(
+                                "dftpu_shm_fallbacks",
+                                "Shm segments lost; pulls degraded to "
+                                "the wire path",
+                            ).inc()
+                            raise TransportError(
+                                f"shm segment lost ({e}); retry pulls "
+                                f"over the wire path",
+                                worker_url=self.url, task=key,
+                            ) from e
+                        shm_plane.release_at(
+                            info["dir"], info["seg"], info["token"]
+                        )
+                        # decode WITHOUT capacity — identical to the
+                        # P-frame path (the planes' byte-identity
+                        # contract); padding is re-derived downstream
+                        saved_ctr.inc(int(info["nbytes"]), plane="shm")
+                        yield (info["part"], decode_table(payload),
+                               len(body))
+                    elif tag == b"A":
+                        header, blobs = transport.unpack_frame(body)
+                        wire_ctr.inc(len(body), plane="stream")
+                        saved_ctr.inc(
+                            transport.frame_saved_bytes(header),
+                            plane="stream",
+                        )
+                        yield (header["part"],
+                               decode_table_adaptive(
+                                   blobs, header["cols"]
+                               ),
+                               len(body))
+                    else:  # b"P"
+                        header, blobs = transport.unpack_frame(body)
+                        wire_ctr.inc(len(body), plane="stream")
+                        saved_ctr.inc(
+                            transport.frame_saved_bytes(header),
+                            plane="stream",
+                        )
+                        yield (header["part"],
+                               decode_table(blobs["table"]), len(body))
+                    if cancel is not None and cancel.is_set():
+                        return
+            except grpc.RpcError as e:
+                raise _map_rpc_error(e, self.url, key) from e
+        finally:
+            stream.cancel()
+            self._release_incomplete(key, completed)
+
+    def _release_incomplete(self, key: TaskKey, completed: bool) -> None:
+        """Best-effort remote release of a partition stream that tore
+        down before its trailing H-frame (abandoned LIMIT stream, torn
+        segment, retry reroute): the server's drop-driven release only
+        fires when EVERY partition is served, so an abandoned remote
+        task would otherwise pin its registry entry and shipped slices
+        until TTL. The in-process planes get the same sweep from the
+        coordinator's `_cleanup_task`; this is its remote face."""
+        if completed:
+            return
+        try:
+            self._call("Invalidate", {"key": _key_to_obj(key)})
+        except Exception:
+            pass  # release must never mask the stream's own error
 
     def get_info(self) -> dict:
         return self._call("GetInfo", {})
@@ -754,6 +1057,10 @@ class GrpcCluster:
     def shutdown(self) -> None:
         for s in self.servers:
             s.stop(grace=None)
+        for w in self.local_workers:
+            # reclaim shm pool directories (the backstop for references
+            # a dead consumer never released)
+            w.segment_pool.shutdown()
 
 
 def start_localhost_cluster(num_workers: int) -> GrpcCluster:

@@ -27,12 +27,19 @@ try:
 except Exception:  # pragma: no cover - zstd is baked into this image
     _zstd = None
 
+try:  # lz4 is OPTIONAL (absent from this image): every path gates
+    import lz4.frame as _lz4
+except Exception:
+    _lz4 = None
+
 DEFAULT_CHUNK_BYTES = 1 << 20
 
 
 def compress(data: bytes, codec: str) -> bytes:
     if codec == "zstd" and _zstd is not None:
         return _zstd.ZstdCompressor(level=1).compress(data)
+    if codec == "lz4" and _lz4 is not None:
+        return _lz4.compress(bytes(data))
     return data
 
 
@@ -41,28 +48,84 @@ def decompress(data: bytes, codec: str) -> bytes:
         if _zstd is None:
             raise RuntimeError("zstd frame received but zstandard missing")
         return _zstd.ZstdDecompressor().decompress(data)
+    if codec == "lz4":
+        if _lz4 is None:
+            raise RuntimeError("lz4 frame received but lz4 missing")
+        return _lz4.decompress(data)
     return data
 
 
 def effective_codec(codec: str) -> str:
+    """Downgrade a requested codec to what THIS process can actually
+    produce (lz4 -> zstd -> none): the frame stays self-describing, so a
+    downgraded producer never strands a consumer."""
+    if codec == "lz4" and _lz4 is None:
+        codec = "zstd"
     if codec == "zstd" and _zstd is None:
         return "none"
     return codec
 
 
+def supported_codecs() -> list[str]:
+    """Codecs this process can DECODE (and encode) — the per-connection
+    negotiation surface: workers advertise it through GetInfo and
+    clients intersect before choosing a wire codec."""
+    out = ["none"]
+    if _zstd is not None:
+        out.append("zstd")
+    if _lz4 is not None:
+        out.append("lz4")
+    return out
+
+
+def negotiate_codec(requested: str, peer_codecs) -> str:
+    """The codec to put on the wire toward a peer advertising
+    ``peer_codecs``: the requested codec when both ends speak it, else
+    the best shared fallback (zstd, then none). An empty/unknown
+    advertisement (an old worker's GetInfo without the field) falls back
+    to `effective_codec` alone — this end's capabilities."""
+    requested = effective_codec(requested)
+    if not peer_codecs:
+        return requested
+    peers = set(peer_codecs)
+    if requested in peers:
+        return requested
+    if "zstd" in peers and _zstd is not None:
+        return "zstd"
+    return "none"
+
+
+def frame_saved_bytes(header: dict) -> int:
+    """Wire bytes compression saved in an unpacked frame's header (the
+    ``raw_len`` vs ``len`` blob meta delta) — feeds the
+    `dftpu_wire_bytes_saved` telemetry dimension."""
+    saved = 0
+    for m in header.get("blobs", []):
+        raw = m.get("raw_len")
+        if raw is not None:
+            saved += max(int(raw) - int(m["len"]), 0)
+    return saved
+
+
 def pack_frame(header: dict, blobs: dict[str, bytes],
-               codec: str = "zstd") -> bytes:
-    """-> one binary frame; blobs compressed with ``codec``."""
+               codec: str = "zstd", codecs=None) -> bytes:
+    """-> one binary frame; blobs compressed with ``codec`` (or a
+    per-blob override from the ``codecs`` name->codec map — the adaptive
+    per-column plane mixes codecs within one frame; per-blob ``comp``
+    framing keeps the result self-describing)."""
     codec = effective_codec(codec)
     parts = []
     meta = []
     for name, raw in blobs.items():
-        c = compress(raw, codec)
+        blob_codec = codec
+        if codecs is not None and name in codecs:
+            blob_codec = effective_codec(codecs[name])
+        c = compress(raw, blob_codec)
         # compression that doesn't pay for itself ships raw
         if len(c) >= len(raw):
             c, used = raw, "none"
         else:
-            used = codec
+            used = blob_codec
         meta.append({"n": name, "len": len(c), "comp": used,
                      "raw_len": len(raw)})
         parts.append(c)

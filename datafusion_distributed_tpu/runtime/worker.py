@@ -361,6 +361,15 @@ class Worker:
         # data plane to open streams to producer workers (the reference's
         # consumer-side WorkerConnectionPool, `worker_connection_pool.rs`)
         self.peer_channels = peer_channels
+        # co-located segment pool (runtime/shm_plane.py): the streaming
+        # transfer RPC publishes chunk payloads here when the consumer
+        # is classified same-host; cheap to build — no directory exists
+        # until the first publish
+        from datafusion_distributed_tpu.runtime.shm_plane import (
+            SegmentPool,
+        )
+
+        self.segment_pool = SegmentPool()
         # final progress of partition-range tasks, retained past their
         # drop-driven invalidation (consumed once by task_progress)
         self._final_progress: dict[TaskKey, Optional[dict]] = {}
@@ -881,6 +890,30 @@ class Worker:
             self.table_store.remove(staged)
             data.staged_partition_ids = []
 
+    def transfer_partitions(
+        self,
+        key: TaskKey,
+        key_names,
+        num_partitions: int,
+        part_lo: int,
+        part_hi: int,
+        per_dest_capacity: int = 0,
+        chunk_rows: int = 65536,
+        cancel=None,
+        wire_compression: str = "auto",
+        shm=None,
+    ):
+        """In-process face of the streaming `TransferPartitions` RPC
+        (grpc_worker.py): same partition-chunk sequence as
+        `execute_task_partitions` — the planes' byte-identity contract.
+        ``wire_compression``/``shm`` are accepted for surface parity and
+        ignored: an in-process hop ships references, zero wire bytes."""
+        yield from self.execute_task_partitions(
+            key, key_names, num_partitions, part_lo, part_hi,
+            per_dest_capacity=per_dest_capacity, chunk_rows=chunk_rows,
+            cancel=cancel,
+        )
+
     def partitions_remaining(self, key: TaskKey) -> Optional[int]:
         data = self.registry.get(key)
         return None if data is None else data.partitions_remaining
@@ -906,9 +939,17 @@ class Worker:
         return self.peer_channels is not None
 
     def get_info(self) -> dict:
+        from datafusion_distributed_tpu.runtime import transport
+
         return {"url": self.url, "version": self.version,
                 "tasks_cached": len(self.registry),
                 "peer_capable": self.peer_capable,
+                # wire codecs this process can decode: clients intersect
+                # with their own before choosing a connection codec (the
+                # per-connection negotiation surface)
+                "wire_codecs": transport.supported_codecs(),
+                # shm data-plane accounting (runtime/shm_plane.py)
+                "shm": self.segment_pool.stats(),
                 # staged-byte accounting (zero-copy data plane): actual
                 # staged bytes/entries/views + peak, per worker — the
                 # observability service's data-plane surface

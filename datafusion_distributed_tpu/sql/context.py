@@ -192,6 +192,31 @@ class SessionConfig:
                         f"invalid verify_plans mode {value!r} (expected "
                         f"one of {MODES})"
                     )
+            elif key == "data_plane":
+                # cross-process data-plane selection (runtime/
+                # coordinator.py _data_plane): auto keeps the routing
+                # ladder; unary/stream/shm force one plane. Execution
+                # routing only — NEVER trace-relevant (toggling planes
+                # must recompile nothing; the byte-identity gates in
+                # tests/test_shm_plane.py pin that)
+                value = str(value).strip().lower()
+                if value not in ("auto", "unary", "stream", "shm"):
+                    raise ValueError(
+                        f"invalid data_plane {value!r} (expected one of "
+                        f"('auto', 'unary', 'stream', 'shm'))"
+                    )
+            elif key == "wire_compression":
+                # transfer-RPC wire codec policy: auto = adaptive
+                # per-column choice (runtime/codec.py), zstd/lz4 force a
+                # codec (still downgraded through per-connection
+                # negotiation when an end can't decode it), off ships
+                # raw frames
+                value = str(value).strip().lower()
+                if value not in ("auto", "off", "zstd", "lz4"):
+                    raise ValueError(
+                        f"invalid wire_compression {value!r} (expected "
+                        f"one of ('auto', 'off', 'zstd', 'lz4'))"
+                    )
             elif key == "max_concurrent_queries":
                 # serving-tier admission knobs (runtime/serving.py) are
                 # validated at SET time: a bad value must fail the SET,

@@ -1049,7 +1049,9 @@ def parse_statements(sql: str) -> list:
 
 #: SET options whose value is a bare-word enum rather than a literal
 #: (kept in sync with the scope handlers in sql/context.py)
-_ENUM_SET_OPTIONS = frozenset({"verify_plans"})
+_ENUM_SET_OPTIONS = frozenset(
+    {"verify_plans", "data_plane", "wire_compression"}
+)
 
 
 def _expect_word(p: Parser, word: str) -> None:

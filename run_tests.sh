@@ -210,6 +210,22 @@ if ! env DFTPU_LOCK_CHECK=1 python -m pytest tests/test_pipelined_shuffle.py \
         -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
     FAILED+=("tests/test_pipelined_shuffle.py[gate+lockcheck]")
 fi
+# Shm + streaming-transfer data-plane gate (tests/test_shm_plane.py):
+# the cross-process planes — segment refcount lifecycle (last release
+# unlinks, zero leaked segments), spill-file -> segment hardlink
+# composition, torn-segment SegmentError, per-connection wire-codec
+# negotiation, adaptive per-column compression roundtrip, TPC-H
+# q1/q3/q12/q18 byte-identical across data_plane in {unary,stream,shm}
+# on a real gRPC cluster, zero new XLA traces on plane toggle, and the
+# seeded chaos kind="segment_lost" degradation to the wire path. Runs
+# under DFTPU_LOCK_CHECK=1: SegmentPool's decide-locked/do-unlocked
+# publish/open discipline is exercised by concurrent partition pullers.
+echo "=== tests/test_shm_plane.py (shm + streaming data-plane gate, DFTPU_LOCK_CHECK=1)"
+if ! env DFTPU_LOCK_CHECK=1 python -m pytest tests/test_shm_plane.py \
+        -q --no-header \
+        -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
+    FAILED+=("tests/test_shm_plane.py[gate+lockcheck]")
+fi
 for f in tests/test_*.py; do
     [ "$f" = "tests/test_memory_pressure.py" ] && continue  # ran above
     [ "$f" = "tests/test_recompile_budget.py" ] && continue  # ran above
@@ -222,6 +238,7 @@ for f in tests/test_*.py; do
     [ "$f" = "tests/test_telemetry.py" ] && continue  # ran above (gate)
     [ "$f" = "tests/test_elasticity.py" ] && continue  # ran above (gate)
     [ "$f" = "tests/test_data_plane.py" ] && continue  # ran above (gate)
+    [ "$f" = "tests/test_shm_plane.py" ] && continue  # ran above (gate)
     echo "=== $f"
     if ! python -m pytest "$f" -q --no-header -p no:cacheprovider \
             "${MARKER_ARGS[@]}" "$@"; then

@@ -1,0 +1,512 @@
+"""Cross-process data plane: shm segments + streaming transfer (ISSUE 16).
+
+The cross-process planes (runtime/shm_plane.py SegmentPool, the
+TransferPartitions RPC in runtime/grpc_worker.py, adaptive per-column
+wire compression in runtime/codec.py) must be RESULT-INVARIANT: the
+plane a chunk rides is an execution-routing decision, never a semantic
+one.
+
+Contracts pinned here:
+
+- Refcount lifecycle: publish creates a segment with one token, acquire
+  adds readers, the LAST release unlinks — zero `.seg` files once every
+  stream drained (the gate runs under DFTPU_LOCK_CHECK=1 via conftest).
+- Spill composition: a SpillManager file IS a valid segment
+  (`publish_file` hardlinks it, no decode round trip) and refaults
+  byte-identically through the same DFSP frame.
+- Byte identity: TPC-H q1/q3/q12/q18 identical across
+  `distributed.data_plane in {unary, stream, shm}` on a real gRPC
+  cluster, with ZERO new XLA traces on plane toggle and zero leaked
+  slices/segments.
+- Degradation: a seeded chaos `kind="segment_lost"` schedule tears a
+  segment mid-stream; the pull degrades to the wire path (retryable,
+  `dftpu_shm_fallbacks` counts it) instead of failing the query.
+- Negotiation: the wire codec is the intersection of both ends'
+  `supported_codecs()` (GetInfo `wire_codecs`), downgrading cleanly
+  when a codec (lz4 on this image) is unavailable.
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from datafusion_distributed_tpu.io.parquet import arrow_to_table
+from datafusion_distributed_tpu.runtime import shm_plane, transport
+from datafusion_distributed_tpu.runtime.chaos import (
+    one_crash_per_stage,
+    wrap_cluster,
+)
+from datafusion_distributed_tpu.runtime.codec import (
+    decode_table,
+    decode_table_adaptive,
+    encode_table,
+    encode_table_adaptive,
+)
+from datafusion_distributed_tpu.runtime.coordinator import Coordinator
+from datafusion_distributed_tpu.runtime.shm_plane import (
+    SegmentError,
+    SegmentPool,
+)
+from datafusion_distributed_tpu.runtime.spill import SpillManager
+from datafusion_distributed_tpu.runtime.telemetry import DEFAULT_REGISTRY
+
+CHAOS_SEED = int(os.environ.get("DFTPU_CHAOS_SEED", "20260803"))
+FAST = {"task_retry_backoff_s": 0.001}
+
+TPCH = {
+    "q1": """
+select l_returnflag, l_linestatus,
+       sum(l_quantity) as sum_qty,
+       sum(l_extendedprice) as sum_base_price,
+       sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+       sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+       avg(l_quantity) as avg_qty,
+       avg(l_extendedprice) as avg_price,
+       avg(l_discount) as avg_disc,
+       count(*) as count_order
+from lineitem
+where l_shipdate <= date '1998-09-02'
+group by l_returnflag, l_linestatus
+order by l_returnflag, l_linestatus
+""",
+    "q3": """
+select l_orderkey,
+       sum(l_extendedprice * (1 - l_discount)) as revenue,
+       o_orderdate, o_shippriority
+from customer, orders, lineitem
+where c_mktsegment = 'BUILDING'
+  and c_custkey = o_custkey
+  and l_orderkey = o_orderkey
+  and o_orderdate < date '1995-03-15'
+  and l_shipdate > date '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by revenue desc, o_orderdate
+limit 10
+""",
+    "q12": """
+select l_shipmode,
+       sum(case when o_orderpriority = '1-URGENT'
+                  or o_orderpriority = '2-HIGH'
+                then 1 else 0 end) as high_line_count,
+       sum(case when o_orderpriority <> '1-URGENT'
+                 and o_orderpriority <> '2-HIGH'
+                then 1 else 0 end) as low_line_count
+from orders, lineitem
+where o_orderkey = l_orderkey
+  and l_shipmode in ('MAIL', 'SHIP')
+  and l_commitdate < l_receiptdate
+  and l_shipdate < l_commitdate
+  and l_receiptdate >= date '1994-01-01'
+  and l_receiptdate < date '1995-01-01'
+group by l_shipmode
+order by l_shipmode
+""",
+    "q18": """
+select c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice,
+       sum(l_quantity)
+from customer, orders, lineitem
+where o_orderkey in (
+    select l_orderkey from lineitem
+    group by l_orderkey having sum(l_quantity) > 300
+  )
+  and c_custkey = o_custkey
+  and o_orderkey = l_orderkey
+group by c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+order by o_totalprice desc, o_orderdate
+limit 100
+""",
+}
+
+
+def _table(rows=4096, seed=0, strings=True):
+    rng = np.random.default_rng(seed)
+    cols = {
+        "k": rng.integers(0, 64, rows),
+        "v": rng.normal(size=rows),
+    }
+    if strings:
+        cols["s"] = pa.array(rng.choice(["aa", "bb", "cc"], rows))
+    return arrow_to_table(pa.table(cols))
+
+
+@pytest.fixture
+def pool(tmp_path):
+    p = SegmentPool(root=str(tmp_path))
+    yield p
+    p.shutdown()
+
+
+@pytest.fixture(scope="module")
+def tpch_ctx():
+    from datafusion_distributed_tpu.data.tpchgen import gen_tpch
+    from datafusion_distributed_tpu.sql.context import SessionContext
+
+    ctx = SessionContext()
+    ctx.config.distributed_options["bytes_per_task"] = 1  # force fan-out
+    for name, arrow in gen_tpch(sf=0.002, seed=7).items():
+        ctx.register_arrow(name, arrow)
+    return ctx
+
+
+@pytest.fixture(scope="module")
+def grpc_cluster():
+    from datafusion_distributed_tpu.runtime.grpc_worker import (
+        start_localhost_cluster,
+    )
+
+    cluster = start_localhost_cluster(2)
+    yield cluster
+    cluster.shutdown()
+
+
+def _run(ctx, sql, cluster, **opts):
+    df = ctx.sql(sql)
+    coord = Coordinator(resolver=cluster, channels=cluster,
+                        config_options={**FAST, **opts})
+    out = df._strip_quals(
+        df.collect_coordinated_table(coordinator=coord, num_tasks=4)
+    ).to_pandas()
+    return out, coord
+
+
+def _assert_no_leaks(cluster):
+    for w in cluster.local_workers:
+        assert not w.table_store.tables, (
+            f"{w.url} leaked TableStore entries"
+        )
+        assert w.table_store.nbytes() == 0, (
+            f"{w.url} accounting leaked: {w.table_store.stats()}"
+        )
+        assert len(w.registry) == 0, f"{w.url} leaked registry entries"
+        assert w.segment_pool.live_segments() == 0, (
+            f"{w.url} leaked shm segments: {w.segment_pool.stats()}"
+        )
+
+
+def _assert_frames_identical(got, base, label=""):
+    assert list(got.columns) == list(base.columns)
+    for col in base.columns:
+        np.testing.assert_array_equal(
+            got[col].to_numpy(), base[col].to_numpy(),
+            err_msg=f"{label}.{col} diverged between planes",
+        )
+
+
+def _saved(plane):
+    return DEFAULT_REGISTRY.counter(
+        "dftpu_wire_bytes_saved",
+        "Wire bytes avoided (shm references, compression delta)",
+        labels=("plane",),
+    ).value(plane=plane)
+
+
+# ---------------------------------------------------------------------------
+# segment pool: refcount lifecycle, torn segments, spill composition
+# ---------------------------------------------------------------------------
+
+
+def test_segment_lifecycle_publish_open_release(pool):
+    t = _table(rows=512)
+    payload = encode_table(t)
+    name, token = pool.publish(payload, capacity=int(t.capacity))
+    assert pool.live_segments() == 1
+    got, cap = pool.open_segment(name)
+    assert bytes(got) == bytes(payload) and cap == int(t.capacity)
+    back = decode_table(got, capacity=cap)
+    a, b = t.to_numpy(), back.to_numpy()
+    for col in a:
+        np.testing.assert_array_equal(np.asarray(a[col]),
+                                      np.asarray(b[col]), err_msg=col)
+    pool.release(name, token)
+    assert pool.live_segments() == 0  # last release unlinks
+    st = pool.stats()
+    assert st["published"] == 1 and st["opened"] == 1
+    assert st["published_bytes"] == len(payload)
+
+
+def test_segment_refcounts_broadcast_fanout(pool):
+    name, t0 = pool.publish(encode_table(_table(rows=64)))
+    t1 = pool.acquire(name)
+    t2 = pool.acquire(name)
+    pool.release(name, t0)
+    assert pool.live_segments() == 1  # readers still hold it
+    pool.release(name, t1)
+    assert pool.live_segments() == 1
+    pool.release(name, t2)
+    assert pool.live_segments() == 0
+    pool.release(name, t2)  # double release: idempotent, no raise
+    with pytest.raises(SegmentError):
+        pool.acquire(name)  # acquire-after-last-release is gone
+
+
+def test_torn_segment_raises_segment_error(pool):
+    name, token = pool.publish(encode_table(_table(rows=128)))
+    d = pool.descriptor()["dir"]
+    seg = os.path.join(d, f"{name}.seg")
+    # truncate mid-payload: the window a dying producer leaves behind
+    with open(seg, "r+b") as f:
+        f.truncate(10)
+    with pytest.raises(SegmentError):
+        pool.open_segment(name)
+    assert pool.stats()["lost"] == 1
+    with open(seg, "wb"):
+        pass  # empty file: torn header
+    with pytest.raises(SegmentError):
+        shm_plane.open_segment_at(d, name)
+    os.unlink(seg)
+    with pytest.raises(SegmentError):  # vanished entirely
+        shm_plane.open_segment_at(d, name)
+    pool.release(name, token)  # release of a torn segment is safe
+    assert pool.live_segments() == 0
+
+
+def test_publish_file_serves_spill_without_decode(tmp_path, pool):
+    """PR 15 composition: a SpillManager file is DFSP-framed exactly like
+    a segment, so a spilled entry is served by hardlink — no decode/
+    re-encode round trip — and refaults byte-identically."""
+    t = _table(rows=1024, seed=3)
+    sm = SpillManager(root=str(tmp_path))
+    slot = sm.write_spill(t, nbytes=1)
+    name, token = pool.publish_file(slot.path)
+    payload, cap = pool.open_segment(name)
+    assert cap == int(t.capacity)
+    back = decode_table(payload, capacity=cap)
+    a, b = t.to_numpy(), back.to_numpy()
+    for col in a:
+        np.testing.assert_array_equal(np.asarray(a[col]),
+                                      np.asarray(b[col]), err_msg=col)
+    # pool root and spill root share tmp_path: served by hardlink
+    assert pool.stats()["linked"] == 1
+    pool.release(name, token)
+    assert pool.live_segments() == 0
+    sm.release(slot)
+    assert sm.live_files() == 0  # the segment was a link, not a borrow
+
+
+def test_publish_file_rejects_non_dfsp_file(tmp_path, pool):
+    bogus = tmp_path / "not-a-segment.bin"
+    bogus.write_bytes(b"parquet? arrow? neither.")
+    with pytest.raises(SegmentError):
+        pool.publish_file(str(bogus))
+    assert pool.live_segments() == 0  # failed publish leaves nothing
+
+
+def test_same_host_classification():
+    pool = SegmentPool()
+    desc = pool.descriptor()
+    assert SegmentPool.same_host(desc)  # our own descriptor
+    assert SegmentPool.same_host({"host": desc["host"]})  # host-only probe
+    assert not SegmentPool.same_host(
+        {"host": "some-other-host.invalid", "dir": desc["dir"]}
+    )
+    assert not SegmentPool.same_host(
+        {"host": desc["host"], "dir": "/nonexistent/pool/dir"}
+    )
+    assert not SegmentPool.same_host(None)
+    pool.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# wire codec: negotiation + adaptive per-column encode
+# ---------------------------------------------------------------------------
+
+
+def test_codec_negotiation_intersects_both_ends():
+    ours = transport.supported_codecs()
+    assert "none" in ours  # the identity codec is always speakable
+    # requested codec spoken by both ends wins — but only if THIS end
+    # can produce it (effective_codec runs before the intersection)
+    want = transport.effective_codec("zstd")
+    assert transport.negotiate_codec("zstd", ["none", "zstd"]) == want
+    # peer without the requested codec: best shared fallback
+    assert transport.negotiate_codec("zstd", ["none"]) == "none"
+    # lz4 requested: downgrade chain lz4 -> zstd -> none, never naming a
+    # codec either end cannot handle
+    assert transport.negotiate_codec("lz4", ours) in ours
+    # empty/unknown advertisement (old worker): this end's capability
+    assert transport.negotiate_codec("zstd", None) == want
+
+
+def test_get_info_advertises_wire_codecs():
+    from datafusion_distributed_tpu.runtime.worker import Worker
+
+    info = Worker(url="mem://shm-info").get_info()
+    assert info["wire_codecs"] == transport.supported_codecs()
+    assert info["shm"]["published"] == 0
+
+
+def test_adaptive_encode_decode_byte_identical():
+    t = _table(rows=2048, seed=5, strings=True)
+    blobs, codecs = encode_table_adaptive(
+        t, transport.supported_codecs()
+    )
+    assert len(blobs) == len(t.names)
+    assert set(codecs) <= set(blobs)
+    back = decode_table_adaptive(blobs, len(blobs))
+    base = decode_table(encode_table(t))  # the single-blob plane
+    a, b = back.to_numpy(), base.to_numpy()
+    assert list(a) == list(b)
+    for col in a:
+        np.testing.assert_array_equal(np.asarray(a[col]),
+                                      np.asarray(b[col]), err_msg=col)
+    # mixed codecs survive one frame (per-blob comp self-description)
+    frame = transport.pack_frame({"cols": len(blobs)}, blobs,
+                                 codec="zstd", codecs=codecs)
+    header, out = transport.unpack_frame(frame)
+    for n in blobs:
+        assert bytes(out[n]) == bytes(blobs[n])
+    assert transport.frame_saved_bytes(header) >= 0
+
+
+# ---------------------------------------------------------------------------
+# SQL config plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_data_plane_knobs_validate_and_parse():
+    from datafusion_distributed_tpu.sql.context import SessionConfig
+
+    cfg = SessionConfig()
+    for v in ("auto", "unary", "stream", "shm"):
+        cfg.set_option("distributed.data_plane", v)
+        assert cfg.distributed_options["data_plane"] == v
+    with pytest.raises(ValueError):
+        cfg.set_option("distributed.data_plane", "carrier-pigeon")
+    for v in ("auto", "off", "zstd", "lz4"):
+        cfg.set_option("distributed.wire_compression", v)
+        assert cfg.distributed_options["wire_compression"] == v
+    with pytest.raises(ValueError):
+        cfg.set_option("distributed.wire_compression", "gzip")
+
+
+def test_set_statement_accepts_bare_word_planes(tpch_ctx):
+    # bare-word enum values parse (sql/parser.py _ENUM_SET_OPTIONS)
+    tpch_ctx.sql("set distributed.data_plane = shm")
+    assert tpch_ctx.config.distributed_options["data_plane"] == "shm"
+    tpch_ctx.sql("set distributed.wire_compression = zstd")
+    assert (
+        tpch_ctx.config.distributed_options["wire_compression"] == "zstd"
+    )
+    tpch_ctx.sql("set distributed.data_plane = auto")
+    tpch_ctx.sql("set distributed.wire_compression = auto")
+
+
+def test_data_plane_not_trace_relevant():
+    """Plane selection must never enter the shipped trace-relevant
+    config (worker-side fingerprint input): toggling planes recompiles
+    nothing — the zero-new-traces half of the acceptance gate."""
+    from datafusion_distributed_tpu.runtime.worker import (
+        TRACE_RELEVANT_CONFIG_KEYS,
+    )
+
+    assert "data_plane" not in TRACE_RELEVANT_CONFIG_KEYS
+    assert "wire_compression" not in TRACE_RELEVANT_CONFIG_KEYS
+
+
+# ---------------------------------------------------------------------------
+# TPC-H byte identity across planes (gRPC cluster) + zero new traces
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("qname", ["q1", "q3", "q12", "q18"])
+def test_tpch_byte_identical_across_planes(tpch_ctx, grpc_cluster, qname):
+    sql = TPCH[qname]
+    base, _ = _run(tpch_ctx, sql, grpc_cluster, data_plane="unary")
+    _assert_no_leaks(grpc_cluster)
+    saved0 = _saved("shm")
+    pub0 = sum(
+        w.segment_pool.stats()["published"]
+        for w in grpc_cluster.local_workers
+    )
+    for plane in ("stream", "shm"):
+        out, _ = _run(tpch_ctx, sql, grpc_cluster, data_plane=plane)
+        _assert_frames_identical(out, base, f"{qname}[{plane}-vs-unary]")
+        _assert_no_leaks(grpc_cluster)
+    # the shm run actually rode the segment plane (co-located cluster):
+    # segments were published and their payload bytes never hit the wire
+    pub1 = sum(
+        w.segment_pool.stats()["published"]
+        for w in grpc_cluster.local_workers
+    )
+    assert pub1 > pub0, f"{qname}: shm plane never published a segment"
+    assert _saved("shm") > saved0, (
+        f"{qname}: shm plane saved no wire bytes"
+    )
+
+
+def test_plane_toggle_zero_new_traces(tpch_ctx):
+    """Toggling `distributed.data_plane` on a WARM query must compile
+    nothing: the plane decides routing (bulk pull vs partition streams
+    vs shm segments), never plan shape, and neither knob is
+    trace-relevant config. Warm every plane's plan shape first — the
+    unary plane's bulk path and the streaming planes' partition-stream
+    path are different programs, so each compiles once ever — then pin
+    the trace count and toggle through all planes again. Runs on the
+    in-process cluster: the gRPC plan round trip retraces per query
+    regardless of plane (pre-existing, plane-independent), which would
+    mask the thing this test pins."""
+    from datafusion_distributed_tpu.plan import physical as phys
+    from datafusion_distributed_tpu.runtime.coordinator import (
+        InMemoryCluster,
+    )
+
+    planes = ("unary", "stream", "shm")
+    cluster = InMemoryCluster(2)
+    runs = {}
+    for plane in planes:  # warm each plane's plan shape
+        runs[plane], _ = _run(tpch_ctx, TPCH["q3"], cluster,
+                              data_plane=plane)
+    n0 = phys.trace_count()
+    for plane in planes:
+        out, _ = _run(tpch_ctx, TPCH["q3"], cluster, data_plane=plane)
+        _assert_frames_identical(out, runs[plane], f"q3[{plane}-warm]")
+        assert phys.trace_count() == n0, (
+            f"data_plane={plane} toggle recompiled a warm query"
+        )
+
+
+def test_wire_compression_modes_result_invariant(tpch_ctx, grpc_cluster):
+    base, _ = _run(tpch_ctx, TPCH["q3"], grpc_cluster, data_plane="unary")
+    for mode in ("off", "zstd", "lz4"):
+        out, _ = _run(tpch_ctx, TPCH["q3"], grpc_cluster,
+                      data_plane="stream", wire_compression=mode)
+        _assert_frames_identical(out, base, f"q3[wire={mode}]")
+        _assert_no_leaks(grpc_cluster)
+
+
+# ---------------------------------------------------------------------------
+# chaos: a torn segment degrades to the wire path
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_segment_lost_degrades_to_wire(tpch_ctx):
+    """Seeded `kind="segment_lost"` schedule: a segment vanishes between
+    publish and open. The pull must degrade — shm marked broken for the
+    connection, retry re-pulls over the wire — with results identical
+    and zero leaked state on EVERY worker, including the one whose
+    partial stream was abandoned."""
+    from datafusion_distributed_tpu.runtime.grpc_worker import (
+        start_localhost_cluster,
+    )
+
+    cluster = start_localhost_cluster(2)
+    try:
+        base, _ = _run(tpch_ctx, TPCH["q3"], cluster, data_plane="unary")
+        fallbacks = DEFAULT_REGISTRY.counter(
+            "dftpu_shm_fallbacks",
+            "Shm segments lost; pulls degraded to the wire path",
+        )
+        fb0 = fallbacks.value()
+        chaos = wrap_cluster(
+            cluster, one_crash_per_stage(CHAOS_SEED, kind="segment_lost")
+        )
+        out, _ = _run(tpch_ctx, TPCH["q3"], chaos, data_plane="shm")
+        _assert_frames_identical(out, base, "q3[segment_lost]")
+        assert fallbacks.value() > fb0, (
+            "segment_lost schedule never exercised the degradation path"
+        )
+        _assert_no_leaks(cluster)
+    finally:
+        cluster.shutdown()

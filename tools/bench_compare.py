@@ -12,6 +12,11 @@ Compares, wherever both files carry them:
 - serving metrics folded into ``meta.serving`` by `bench.py --serving`
   (qps: HIGHER is better; cheap/straggler p99 ms: LOWER is better; SLO
   latency attainment: HIGHER is better)
+- micro_bench cases under ``micro`` (a {case: record} map or the raw
+  benchmarks/micro_bench.py JSONL record list): per-metric direction —
+  ms/copied_mb/peak_staged_mb LOWER, gbps/mb_per_s HIGHER; a case
+  marked "skipped" (e.g. data_plane_wire_lz4 without the lz4 module)
+  never reads as a regression
 
 A comparison REGRESSES when the current value is worse than baseline by
 more than ``--threshold`` (relative, default 0.10 = 10%); values under
@@ -67,12 +72,43 @@ def _compare_value(name: str, base, cur, threshold: float,
         entry["status"] = "skipped"  # below the noise floor
         return entry
     change = _rel_change(b, c)
+    if b == 0 and c != 0:
+        # zero baseline: any growth is infinite relative change. A
+        # lower-is-better metric that was 0 (copied_mb on the shm plane)
+        # regressing to nonzero must flag, not hide behind the
+        # degenerate division. Finite sentinel keeps --json standard.
+        change = 1e9 if c > 0 else -1e9
     entry["rel_change"] = round(change, 4)
     worse = (-change if higher_is_better else change) > threshold
     better = (change if higher_is_better else -change) > threshold
     entry["status"] = ("regression" if worse
                        else "improvement" if better else "ok")
     return entry
+
+
+#: micro_bench case metric -> direction (True = higher is better).
+#: Metrics not listed here are informational and never compared.
+_MICRO_DIRECTIONS = {
+    "ms": False,
+    "gbps": True,
+    "mb_per_s": True,
+    "copied_mb": False,   # bytes a socket carried: the shm-vs-copy axis
+    "payload_mb": False,
+    "peak_staged_mb": False,
+    "ratio": False,
+    "speedup_vs_copy": True,
+}
+
+
+def _micro_cases(doc: dict) -> dict:
+    """A document's `micro` section as {case: record}. Accepts either
+    that map directly or the raw benchmarks/micro_bench.py JSONL record
+    list (each record self-names via its "bench" field)."""
+    m = doc.get("micro")
+    if isinstance(m, list):
+        m = {r.get("bench"): r for r in m
+             if isinstance(r, dict) and r.get("bench")}
+    return m if isinstance(m, dict) else {}
 
 
 def compare(baseline: dict, current: dict, threshold: float = 0.10,
@@ -125,6 +161,31 @@ def compare(baseline: dict, current: dict, threshold: float = 0.10,
                 f"serving:{name}", bs[name], cs[name], threshold,
                 higher_is_better=hib,
             ))
+    # micro_bench cases (data_plane_copy/view/shm, wire roundtrips, ...):
+    # intersection of both documents' case sets, per-metric direction
+    # from _MICRO_DIRECTIONS. A case either side marked "skipped" (e.g.
+    # data_plane_wire_lz4 on an image without lz4) compares as skipped —
+    # "not run" must never read as "regressed".
+    bm, cm = _micro_cases(baseline), _micro_cases(current)
+    for case in sorted(set(bm) & set(cm)):
+        b, c = bm[case], cm[case]
+        if not isinstance(b, dict) or not isinstance(c, dict):
+            continue
+        if b.get("skipped") or c.get("skipped"):
+            comparisons.append({
+                "name": f"micro:{case}",
+                "baseline": b.get("skipped", "ran"),
+                "current": c.get("skipped", "ran"),
+                "higher_is_better": False,
+                "status": "skipped",
+            })
+            continue
+        for metric, hib in _MICRO_DIRECTIONS.items():
+            if b.get(metric) is not None and c.get(metric) is not None:
+                comparisons.append(_compare_value(
+                    f"micro:{case}:{metric}", b[metric], c[metric],
+                    threshold, higher_is_better=hib,
+                ))
     return {
         "threshold": threshold,
         "comparisons": comparisons,

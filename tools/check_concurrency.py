@@ -136,6 +136,10 @@ _BLOCKING_TAIL = {
     # lock — the TableStore picks victims locked, does the I/O
     # unlocked, then re-acquires to swap the entry
     "write_spill", "read_spill",
+    # shm-plane I/O entry points (runtime/shm_plane.py SegmentPool):
+    # segment publish/link/read are tmpfs I/O under the same
+    # decide-locked / do-unlocked / account-locked discipline
+    "publish", "publish_file", "open_segment",
 }
 #: receiver hints for ``.wait()`` / ``.result()`` blocking calls — an
 #: ``Event.wait`` or ``Future.result`` under a lock stalls every other
