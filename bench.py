@@ -169,6 +169,26 @@ def _wire_counter_totals():
         return (0.0, 0.0)
 
 
+def _adaptivity_counter_totals():
+    """Summed runtime-adaptivity counters (skew splits, partial-agg
+    bail-outs, mid-query replans) — sampled before/after each query so
+    the per-query event can say which adaptations fired. Best-effort:
+    0s when the adaptivity module was never imported."""
+    try:
+        from datafusion_distributed_tpu.runtime.telemetry import (
+            DEFAULT_REGISTRY,
+        )
+
+        snap = DEFAULT_REGISTRY.snapshot()
+        return tuple(
+            sum(v for _labels, v in (snap.get(fam) or {}).get("samples", []))
+            for fam in ("dftpu_skew_splits", "dftpu_partial_agg_bailouts",
+                        "dftpu_replans")
+        )
+    except Exception:
+        return (0.0, 0.0, 0.0)
+
+
 def _emit(fh, **kw):
     kw["ts"] = round(time.time(), 3)
     fh.write(json.dumps(kw) + "\n")
@@ -341,6 +361,7 @@ def _child_main() -> None:
             runs = []
             best = float("inf")
             wire0, saved0 = _wire_counter_totals()
+            adapt0 = _adaptivity_counter_totals()
             # warm-up run compiles; second run measures steady-state
             # latency (the reference reports p50 of repeat runs)
             for _attempt in range(2):
@@ -392,6 +413,17 @@ def _child_main() -> None:
             if wire1 > wire0 or saved1 > saved0:
                 ev["wire_bytes"] = int(wire1 - wire0)
                 ev["wire_bytes_saved"] = int(saved1 - saved0)
+            # which runtime adaptations fired on this query (deltas of
+            # the closed-loop counters). Absent keys mean "none fired" —
+            # on well-estimated plans all three stay 0 and the event
+            # stays as small as before.
+            adapt1 = _adaptivity_counter_totals()
+            for key, b0, b1 in zip(
+                ("adapt_skew_splits", "adapt_bailouts", "adapt_replans"),
+                adapt0, adapt1,
+            ):
+                if b1 > b0:
+                    ev[key] = int(b1 - b0)
             if warm_s is not None:
                 ev["warm_s"] = warm_s
             if hbm_gbps:
@@ -1092,7 +1124,8 @@ def main() -> None:
                     k: ev[k] for k in
                     ("runs", "warm_s", "bytes_in", "gbps",
                      "pct_hbm_roofline", "wire_bytes",
-                     "wire_bytes_saved")
+                     "wire_bytes_saved", "adapt_skew_splits",
+                     "adapt_bailouts", "adapt_replans")
                     if k in ev}
                 print(f"  [{plat}] {ev['q']}: {ev['secs']}s "
                       f"({ev.get('gbps', '?')} GB/s, "

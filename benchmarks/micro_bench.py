@@ -556,6 +556,171 @@ def main() -> None:
     })
     print(json.dumps(results[-1]), flush=True)
 
+    # ---- skew-aware shuffle splitting -------------------------------------
+    # An 80/20-hot shuffle against a per-row-cost cluster (each task
+    # sleeps GIL-released in proportion to its rows — standing in for
+    # the per-row partition/wire cost a real worker pays): wall + the
+    # per-task p99 with the skew splitter off vs on. The split fans the
+    # hot producer slice out as contiguous row-range views, so any win
+    # is pure scheduling — bytes and results stay identical
+    # (tests/test_adaptivity.py pins that).
+    from datafusion_distributed_tpu.plan.exchanges import (
+        CoalesceExchangeExec as _Coal,
+    )
+
+    sk_hot, sk_cold, sk_per_row_s = 8000, 500, 20e-6
+    sk_durations: list = []
+
+    class _PerRowCostWorker(_Wkr):
+        def execute_task(self, key, *a, **kw):
+            out = super().execute_task(key, *a, **kw)
+            dt = int(out.num_rows) * sk_per_row_s
+            sk_durations.append(dt)
+            time.sleep(dt)
+            return out
+
+    class _PerRowCostCluster:
+        def __init__(self, n):
+            self.workers = {
+                f"mem://skew-{i}": _PerRowCostWorker(f"mem://skew-{i}")
+                for i in range(n)
+            }
+            for w in self.workers.values():
+                w.peer_channels = self
+
+        def get_urls(self):
+            return list(self.workers.keys())
+
+        def get_worker(self, url):
+            return self.workers[url]
+
+    def skewed_plan():
+        def mk(nrows, seed):
+            r = np.random.default_rng(seed)
+            return arrow_to_table(pa.table({
+                "k": r.integers(0, 64, nrows),
+                "v": r.normal(size=nrows),
+            }))
+
+        tasks = [mk(sk_hot, 0)] + [mk(sk_cold, i) for i in (1, 2, 3)]
+        scan = _MScan(tasks, tasks[0].schema())
+        ex = _Shuf(scan, ["k"], 4,
+                   round_up_pow2(max(2 * (sk_hot + 3 * sk_cold), 8)))
+        ex.producer_tasks = 4
+        ex.stage_id = 1
+        root = _Coal(ex, 4)
+        root.stage_id = 2
+        return root
+
+    def run_skew(split: bool):
+        sk_durations.clear()
+        cluster = _PerRowCostCluster(4)
+        coord = Coordinator(
+            resolver=cluster, channels=cluster,
+            config_options={
+                # hand-assigned stage ids: sequential scheduler; the
+                # splitter engages on the BULK plane only
+                "stage_parallelism": 1,
+                "pipelined_shuffle": False,
+                "data_plane": "unary",
+                "skew_split_factor": 2.0 if split else 0.0,
+                "skew_split_min_rows": 64,
+            },
+        )
+        t0 = time.perf_counter()
+        coord.execute(skewed_plan())
+        wall = time.perf_counter() - t0
+        p99 = (float(np.percentile(sk_durations, 99))
+               if sk_durations else 0.0)
+        n_splits = sum(v.get("skew_splits", 0)
+                       for v in coord.stream_metrics.values())
+        return wall, p99, n_splits
+
+    run_skew(False)  # warm the XLA compile caches once
+    t_sk_off, p99_off, _ = min((run_skew(False) for _ in range(2)),
+                               key=lambda r: r[0])
+    t_sk_on, p99_on, n_splits = min((run_skew(True) for _ in range(2)),
+                                    key=lambda r: r[0])
+    results.append({
+        "bench": "skew_shuffle_static",
+        "ms": round(t_sk_off * 1e3, 1),
+        "task_p99_ms": round(p99_off * 1e3, 1),
+    })
+    print(json.dumps(results[-1]), flush=True)
+    results.append({
+        "bench": "skew_shuffle_adaptive",
+        "ms": round(t_sk_on * 1e3, 1),
+        "task_p99_ms": round(p99_on * 1e3, 1),
+        "speedup_vs_static": round(t_sk_off / max(t_sk_on, 1e-9), 2),
+        "skew_splits": n_splits,
+        "hot_rows": sk_hot,
+        "cold_rows": sk_cold,
+        "per_row_cost_us": sk_per_row_s * 1e6,
+        "workers": 4,
+    })
+    print(json.dumps(results[-1]), flush=True)
+
+    # ---- partial-aggregate bail-out ---------------------------------------
+    # Worst case for the push-down: NDV ~= rows, so the pre-shuffle
+    # partial reduces nothing and pure push-down pays the partial-state
+    # machinery for zero byte savings. With the bail-out armed the
+    # coordinator probes task 0, measures the ~1.0 reduction ratio, and
+    # swaps the remaining tasks to passthrough — the arm should land
+    # within ~10% of running with push-down disabled outright, which is
+    # what lets partial_agg_pushdown default ON.
+    ab_n = 1 << 15
+    ab_t = arrow_to_table(pa.table({
+        "k": np.arange(ab_n, dtype=np.int64),
+        "v": rng.normal(size=ab_n),
+    }))
+
+    def bailout_plan(pushdown: bool):
+        scan = _MScan(_ptab(ab_t, 4), ab_t.schema())
+        ex = _Shuf(scan, ["k"], 4, round_up_pow2(max(4 * ab_n // 4, 8)))
+        agg = _HAgg("single", ["k"], [_Agg("sum", "v", "sv")], ex,
+                    num_slots=round_up_pow2(4 * ab_n))
+        # est_rows left unset: the sampled-NDV heuristic (sqrt) lies low
+        # on all-distinct keys, so the planner wrongly pushes down —
+        # exactly the misprediction the probe corrects
+        return _dplan(agg, _DCfg(num_tasks=4,
+                                 partial_agg_pushdown=pushdown))
+
+    def run_bailout(pushdown: bool, ratio: float):
+        cluster = InMemoryCluster(4)
+        coord = Coordinator(
+            resolver=cluster, channels=cluster,
+            config_options={"stage_parallelism": 4,
+                            "peer_shuffle": False,
+                            "pipelined_shuffle": False,
+                            "data_plane": "unary",
+                            "partial_agg_bailout_ratio": ratio},
+        )
+        plan = bailout_plan(pushdown)
+        coord.execute(plan)  # warm
+        t0 = time.perf_counter()
+        coord.execute(plan)
+        dt = time.perf_counter() - t0
+        bailed = any(v.get("partial_agg_bailout")
+                     for v in coord.stream_metrics.values())
+        return dt, bailed
+
+    t_ab_off, _ = run_bailout(False, 0.0)
+    t_ab_on, ab_bailed = run_bailout(True, 0.5)
+    results.append({
+        "bench": "partial_agg_bailout_pushdown_off",
+        "ms": round(t_ab_off * 1e3, 2),
+    })
+    print(json.dumps(results[-1]), flush=True)
+    results.append({
+        "bench": "partial_agg_bailout_adaptive",
+        "ms": round(t_ab_on * 1e3, 2),
+        "bailed_out": ab_bailed,
+        "overhead_vs_off": round(t_ab_on / max(t_ab_off, 1e-9) - 1, 4),
+        "ndv": ab_n,
+        "rows": ab_n,
+    })
+    print(json.dumps(results[-1]), flush=True)
+
     # ---- multi-query serving throughput -----------------------------------
     # Closed-loop serving bench (runtime/serving.py): N clients each
     # submit-and-wait over a mixed workload — cheap q6-shaped aggregates

@@ -516,6 +516,59 @@ def _variance_result(func: str, s, sq, cnt):
     return Column(out, valid, DataType.FLOAT64)
 
 
+def singleton_partial_states(table: Table, group_names, aggs) -> Table:
+    """Per-row singleton partial-aggregation states: for each input row,
+    the accumulator a partial aggregate would emit for a one-row group.
+    Schema-identical to (and mergeable by the same final stage as)
+    ``hash_aggregate(mode="partial")`` over the same input — the runtime
+    bail-out (runtime/adaptivity.py) swaps a non-reducing pushed-down
+    partial for this pure elementwise pass, which costs no hash table
+    and no claim loop. Column recipes mirror the partial-mode arms of
+    `_eval_agg` with group count == 1; padding rows past ``num_rows``
+    carry garbage like every other elementwise operator."""
+    i64 = DataType.INT64.np_dtype
+    f64 = DataType.FLOAT64.np_dtype
+    cols: dict = {}
+    for g in group_names:
+        cols[g] = table.column(g)
+    for spec in aggs:
+        name = spec.output_name
+        if spec.func == "count_star":
+            cols[name] = Column(
+                jnp.ones(table.capacity, dtype=i64), None, DataType.INT64
+            )
+            continue
+        col = table.column(spec.input_name)
+        valid = col.valid_mask()
+        one = jnp.where(valid, 1, 0).astype(i64)
+        if spec.func == "count":
+            cols[name] = Column(one, None, DataType.INT64)
+        elif spec.func == "sum":
+            acc_dtype = f64 if col.dtype.is_float else i64
+            vals = jnp.where(valid, col.data, 0).astype(acc_dtype)
+            sum_dtype = (DataType.FLOAT64 if col.dtype.is_float
+                         else DataType.INT64)
+            cols[name] = Column(vals, valid, sum_dtype)
+        elif spec.func == "avg":
+            vals = jnp.where(valid, col.data, 0).astype(f64)
+            cols[f"{name}__sum"] = Column(vals, valid, DataType.FLOAT64)
+            cols[f"{name}__count"] = Column(one, None, DataType.INT64)
+        elif spec.func in _VARIANCE_FUNCS:
+            vals = jnp.where(valid, col.data, 0).astype(f64)
+            cols[f"{name}__sum"] = Column(vals, valid, DataType.FLOAT64)
+            cols[f"{name}__sumsq"] = Column(
+                vals * vals, valid, DataType.FLOAT64
+            )
+            cols[f"{name}__count"] = Column(one, None, DataType.INT64)
+        elif spec.func in ("min", "max"):
+            cols[name] = Column(col.data, valid, col.dtype, col.dictionary)
+        else:
+            raise NotImplementedError(
+                f"no singleton partial state for {spec.func}"
+            )
+    return Table(tuple(cols.keys()), tuple(cols.values()), table.num_rows)
+
+
 def _check_int32_sum_range(vals, seg_sum, prec_flags):
     """tpu precision mode: int32 scatter-add wraps silently past 2^31, so
     estimate each group's sum in float32 alongside and flag when any group's

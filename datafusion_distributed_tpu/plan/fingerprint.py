@@ -349,6 +349,11 @@ _PLAN_ATTRS: dict = {
         n.mode, tuple(n.group_names), _canon_value(n.aggs),
         int(n.num_slots), int(n.out_capacity),
     ),
+    # bail-out form of a pushed-down partial aggregate (plan/physical.py,
+    # runtime/adaptivity.py): per-row singleton states, no table sizing
+    "PartialPassthroughExec": lambda n: (
+        tuple(n.group_names), _canon_value(n.aggs),
+    ),
     "SortExec": lambda n: (
         _canon_value(n.keys), n.fetch,
     ),

@@ -113,6 +113,20 @@ class ExecutionPlan:
     #: with_new_children rebuilds by the __init_subclass__ hook below.
     est_rows: "float | None" = None
     est_selectivity: "float | None" = None
+    #: runtime-adaptivity annotations stamped by the distributed planner's
+    #: partial-aggregate push-down pass: marks a "partial" aggregate whose
+    #: measured reduction the coordinator may probe and bail out of
+    #: (runtime/adaptivity.py). Coordinator-side only — never fingerprinted,
+    #: never serialized — but must survive the with_new_children rebuilds
+    #: the coordinator performs while resolving nested exchange scans.
+    bailout_candidate: "bool | None" = None
+    predicted_partial_rows: "int | None" = None
+
+    #: annotations the __init_subclass__ hook carries across rebuilds
+    _PRESERVED_ANNOTATIONS = (
+        "est_rows", "est_selectivity",
+        "bailout_candidate", "predicted_partial_rows",
+    )
 
     def __init__(self) -> None:
         self.node_id = next(_NODE_COUNTER)
@@ -128,7 +142,7 @@ class ExecutionPlan:
         def wrapped(self, children, _impl=impl):
             n = _impl(self, children)
             if n is not self and type(n) is type(self):
-                for a in ("est_rows", "est_selectivity"):
+                for a in self._PRESERVED_ANNOTATIONS:
                     v = getattr(self, a, None)
                     if v is not None and getattr(n, a, None) is None:
                         setattr(n, a, v)
@@ -500,6 +514,59 @@ def _agg_output_fields(a: AggSpec, child_schema: Schema, mode: str) -> list[Fiel
         return [Field(a.output_name, dt, True)]
     # min/max keep input type
     return [Field(a.output_name, src.dtype, True)]
+
+
+class PartialPassthroughExec(ExecutionPlan):
+    """Per-row partial-aggregation states — the bail-out form of a
+    pushed-down ``HashAggregateExec(mode="partial")``. Emits, for every
+    input row, the singleton accumulator a one-row group would produce
+    (ops/aggregate.py `singleton_partial_states`), under the exact
+    partial-mode schema, so the downstream final aggregate merges either
+    operator's output interchangeably. The runtime swaps this in for the
+    remaining tasks of a stage whose probed first task showed the
+    sampled-NDV prediction was wrong and the partial barely reduces
+    (runtime/adaptivity.py): pure elementwise work instead of a hash
+    table that pays without shrinking the exchange."""
+
+    def __init__(self, group_names: Sequence[str], aggs: Sequence[AggSpec],
+                 child: ExecutionPlan):
+        super().__init__()
+        self.group_names = list(group_names)
+        self.aggs = list(aggs)
+        self.child = child
+
+    def children(self):
+        return [self.child]
+
+    def with_new_children(self, children):
+        return PartialPassthroughExec(self.group_names, self.aggs,
+                                      children[0])
+
+    def schema(self):
+        child_schema = self.child.schema()
+        fields = [child_schema.field(g) for g in self.group_names]
+        for a in self.aggs:
+            fields.extend(_agg_output_fields(a, child_schema, "partial"))
+        return Schema(fields)
+
+    def output_capacity(self):
+        return self.child.output_capacity()
+
+    def _execute(self, ctx: ExecContext) -> Table:
+        from datafusion_distributed_tpu.ops.aggregate import (
+            singleton_partial_states,
+        )
+
+        return singleton_partial_states(
+            self.child.execute(ctx), self.group_names, self.aggs
+        )
+
+    def display(self):
+        aggs = ", ".join(f"{a.func}({a.input_name or '*'})" for a in self.aggs)
+        return (
+            f"PartialPassthrough gby=[{', '.join(self.group_names)}] "
+            f"aggs=[{aggs}]"
+        )
 
 
 class SortExec(ExecutionPlan):

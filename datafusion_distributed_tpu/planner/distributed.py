@@ -151,8 +151,11 @@ class DistributedConfig:
     # `predicted_exchange_bytes` on the rewritten shuffles so the
     # coordinator can record predicted-vs-measured bytes (see
     # _partial_agg_pushdown_pass; grounding: *Chasing Similarity* /
-    # *Partial Partial Aggregates*, PAPERS.md)
-    partial_agg_pushdown: bool = False
+    # *Partial Partial Aggregates*, PAPERS.md). Default ON: the runtime
+    # bail-out (runtime/adaptivity.py partial_agg_bailout_ratio) caps
+    # the cost of a wrong NDV prediction at one probed task, so the
+    # push-down no longer needs opt-in caution.
+    partial_agg_pushdown: bool = True
     # minimum predicted BYTES reduction (0..1) for the push-down to fire:
     # below it the pre-exchange aggregate is pure compute overhead (the
     # high-NDV regime where distribution-aware placement says "aggregate
@@ -819,6 +822,13 @@ def _partial_agg_pushdown_pass(plan: ExecutionPlan,
                 "partial", node.group_names, node.aggs, ex.child,
             )
             partial.est_rows = node.est_rows
+            # runtime bail-out candidacy (runtime/adaptivity.py): the
+            # coordinator probes the first task's measured reduction and
+            # swaps the partial for a passthrough when this prediction
+            # was wrong. Coordinator-side annotation only — never
+            # fingerprinted, never serialized.
+            partial.bailout_candidate = True
+            partial.predicted_partial_rows = int(pred.rows_out)
             w_raw = row_width(ex.child.schema())
             w_partial = row_width(partial.schema())
             bytes_in = rows_in * w_raw
@@ -871,6 +881,8 @@ def _partial_agg_pushdown_pass(plan: ExecutionPlan,
             node.predicted_exchange_bytes = int(
                 pred.rows_out * row_width(partial.schema())
             )
+            partial.bailout_candidate = True
+            partial.predicted_partial_rows = int(pred.rows_out)
             # stats-gated partial_reduce re-pack (the SAME rewrite the
             # partial_reduce knob applies unconditionally —
             # _repack_partial_shuffle): only when a task's slice

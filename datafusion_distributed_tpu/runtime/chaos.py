@@ -76,12 +76,30 @@ class FaultSpec:
     #: resident bytes when unset) and delegates the call: the spill/
     #: backpressure/shedding machinery must absorb it — results stay
     #: byte-identical, zero leaked slices, zero leaked spill files.
+    #: "skew" is a WORKLOAD-shaping fault, not an error: the matching
+    #: task's bulk output has ``skew_fraction`` of its ``skew_column``
+    #: values overwritten with the column's row-0 value, concentrating
+    #: a hot key so the downstream hash shuffle lands one hot partition
+    #: (the input the skew-aware splitter in runtime/adaptivity.py
+    #: corrects for). Seeded and query-scoped like every other kind —
+    #: replaying the same schedule reshapes the same tasks — but it
+    #: CHANGES DATA by design, so A/B comparisons must run BOTH arms
+    #: under the same skew schedule. Bulk execute_task only: the
+    #: streaming/partition planes pass through untouched.
     kind: str = "crash"
     rate: float = 1.0  # per-call probability (seed-hashed, deterministic)
     delay_s: float = 0.0  # for kind="delay"/"straggler": injected latency
     #: for kind="oom": the collapsed budget (None = half the worker's
     #: resident staged bytes at injection time, minimum 1)
     budget_bytes: Optional[int] = None
+    #: for kind="skew": the shuffle-key column to concentrate — None
+    #: targets the task output's FIRST column (the planner emits the
+    #: group/shuffle key first, under internal names like ``__g0`` that
+    #: a spec cannot know); a NAMED column that is absent makes the fire
+    #: a no-op. ``skew_fraction`` of the task's rows are overwritten
+    #: with the row-0 hot value.
+    skew_column: Optional[str] = None
+    skew_fraction: float = 0.8
     #: restrict to these worker urls (substring match); None = any worker
     workers: Optional[Sequence[str]] = None
     #: restrict to these stage ids; None = any stage
@@ -375,6 +393,38 @@ def _raise_for(spec: FaultSpec, site: str, url: str, key) -> None:
     )
 
 
+def _apply_skew(table, spec: FaultSpec):
+    """kind="skew": concentrate a hot key in the task's bulk output —
+    the first ``skew_fraction`` of ``skew_column``'s live rows are
+    overwritten with the column's row-0 value (and row-0 validity), on
+    COPIES of the host arrays; capacity, row count, schema, and every
+    other column are untouched. A missing/absent column or an empty
+    task degrades to a no-op rather than failing the call."""
+    import numpy as np
+
+    from datafusion_distributed_tpu.ops.table import Column, Table
+
+    name = spec.skew_column or (table.names[0] if table.names else None)
+    if not name or name not in table.names or table.num_rows <= 0:
+        return table
+    hot = int(int(table.num_rows) * min(max(spec.skew_fraction, 0.0), 1.0))
+    if hot <= 0:
+        return table
+    col = table.column(name)
+    data = np.asarray(col.data).copy()
+    data[:hot] = data[0]
+    validity = col.validity
+    if validity is not None:
+        validity = np.asarray(validity).copy()
+        validity[:hot] = validity[0]
+    cols = tuple(
+        Column(data, validity, col.dtype, col.dictionary)
+        if n == name else table.column(n)
+        for n in table.names
+    )
+    return Table(tuple(table.names), cols, table.num_rows)
+
+
 #: encoded-plan int fields that are STRUCTURAL (they enter the plan
 #: fingerprint), so perturbing one yields a plan that decodes cleanly but
 #: fingerprints differently — the exact "silently different program"
@@ -513,6 +563,12 @@ class ChaosWorker:
                 _interruptible_sleep(spec.delay_s, cancel)
             elif spec.kind == "oom":
                 self._apply_oom(spec)
+            elif spec.kind == "skew":
+                # workload-shaping, not an error: manifests on the RESULT
+                # of bulk execute_task (the caller applies _apply_skew);
+                # call-time is a no-op so stream/partition paths that
+                # share this fault site pass through untouched
+                pass
             elif spec.kind == "segment_lost":
                 # transfer-specific: ARM the client's tear-next-segment
                 # hook and delegate — the fault manifests mid-stream as
@@ -525,6 +581,7 @@ class ChaosWorker:
                     self._inner._chaos_tear_next_segment = True
             else:
                 _raise_for(spec, "execute", self.url, key)
+        return spec
 
     def execute_task(self, key, cancel=None):
         # deliberately NO timeout= parameter: advertising one would make
@@ -535,8 +592,11 @@ class ChaosWorker:
         # plumbing (per-query event, hedge loser-cancel) reaches the
         # injected delay's poll loop through it; the inner in-process
         # worker has no cancel surface, so it is consumed here.
-        self._execute_fault(key, cancel)
-        return self._inner.execute_task(key)
+        spec = self._execute_fault(key, cancel)
+        out = self._inner.execute_task(key)
+        if spec is not None and spec.kind == "skew":
+            out = _apply_skew(out, spec)
+        return out
 
     def execute_task_stream(self, key, **kw):
         # inject at CALL time, not first-iteration: the coordinator's

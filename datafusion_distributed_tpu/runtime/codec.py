@@ -42,6 +42,7 @@ from datafusion_distributed_tpu.plan.physical import (
     LimitExec,
     MemoryScanExec,
     ParquetScanExec,
+    PartialPassthroughExec,
     ProjectionExec,
     SortExec,
 )
@@ -975,6 +976,13 @@ def _encode_plan_node(p: ExecutionPlan, store: TableStore) -> dict:
             "slots": p.num_slots,
             "c": _encode_plan_node(p.child, store),
         }
+    if isinstance(p, PartialPassthroughExec):
+        return {
+            "t": "partial_passthrough",
+            "groups": p.group_names,
+            "aggs": [[a.func, a.input_name, a.output_name] for a in p.aggs],
+            "c": _encode_plan_node(p.child, store),
+        }
     if isinstance(p, SortExec):
         return {
             "t": "sort",
@@ -1131,6 +1139,12 @@ def decode_plan(o: dict, store: TableStore) -> ExecutionPlan:
             o["mode"], o["groups"],
             [AggSpec(f, i, n) for f, i, n in o["aggs"]],
             decode_plan(o["c"], store), o["slots"],
+        )
+    if t == "partial_passthrough":
+        return PartialPassthroughExec(
+            o["groups"],
+            [AggSpec(f, i, n) for f, i, n in o["aggs"]],
+            decode_plan(o["c"], store),
         )
     if t == "sort":
         return SortExec(

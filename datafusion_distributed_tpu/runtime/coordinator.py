@@ -948,7 +948,9 @@ class Coordinator:
             for c in plan.children()
         ]
         if children:
-            plan = plan.with_new_children(children)
+            plan = self._widen_bailed_out_merge(
+                plan.with_new_children(children)
+            )
         if not getattr(plan, "is_exchange", False):
             return plan
         import time as _time
@@ -996,7 +998,11 @@ class Coordinator:
             if getattr(node, "is_exchange", False):
                 return resolved[node.stage_id]
             children = [resolve(c) for c in node.children()]
-            return node.with_new_children(children) if children else node
+            if not children:
+                return node
+            return self._widen_bailed_out_merge(
+                node.with_new_children(children)
+            )
 
         waiting = {sid: set(n.deps) for sid, n in nodes.items()}
         consumers = dag.consumers_map()
@@ -1070,6 +1076,7 @@ class Coordinator:
                 s for s, deps in waiting.items() if not deps
             ):
                 enqueue(sid)
+            replan_active = False
             while futs:
                 done, _ = cf.wait(
                     list(futs), return_when=cf.FIRST_COMPLETED
@@ -1091,6 +1098,17 @@ class Coordinator:
                     if t1 is not None:  # pipelined spans record at feed
                         self._record_stage_span(query_id, sid, sub_s, t0,
                                                 t1)
+                    # closed-loop re-cost: a stage whose measured output
+                    # cardinality diverged far from its estimate rescales
+                    # the not-yet-submitted downstream frontier, so the
+                    # backlog promotion below dispatches cheapest-first
+                    # on CORRECTED bytes (scheduling only — plan
+                    # structure and results are untouched)
+                    if self._maybe_replan(
+                        query_id, sid, nodes, scan,
+                        set(futs.values()) | set(resolved),
+                    ):
+                        replan_active = True
                     for c in sorted(consumers.get(sid, ())):
                         waiting[c].discard(sid)
                         if not waiting[c] and first_error is None and (
@@ -1098,9 +1116,15 @@ class Coordinator:
                         ):
                             enqueue(c)
                 # freed budget: promote backlogged ready stages (in
-                # deterministic stage-id order)
+                # deterministic stage-id order; after a replan, in
+                # deterministic corrected-cost order)
                 if backlog and first_error is None and not self._cancelled():
-                    backlog.sort()
+                    if replan_active:
+                        backlog.sort(
+                            key=lambda s: (int(nodes[s].est_bytes or 0), s)
+                        )
+                    else:
+                        backlog.sort()
                     while backlog and len(futs) < parallelism:
                         submit(backlog.pop(0))
         finally:
@@ -1112,6 +1136,12 @@ class Coordinator:
             # only cancellations surfaced: something upstream (another
             # thread sharing this coordinator) set the event — propagate
             raise first_cancel
+        # a cancel can land in the window where every in-flight job
+        # completes cleanly: downstream stages are then silently skipped
+        # (the enqueue gate), futs drains, and neither error slot is set —
+        # resolving the partial frontier would KeyError on a stage that
+        # never ran. Surface the cancel like a job would have.
+        self._check_cancelled()
         return resolve(plan)
 
     def _record_stage_span(self, query_id: str, stage_id: int,
@@ -1256,6 +1286,12 @@ class Coordinator:
             return
         if type(scan) is not MemoryScanExec:
             return
+        if getattr(scan, "bailout_raw_rows", False):
+            # a bailed-out boundary carries raw rows at a widened
+            # capacity; a restore could not re-derive the consumer-side
+            # merge widening (the annotation dies with the scan), so
+            # this stage re-executes instead of restoring
+            return
         staged = ck.save(stage_id, list(scan.tasks), scan.replicated,
                          scan.pinned, t_prod)
         if staged is not None:
@@ -1318,16 +1354,48 @@ class Coordinator:
             self._seed_consumer_scan(plan, scan)
             return scan
         else:
+            if isinstance(plan, ShuffleExchangeExec) and not isinstance(
+                plan, RangeShuffleExchangeExec
+            ):
+                # skew-aware split (runtime/adaptivity.py): a hot
+                # producer slice — typically a hot hash partition left
+                # by the upstream shuffle — fans out over contiguous
+                # row-range views before the tasks dispatch. Plain hash
+                # shuffles only: their regroup is producer-major with
+                # stable within-producer order, so contiguous sub-views
+                # reproduce the exact row order of the unsplit task.
+                producer, t_prod = self._adapt_split_skew(
+                    producer, query_id, stage_id, t_prod
+                )
             outputs = self._run_stage_tasks(
                 producer, query_id, stage_id, t_prod
             )
         if isinstance(plan, ShuffleExchangeExec) and not isinstance(
             plan, RangeShuffleExchangeExec
         ):
+            from datafusion_distributed_tpu.ops.table import round_up_pow2
             from datafusion_distributed_tpu.planner.statistics import (
                 row_width,
             )
 
+            sm = self.stream_metrics.get((query_id, stage_id)) or {}
+            if sm.get("partial_agg_bailout"):
+                # a bail-out invalidates the planner's capacity
+                # arithmetic too: the push-down pass sized this
+                # exchange's padded per-destination capacity from the
+                # partial's slot count, but after the swap RAW rows
+                # cross the boundary. Padded capacities are shapes, not
+                # hints — regrouping at the stale capacity is a hard
+                # concat overflow — so widen to the worst-case
+                # per-destination share (every row on one destination)
+                # before the regroup.
+                total = sum(int(o.num_rows) for o in outputs)
+                need = round_up_pow2(
+                    -(-total // max(len(outputs), 1))
+                )
+                if need > int(plan.per_dest_capacity):
+                    plan.per_dest_capacity = need
+                    sm["bailout_capacity_widened"] = need
             # bulk plane: the exchange moved the producers' LIVE rows
             # through the coordinator (padded capacities are device
             # buffers, not wire bytes here)
@@ -1336,11 +1404,19 @@ class Coordinator:
                 sum(int(o.num_rows) for o in outputs)
                 * row_width(producer.schema()),
                 "unary" if self._data_plane() == "unary" else "bulk",
+                rows=sum(int(o.num_rows) for o in outputs),
             )
             # consumer-count decision + regroup are overridable together:
             # the adaptive coordinator defers co-shuffled siblings so a
             # join stage's feeds agree on ONE adapted count
             scan = self._finish_shuffle(plan, outputs, producer)
+            if sm.get("partial_agg_bailout"):
+                # flag the consumer-side scan: RAW rows live in these
+                # slices, so the merge aggregate above must re-derive
+                # its table size from the slice capacity instead of the
+                # stale partial-rows prediction (_widen_bailed_out_merge
+                # picks this up when the consumer tree resolves)
+                scan.bailout_raw_rows = True
             self._seed_consumer_scan(plan, scan)
             return scan
         t = self._consumer_task_count(plan, outputs)
@@ -2010,7 +2086,7 @@ class Coordinator:
 
     def _record_exchange_bytes(self, exchange, query_id: str,
                                stage_id: int, measured: int,
-                               plane: str) -> None:
+                               plane: str, rows: Optional[int] = None) -> None:
         """Predicted-vs-measured exchange accounting (the partial-agg
         push-down feedback loop): the planner pass stamps
         `predicted_exchange_bytes` on shuffles it rewrote from sampled
@@ -2024,6 +2100,11 @@ class Coordinator:
             (query_id, stage_id), {"plane": plane}
         )
         sm["exchange_bytes"] = int(measured)
+        if rows is not None:
+            # bulk-plane measured output rows (the streaming planes
+            # record theirs from StreamStats) — what the mid-query
+            # replan compares against StageDagNode.est_rows
+            sm["rows"] = int(rows)
         if predicted is not None:
             sm["predicted_exchange_bytes"] = int(predicted)
         try:
@@ -2135,6 +2216,338 @@ class Coordinator:
             zero_copy=self._zero_copy(),
         )
         return MemoryScanExec(slices, producer.schema())
+
+    # -- closed-loop runtime adaptivity --------------------------------------
+    def _adaptivity(self):
+        """Runtime-adaptivity knobs (runtime/adaptivity.py), re-parsed
+        per decision so `SET skew_split_factor` etc. between queries
+        take effect without rebuilding the coordinator. None of them is
+        trace-relevant: toggling recompiles nothing."""
+        from datafusion_distributed_tpu.runtime.adaptivity import (
+            AdaptivitySettings,
+        )
+
+        return AdaptivitySettings.from_options(self.config_options)
+
+    def _adapt_split_skew(self, producer, query_id: str, stage_id: int,
+                          task_count: int):
+        """Skew-aware repartitioning on the bulk shuffle plane: when one
+        producer task's input slice carries `skew_split_factor` x the
+        median rows (the signature of a hot hash partition produced by
+        the upstream exchange — the same histogram PartitionFeed records
+        on the streaming plane), split that task into contiguous
+        row-range views (`ops.table.slice_view` over one `host_view`
+        rebind, the PR 8 zero-copy primitives) so idle workers share the
+        hot rows. Returns the (possibly rewritten) producer and task
+        count.
+
+        Byte-identity argument: `_shuffle_regroup` walks producers in
+        list order with a STABLE within-producer order, so replacing
+        task j by sub-views whose concatenation is exactly task j's row
+        order reproduces identical per-destination rows in identical
+        order — only task boundaries (and padding capacities) change.
+        Eligibility is conservative: exactly one un-pinned partitioned
+        MemoryScan (every other leaf replicated), reached from the stage
+        root through row-order-preserving nodes only (filter/projection/
+        coalesce/sampler, or a hash join via its PROBE child — emission
+        is probe-major)."""
+        settings = self._adaptivity()
+        if not settings.skew_enabled or task_count < 2:
+            return producer, task_count
+        from datafusion_distributed_tpu.ops.table import (
+            host_view,
+            slice_view,
+        )
+        from datafusion_distributed_tpu.runtime.adaptivity import (
+            detect_skew,
+            note_skew_split,
+            split_ranges,
+        )
+
+        leaves = producer.collect(lambda n: not n.children())
+        scans = [n for n in leaves if isinstance(n, MemoryScanExec)]
+        if len(scans) != len(leaves):
+            return producer, task_count  # stream/peer/parquet leaves
+        candidates = [
+            s for s in scans if not s.pinned and not s.replicated
+        ]
+        if len(candidates) != 1:
+            return producer, task_count
+        scan = candidates[0]
+        if len(scan.tasks) != task_count:
+            return producer, task_count
+        if producer.collect(lambda n: isinstance(n, IsolatedArmExec)):
+            return producer, task_count
+        if not self._skew_splittable(producer, scan):
+            return producer, task_count
+        counts = [int(t.num_rows) for t in scan.tasks]
+        rep = detect_skew(counts, settings.skew_split_factor,
+                          settings.skew_split_min_rows)
+        if rep is None:
+            return producer, task_count
+        k = min(
+            -(-rep.rows // max(int(rep.median), 1)),
+            max(self._live_worker_count(), 2),
+            8,  # fan-out ceiling: dispatch overhead grows per sub-task
+            rep.rows,
+        )
+        if k < 2:
+            return producer, task_count
+        host = host_view(scan.tasks[rep.partition])
+        subs = [
+            slice_view(host, lo, cnt)
+            for lo, cnt in split_ranges(rep.rows, k)
+        ]
+        new_tasks = (
+            list(scan.tasks[:rep.partition]) + subs
+            + list(scan.tasks[rep.partition + 1:])
+        )
+        new_scan = MemoryScanExec(new_tasks, scan._schema)
+
+        def swap(node):
+            if node is scan:
+                return new_scan
+            children = [swap(c) for c in node.children()]
+            return node.with_new_children(children) if children else node
+
+        note_skew_split(query_id, stage_id, rep.partition, rep.rows, k,
+                        rep.median)
+        sm = self.stream_metrics.setdefault(
+            (query_id, stage_id), {"plane": "bulk"}
+        )
+        sm["skew_splits"] = sm.get("skew_splits", 0) + 1
+        sm["skew_partition_rows"] = rep.rows
+        return swap(producer), task_count + k - 1
+
+    def _skew_splittable(self, producer, scan) -> bool:
+        """Whether the path from the stage root to `scan` preserves
+        per-row order under a contiguous split of the scan's task axis:
+        only row-wise nodes, and hash joins entered via the probe child
+        (their emission is probe-major; the build side must then hang
+        off replicated scans, which the candidate filter guarantees)."""
+        from datafusion_distributed_tpu.plan.joins import HashJoinExec
+        from datafusion_distributed_tpu.plan.physical import (
+            CoalescePartitionsExec,
+            FilterExec,
+            ProjectionExec,
+        )
+        from datafusion_distributed_tpu.planner.adaptive import SamplerExec
+
+        def path_ok(node) -> bool:
+            if node is scan:
+                return True
+            if isinstance(node, (FilterExec, ProjectionExec,
+                                 CoalescePartitionsExec, SamplerExec)):
+                return path_ok(node.children()[0])
+            if isinstance(node, HashJoinExec):
+                return path_ok(node.probe)
+            return False
+
+        return path_ok(producer)
+
+    def _bailout_probe(self, producer, query_id: str, stage_id: int,
+                       task_count: int):
+        """When the stage carries a pushed-down partial aggregate the
+        planner stamped as a bail-out candidate
+        (planner/distributed.py `_partial_agg_pushdown_pass`), return a
+        closure that judges task 0's measured reduction ratio and — when
+        it exceeds `partial_agg_bailout_ratio`, i.e. the sampled-NDV
+        prediction was wrong and the partial barely reduced — returns a
+        producer with the partial swapped for `PartialPassthroughExec`
+        for the remaining tasks (grounding: *Partial Partial
+        Aggregates*). None when the stage has no candidate or its input
+        rows are not measurable host-side.
+
+        Input rows come from the partitioned scans' task-0 slices, so
+        the probe only engages when every node under the partial is
+        row-wise (a filter UNDERCOUNTS the true ratio — conservative:
+        it can only make the bail-out rarer, never spurious)."""
+        settings = self._adaptivity()
+        if not settings.bailout_enabled or task_count < 2:
+            return None
+        from datafusion_distributed_tpu.plan.physical import (
+            CoalescePartitionsExec,
+            FilterExec,
+            HashAggregateExec,
+            PartialPassthroughExec,
+            ProjectionExec,
+        )
+        from datafusion_distributed_tpu.planner.adaptive import SamplerExec
+        from datafusion_distributed_tpu.runtime.adaptivity import (
+            note_partial_agg_bailout,
+        )
+
+        partials = producer.collect(
+            lambda n: isinstance(n, HashAggregateExec)
+            and n.mode == "partial"
+            and getattr(n, "bailout_candidate", False)
+        )
+        if len(partials) != 1:
+            return None
+        partial = partials[0]
+        allowed = (FilterExec, ProjectionExec, CoalescePartitionsExec,
+                   SamplerExec, MemoryScanExec)
+        subtree = partial.child.collect(lambda n: True)
+        if any(not isinstance(n, allowed) for n in subtree):
+            return None  # joins/unions below: scan rows ≠ agg input rows
+        scans = [
+            n for n in subtree
+            if isinstance(n, MemoryScanExec)
+            and not n.pinned and not n.replicated and n.tasks
+        ]
+        rows_in = sum(int(s.tasks[0].num_rows) for s in scans)
+        if rows_in <= 0:
+            return None
+
+        def judge(out0: Table):
+            rows_out = int(out0.num_rows)
+            ratio = rows_out / rows_in
+            if ratio < settings.partial_agg_bailout_ratio:
+                return None
+            passthrough = PartialPassthroughExec(
+                partial.group_names, partial.aggs, partial.child
+            )
+
+            def swap(node):
+                if node is partial:
+                    return passthrough
+                children = [swap(c) for c in node.children()]
+                return (node.with_new_children(children)
+                        if children else node)
+
+            note_partial_agg_bailout(
+                query_id, stage_id, rows_in, rows_out, ratio,
+                getattr(partial, "predicted_partial_rows", 0),
+            )
+            sm = self.stream_metrics.setdefault(
+                (query_id, stage_id), {"plane": "bulk"}
+            )
+            sm["partial_agg_bailout"] = True
+            sm["partial_agg_ratio"] = round(ratio, 4)
+            return swap(producer)
+
+        return judge
+
+    @staticmethod
+    def _widen_bailed_out_merge(node):
+        """Consumer-side half of the bail-out: after the swap, RAW rows
+        crossed the exchange, so the planner's consumer merge table —
+        sized from the same predicted partial rows that the probe just
+        disproved — is stale exactly like the exchange capacity was.
+        When an aggregate sits directly on a bailed-out boundary's scan
+        (the push-down pass builds `final(shuffle(partial))`, so the
+        scan IS its direct child once the exchange resolves), rebuild
+        it with the constructor's input-bound default (2x the slice
+        capacity: load factor <= 0.5 even with every row distinct),
+        never below the planner's own sizing. Deterministic — the same
+        bail-out decision always yields the same widened shape."""
+        from datafusion_distributed_tpu.plan.physical import (
+            HashAggregateExec,
+        )
+
+        if not isinstance(node, HashAggregateExec):
+            return node
+        if not any(getattr(c, "bailout_raw_rows", False)
+                   for c in node.children()):
+            return node
+        rebuilt = HashAggregateExec(node.mode, node.group_names,
+                                    node.aggs, node.children()[0])
+        if rebuilt.num_slots <= int(node.num_slots):
+            return node
+        for attr in node._PRESERVED_ANNOTATIONS:
+            setattr(rebuilt, attr, getattr(node, attr, None))
+        return rebuilt
+
+    def _maybe_replan(self, query_id: str, stage_id: int, nodes, scan,
+                      submitted) -> bool:
+        """Mid-query re-cost: when stage `stage_id`'s measured output
+        cardinality diverges from its `StageDagNode.est_rows` by
+        `replan_cardinality_factor`, scale the estimates of every
+        transitively-dependent NOT-YET-SUBMITTED stage by the measured
+        ratio — the backlog promotion then dispatches the unstarted
+        frontier cheapest-first on corrected bytes, and the serving
+        tier's fair-share pool sees corrected cost hints (submit reads
+        `node.est_bytes` at submit time). Scheduling only: stage plans
+        are byte-for-byte untouched, and every affected exchange is
+        re-run through the static verifier (memoized, so structure
+        unchanged == known clean) before it can dispatch."""
+        settings = self._adaptivity()
+        if not settings.replan_enabled:
+            return False
+        node = nodes.get(stage_id)
+        if node is None:
+            return False
+        est = int(getattr(node, "est_rows", 0) or 0)
+        if est <= 0:
+            return False
+        # measured output rows: every plane that moves rows through the
+        # coordinator records them in stream_metrics (bulk:
+        # _record_exchange_bytes; streaming coalesce + pipelined drain:
+        # stats.rows). A materialized MemoryScan is the fallback. The
+        # peer plane is unmeasurable by design — its rows never cross
+        # the coordinator — so those stages simply never trigger.
+        sm0 = self.stream_metrics.get((query_id, stage_id), {})
+        measured = sm0.get("rows")
+        if measured is None and isinstance(scan, MemoryScanExec):
+            if getattr(scan, "replicated", False):
+                measured = int(scan.tasks[0].num_rows) if scan.tasks else 0
+            else:
+                measured = sum(int(t.num_rows) for t in scan.tasks)
+        if not measured or int(measured) <= 0:
+            return False
+        measured = int(measured)
+        if max(measured / est, est / measured) < (
+            settings.replan_cardinality_factor
+        ):
+            return False
+        affected = self._downstream_unsubmitted(stage_id, nodes,
+                                                submitted)
+        if not affected:
+            return False
+        from datafusion_distributed_tpu.plan.verify import (
+            enforce_verification,
+        )
+        from datafusion_distributed_tpu.runtime.adaptivity import (
+            note_replan,
+        )
+
+        try:
+            for sid2 in affected:
+                enforce_verification(
+                    nodes[sid2].exchange, options=self.config_options,
+                    context=f"replan stage {sid2}",
+                )
+        except Exception:
+            return False  # never fail or degrade a query over re-costing
+        ratio = measured / est
+        for sid2 in affected:
+            n2 = nodes[sid2]
+            n2.est_rows = max(int(n2.est_rows * ratio), 1)
+            n2.est_bytes = max(int(n2.est_bytes * ratio), 1)
+        note_replan(query_id, stage_id, measured, est, len(affected))
+        sm = self.stream_metrics.setdefault(
+            (query_id, stage_id), {"plane": "bulk"}
+        )
+        sm["replanned_stages"] = len(affected)
+        return True
+
+    @staticmethod
+    def _downstream_unsubmitted(stage_id: int, nodes, submitted) -> list:
+        """Transitive consumers of `stage_id` that have not been
+        submitted (not resolved, not in flight — i.e. still waiting on
+        deps or parked in the ready backlog), in stage-id order."""
+        rev: dict = {}
+        for sid, n in nodes.items():
+            for d in n.deps:
+                rev.setdefault(d, []).append(sid)
+        seen: set = set()
+        stack = [stage_id]
+        while stack:
+            for c in rev.get(stack.pop(), ()):
+                if c not in seen:
+                    seen.add(c)
+                    stack.append(c)
+        return sorted(s for s in seen if s not in submitted)
 
     # -- streaming data plane -----------------------------------------------
     def _stream_stage_coalesced(
@@ -2273,6 +2686,22 @@ class Coordinator:
         # REMAINING tasks to the concurrent fan-out instead of serializing
         # the whole stage on the stale snapshot taken at stage start
         pending = list(range(task_count))
+        probe = self._bailout_probe(producer, query_id, stage_id,
+                                    task_count)
+        if probe is not None:
+            # self-correcting partial aggregation: run task 0 FIRST (one
+            # task of lookahead), measure the partial's actual reduction,
+            # and swap the remaining tasks to the per-row passthrough
+            # when the sampled-NDV prediction was wrong. Deterministic by
+            # construction — the decision depends only on task 0's
+            # measured rows, and exactly tasks 1..n-1 swap — so repeated
+            # runs stay byte-identical.
+            i = pending.pop(0)
+            account(i, self._run_stage_task(producer, query_id, stage_id,
+                                            i, task_count))
+            swapped = probe(outs[i])
+            if swapped is not None:
+                producer = swapped
         while pending and (
             task_count == 1 or self._live_worker_count() == 1
         ):

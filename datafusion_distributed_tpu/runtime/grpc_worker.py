@@ -89,6 +89,30 @@ def _key_from_obj(o) -> TaskKey:
 
 def _handlers(worker: Worker):
     import grpc
+    import threading as _threading
+
+    # segments published for a task's transfer streams whose tokens the
+    # client may never release (it tears the stream with S-frames still
+    # buffered): reclaimed when the client's `_release_incomplete` sends
+    # Invalidate for the task, and bounded by an oldest-first sweep for
+    # cleanly drained streams that never invalidate. Token release is
+    # idempotent, so reclaiming a segment the client DID consume is a
+    # no-op.
+    task_shm_tokens: dict = {}
+    task_shm_lock = _threading.Lock()
+
+    def _reclaim_task_segments(key) -> None:
+        with task_shm_lock:
+            tokens = task_shm_tokens.pop(key, [])
+            while len(task_shm_tokens) > 256:
+                tokens.extend(
+                    task_shm_tokens.pop(next(iter(task_shm_tokens)))
+                )
+        for name, token in tokens:
+            try:
+                worker.segment_pool.release(name, token)
+            except Exception:
+                pass  # reclaim must never mask the caller's own path
 
     def set_plan(request: bytes, context) -> bytes:
         header, blobs = transport.unpack_frame(request)
@@ -277,6 +301,8 @@ def _handlers(worker: Worker):
         ]
         pool = worker.segment_pool
         serve_shm = SegmentPool.same_host(msg.get("shm"))
+        shm_tokens: list = []
+        drained = False
         try:
             for p, piece, est in worker.execute_task_partitions(
                 key, parts["keys"], int(parts["num"]),
@@ -297,6 +323,11 @@ def _handlers(worker: Worker):
                         # REST of the stream to the wire path
                         serve_shm = False
                     else:
+                        shm_tokens.append((name, token))
+                        with task_shm_lock:
+                            task_shm_tokens.setdefault(key, []).append(
+                                (name, token)
+                            )
                         yield b"S" + json.dumps({
                             "part": p, "seg": name, "token": token,
                             "dir": pool.descriptor()["dir"],
@@ -320,6 +351,7 @@ def _handlers(worker: Worker):
             yield b"H" + json.dumps(
                 {"progress": worker.task_progress(key)}
             ).encode()
+            drained = True
         except WorkerError as e:
             yield b"E" + json.dumps(e.to_dict()).encode()
         except Exception as e:
@@ -327,6 +359,20 @@ def _handlers(worker: Worker):
                 wrap_worker_exception(e, worker.url, key).to_dict()
             ).encode()
         finally:
+            if not drained:
+                # the producer side never finished: S-frames the client
+                # will never open still hold their publish token —
+                # reclaim this stream's own publishes (idempotent per
+                # token, so segments the client DID consume-and-release
+                # are untouched). A stream that drained server-side can
+                # STILL be torn by the client with S-frames buffered;
+                # that path is reclaimed by the client's Invalidate (its
+                # `_release_incomplete`) via `_reclaim_task_segments`.
+                for name, token in shm_tokens:
+                    try:
+                        pool.release(name, token)
+                    except Exception:
+                        pass
             if worker.partitions_remaining(key) in (None, 0):
                 worker.table_store.remove(msg.get("table_ids", []))
 
@@ -348,7 +394,9 @@ def _handlers(worker: Worker):
         # query-end release (the coordinator's EOS sweep for peer-plane
         # producer tasks that were never, or only partially, pulled)
         msg = json.loads(request.decode())
-        worker.release_task(_key_from_obj(msg["key"]))
+        key = _key_from_obj(msg["key"])
+        worker.release_task(key)
+        _reclaim_task_segments(key)
         return json.dumps({"ok": True}).encode()
 
     unary = {

@@ -408,9 +408,16 @@ class PartitionFeed:
         self._end_s: Optional[float] = None  # guarded-by: _cv
         self._on_complete: list = []  # guarded-by: _cv
         self.stats: Optional[StreamStats] = None  # guarded-by: _cv
+        #: per-partition rows/bytes landed so far — the live skew
+        #: histogram the runtime-adaptivity layer reads to spot a hot
+        #: destination while (and after) the shuffle streams
+        #: (runtime/adaptivity.py detect_skew)
+        self.partition_rows = [0] * self.num_partitions  # guarded-by: _cv
+        self.partition_bytes = [0] * self.num_partitions  # guarded-by: _cv
 
     # -- producer side (driven by stream_partition_chunks) -------------------
-    def add(self, producer: int, partition: int, chunk: Table) -> None:
+    def add(self, producer: int, partition: int, chunk: Table,
+            nbytes: int = 0) -> None:
         with self._cv:
             self._chunks[partition].append(
                 (producer, self._seq[producer], chunk)
@@ -419,6 +426,8 @@ class PartitionFeed:
             self._frontier[producer] = max(
                 self._frontier[producer], partition
             )
+            self.partition_rows[partition] += int(chunk.num_rows)
+            self.partition_bytes[partition] += int(nbytes)
             self._first = True
             self._cv.notify_all()
 
@@ -522,6 +531,12 @@ class PartitionFeed:
             self._wait_locked(lambda: self._complete, cancelled)
             return self.stats
 
+    def partition_histogram(self) -> tuple[list, list]:
+        """Point-in-time copy of the per-partition (rows, bytes) landed
+        so far — complete once the feed finished."""
+        with self._cv:
+            return list(self.partition_rows), list(self.partition_bytes)
+
     @property
     def error(self) -> Optional[BaseException]:
         with self._cv:
@@ -623,7 +638,7 @@ def stream_partition_chunks(
         if cancel.is_set():
             continue  # late chunk after cancellation: drop
         p, chunk = payload
-        feed.add(i, p, chunk)
+        feed.add(i, p, chunk, nbytes=nbytes)
         if on_chunk is not None:
             try:
                 on_chunk(chunk)

@@ -340,6 +340,40 @@ class SessionConfig:
                     raise ValueError(
                         "tracing_sample_rate must be in [0, 1]"
                     )
+            elif key == "skew_split_factor":
+                # runtime-adaptivity knobs (runtime/adaptivity.py):
+                # validated at SET time like the serving knobs, and
+                # deliberately NOT trace-relevant — flipping any of them
+                # recompiles nothing (pinned in test_recompile_budget.py)
+                value = float(value)
+                if value != 0 and value < 1.0:
+                    raise ValueError(
+                        "skew_split_factor must be 0 (splitting off) or "
+                        ">= 1.0 (a hot partition is one ABOVE the "
+                        "median)"
+                    )
+            elif key == "skew_split_min_rows":
+                value = int(value)
+                if value < 0:
+                    raise ValueError(
+                        "skew_split_min_rows must be >= 0"
+                    )
+            elif key == "partial_agg_bailout_ratio":
+                value = float(value)
+                if not 0.0 <= value <= 1.0:
+                    raise ValueError(
+                        "partial_agg_bailout_ratio must be in [0, 1] "
+                        "(0 disables the bail-out; 1 bails only when "
+                        "the partial reduces nothing)"
+                    )
+            elif key == "replan_cardinality_factor":
+                value = float(value)
+                if value != 0 and value < 1.0:
+                    raise ValueError(
+                        "replan_cardinality_factor must be 0 (replan "
+                        "off) or >= 1.0 (measured/estimated divergence "
+                        "factor)"
+                    )
             self.distributed_options[key] = value
         elif scope == "planner":
             if not hasattr(self.planner, key):

@@ -210,6 +210,22 @@ if ! env DFTPU_LOCK_CHECK=1 python -m pytest tests/test_pipelined_shuffle.py \
         -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
     FAILED+=("tests/test_pipelined_shuffle.py[gate+lockcheck]")
 fi
+# Runtime-adaptivity gate (tests/test_adaptivity.py): the closed-loop
+# decision points (runtime/adaptivity.py) — skew-aware shuffle splitting
+# under a seeded chaos kind="skew" schedule, the partial-aggregate
+# bail-out probe (high-NDV mispredictions swap to PartialPassthroughExec
+# within 10% of pushdown-off), and mid-query re-costing of unsubmitted
+# stages — with TPC-H q3/q5/q18 byte-identical between every
+# adaptation path forced ON and OFF under chaos + membership churn,
+# replanned stages re-verified clean, and zero leaked slices. Runs
+# under DFTPU_LOCK_CHECK=1: the probe/replan hooks sit inside the
+# stage-DAG scheduler's cross-thread schedules.
+echo "=== tests/test_adaptivity.py (runtime-adaptivity gate, DFTPU_LOCK_CHECK=1)"
+if ! env DFTPU_LOCK_CHECK=1 python -m pytest tests/test_adaptivity.py \
+        -q --no-header \
+        -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
+    FAILED+=("tests/test_adaptivity.py[gate+lockcheck]")
+fi
 # Shm + streaming-transfer data-plane gate (tests/test_shm_plane.py):
 # the cross-process planes — segment refcount lifecycle (last release
 # unlinks, zero leaked segments), spill-file -> segment hardlink
@@ -239,6 +255,7 @@ for f in tests/test_*.py; do
     [ "$f" = "tests/test_elasticity.py" ] && continue  # ran above (gate)
     [ "$f" = "tests/test_data_plane.py" ] && continue  # ran above (gate)
     [ "$f" = "tests/test_shm_plane.py" ] && continue  # ran above (gate)
+    [ "$f" = "tests/test_adaptivity.py" ] && continue  # ran above (gate)
     echo "=== $f"
     if ! python -m pytest "$f" -q --no-header -p no:cacheprovider \
             "${MARKER_ARGS[@]}" "$@"; then

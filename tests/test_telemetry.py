@@ -524,6 +524,21 @@ def test_serving_slo_and_registry(serving_ctx):
             assert "dftpu_queries_preempted_total 0" in (
                 obs.render_openmetrics()
             )
+            # golden names for the runtime-adaptivity counters — the
+            # closed-loop decision points count fires process-wide in
+            # DEFAULT_REGISTRY (registered eagerly at adaptivity
+            # import, so the families exist at 0 before any fire)
+            import datafusion_distributed_tpu.runtime.adaptivity  # noqa: F401
+            from datafusion_distributed_tpu.runtime.telemetry import (
+                DEFAULT_REGISTRY, render_openmetrics,
+            )
+
+            snap_default = DEFAULT_REGISTRY.snapshot()
+            exposed = render_openmetrics(snap_default)
+            for fam in ("dftpu_skew_splits", "dftpu_partial_agg_bailouts",
+                        "dftpu_replans"):
+                assert fam in snap_default, fam
+                assert f"{fam}_total" in exposed, fam
         finally:
             srv.close()
     finally:

@@ -97,6 +97,14 @@ _MICRO_DIRECTIONS = {
     "peak_staged_mb": False,
     "ratio": False,
     "speedup_vs_copy": True,
+    # runtime-adaptivity axes (skew_shuffle_* / partial_agg_bailout_*):
+    # per-task tail + the static/adaptive wall ratio. Adaptation COUNTS
+    # (skew_splits, bailed_out, replan totals in BENCH_DETAIL meta) stay
+    # unlisted on purpose — they are informational context, and "fired
+    # more often" is neither a regression nor an improvement by itself.
+    "task_p99_ms": False,
+    "speedup_vs_static": True,
+    "overhead_vs_off": False,
 }
 
 
