@@ -100,9 +100,9 @@ def _qdir(suite: str) -> str:
     the in-repo set (`benchmarks/queries/<suite>/`) — containers without
     /root/reference previously skipped EVERY query ("no such file"),
     leaving the bench trajectory empty and tools/bench_compare.py with
-    no seed to diff against. Only the tpch set ships in-repo today;
-    tpcds/clickbench still need the reference checkout (their queries
-    would land with the TPC-DS/ClickBench-parity roadmap item)."""
+    no seed to diff against. The tpch and clickbench sets ship in-repo
+    (the latter dialect-adapted to the synthetic `hits` schema);
+    tpcds still needs the reference checkout."""
     ref = f"/root/reference/testdata/{suite}/queries"
     local = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "benchmarks", "queries", suite)
@@ -183,10 +183,12 @@ def _adaptivity_counter_totals():
         return tuple(
             sum(v for _labels, v in (snap.get(fam) or {}).get("samples", []))
             for fam in ("dftpu_skew_splits", "dftpu_partial_agg_bailouts",
-                        "dftpu_replans")
+                        "dftpu_replans", "dftpu_joins_fused",
+                        "dftpu_exchanges_deleted",
+                        "dftpu_global_agg_selected")
         )
     except Exception:
-        return (0.0, 0.0, 0.0)
+        return (0.0, 0.0, 0.0, 0.0, 0.0, 0.0)
 
 
 def _emit(fh, **kw):
@@ -419,7 +421,9 @@ def _child_main() -> None:
             # stays as small as before.
             adapt1 = _adaptivity_counter_totals()
             for key, b0, b1 in zip(
-                ("adapt_skew_splits", "adapt_bailouts", "adapt_replans"),
+                ("adapt_skew_splits", "adapt_bailouts", "adapt_replans",
+                 "joins_fused", "exchanges_deleted",
+                 "global_agg_selected"),
                 adapt0, adapt1,
             ):
                 if b1 > b0:
@@ -931,6 +935,12 @@ def _spawn_child(remaining_queries, deadline, platform):
 
 
 def main() -> None:
+    if "--suite" in sys.argv:
+        # CLI alias for BENCH_SUITE (tpch | tpcds | clickbench); the env
+        # var still wins inside the re-exec'd child, so set it here
+        i = sys.argv.index("--suite")
+        if i + 1 < len(sys.argv):
+            os.environ["BENCH_SUITE"] = sys.argv[i + 1].lower()
     if "--serving" in sys.argv:
         _serving_bench()
         return
@@ -1125,7 +1135,9 @@ def main() -> None:
                     ("runs", "warm_s", "bytes_in", "gbps",
                      "pct_hbm_roofline", "wire_bytes",
                      "wire_bytes_saved", "adapt_skew_splits",
-                     "adapt_bailouts", "adapt_replans")
+                     "adapt_bailouts", "adapt_replans",
+                     "joins_fused", "exchanges_deleted",
+                     "global_agg_selected")
                     if k in ev}
                 print(f"  [{plat}] {ev['q']}: {ev['secs']}s "
                       f"({ev.get('gbps', '?')} GB/s, "

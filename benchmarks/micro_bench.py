@@ -1001,6 +1001,121 @@ def main() -> None:
         })
     print(json.dumps(results[-1]), flush=True)
 
+    # ---- multiway join fusion ---------------------------------------------
+    # Three co-shuffled joins on ONE shared key (the q21 shape): every
+    # probe re-shuffle between them re-hashes the same column, so the
+    # fusion pass (SET distributed.multiway_join) deletes the two
+    # interior identity exchanges and runs one fused stage. Fused vs
+    # binary-chain wall + measured exchange bytes (stream_metrics sums)
+    # on the same 4-worker cluster; results are byte-identical by
+    # construction (tests/test_multiway_join.py pins that). The data
+    # plane is pinned to the coordinator bulk path ("unary") because
+    # only that plane records exchange_bytes — peer/stream bytes never
+    # cross the coordinator and would read as zero on both arms.
+    mw_n = 1 << 15
+    mw_nd = 1 << 12
+    mw_rng = np.random.default_rng(11)
+    mw_ctx = SessionContext()
+    mw_ctx.config.distributed_options["bytes_per_task"] = 1
+    mw_ctx.config.distributed_options["broadcast_joins"] = False
+    mw_ctx.register_arrow("fact", pa.table({
+        "k": mw_rng.integers(0, mw_nd, mw_n), "v": mw_rng.integers(0, 100, mw_n),
+    }))
+    for i in (1, 2, 3):
+        mw_ctx.register_arrow(f"dim{i}", pa.table({
+            "k": np.arange(mw_nd), f"a{i}": mw_rng.integers(0, 100, mw_nd),
+        }))
+    mw_sql = """
+    select count(*) as c, sum(a1 + a2 + a3) as s
+    from fact
+    join dim1 on fact.k = dim1.k
+    join dim2 on fact.k = dim2.k
+    join dim3 on fact.k = dim3.k
+    """
+
+    mw_opts = {"bytes_per_task": 1, "data_plane": "unary"}
+
+    def mw_run(fused: bool):
+        mw_ctx.config.distributed_options["multiway_join"] = fused
+        cluster = InMemoryCluster(4)
+        coord = Coordinator(resolver=cluster, channels=cluster,
+                            config_options=dict(mw_opts))
+        df = mw_ctx.sql(mw_sql)
+        df.collect_coordinated_table(coordinator=coord, num_tasks=4)  # warm
+        coord2 = Coordinator(resolver=cluster, channels=cluster,
+                             config_options=dict(mw_opts))
+        df = mw_ctx.sql(mw_sql)
+        t0 = time.perf_counter()
+        df.collect_coordinated_table(coordinator=coord2, num_tasks=4)
+        dt = time.perf_counter() - t0
+        ex_bytes = sum(
+            int(sm.get("exchange_bytes", 0))
+            for sm in coord2.stream_metrics.values()
+        )
+        return dt, ex_bytes
+
+    t_chain, bytes_chain = mw_run(fused=False)
+    t_fused, bytes_fused = mw_run(fused=True)
+    results.append({
+        "bench": "multiway_join_chain", "ms": round(t_chain * 1e3, 1),
+        "exchange_mb": round(bytes_chain / 1e6, 3), "workers": 4,
+    })
+    print(json.dumps(results[-1]), flush=True)
+    results.append({
+        "bench": "multiway_join_fused", "ms": round(t_fused * 1e3, 1),
+        "exchange_mb": round(bytes_fused / 1e6, 3),
+        "exchange_mb_saved": round((bytes_chain - bytes_fused) / 1e6, 3),
+        "speedup_vs_chain": round(t_chain / max(t_fused, 1e-9), 2),
+        "workers": 4,
+    })
+    print(json.dumps(results[-1]), flush=True)
+
+    # ---- global hash aggregation ------------------------------------------
+    # High-NDV group-by (every key nearly distinct): the partial+final
+    # shape shuffles partial STATES that are barely smaller than the raw
+    # rows, so the merge pass is pure overhead. SET distributed.
+    # global_hash_agg shuffles the raw rows once and aggregates each
+    # disjoint key range in ONE shared table — no merge stage. Exact
+    # integer aggregates both ways (tests pin equality).
+    ga_n = 1 << 16
+    ga_rng = np.random.default_rng(13)
+    ga_ctx = SessionContext()
+    ga_ctx.config.distributed_options["bytes_per_task"] = 1
+    ga_ctx.register_arrow("events", pa.table({
+        "id": ga_rng.permutation(ga_n),
+        "v": ga_rng.integers(0, 1000, ga_n),
+    }))
+    ga_sql = ("select id, count(*) as c, sum(v) as s, min(v) as mn, "
+              "max(v) as mx from events group by id")
+
+    def ga_run(enabled: bool):
+        ga_ctx.config.distributed_options["global_hash_agg"] = enabled
+        cluster = InMemoryCluster(4)
+        coord = Coordinator(resolver=cluster, channels=cluster,
+                            config_options={"bytes_per_task": 1})
+        df = ga_ctx.sql(ga_sql)
+        df.collect_coordinated_table(coordinator=coord, num_tasks=4)  # warm
+        coord2 = Coordinator(resolver=cluster, channels=cluster,
+                             config_options={"bytes_per_task": 1})
+        df = ga_ctx.sql(ga_sql)
+        t0 = time.perf_counter()
+        df.collect_coordinated_table(coordinator=coord2, num_tasks=4)
+        return time.perf_counter() - t0
+
+    t_merge = ga_run(enabled=False)
+    t_global = ga_run(enabled=True)
+    results.append({
+        "bench": "global_hash_agg_merge", "ms": round(t_merge * 1e3, 1),
+        "rows": ga_n, "workers": 4,
+    })
+    print(json.dumps(results[-1]), flush=True)
+    results.append({
+        "bench": "global_hash_agg_single", "ms": round(t_global * 1e3, 1),
+        "speedup_vs_merge": round(t_merge / max(t_global, 1e-9), 2),
+        "rows": ga_n, "workers": 4,
+    })
+    print(json.dumps(results[-1]), flush=True)
+
     summary = {
         "metric": "micro_bench_suite",
         "value": len(results),

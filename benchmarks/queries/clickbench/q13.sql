@@ -1,0 +1,2 @@
+SELECT "SearchPhrase", COUNT(DISTINCT "UserID") AS c FROM hits
+WHERE "SearchPhrase" <> '' GROUP BY "SearchPhrase" ORDER BY c DESC LIMIT 10

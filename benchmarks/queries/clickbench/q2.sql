@@ -1,0 +1,1 @@
+SELECT SUM("AdvEngineID") AS s, COUNT(*) AS c, AVG("ResolutionWidth") AS a FROM hits

@@ -1,0 +1,1 @@
+SELECT AVG("UserID") AS a FROM hits

@@ -1,0 +1,4 @@
+SELECT "WatchID", "ClientIP", COUNT(*) AS c, SUM("IsRefresh") AS r,
+       AVG("ResolutionWidth") AS a
+FROM hits WHERE "SearchPhrase" <> ''
+GROUP BY "WatchID", "ClientIP" ORDER BY c DESC LIMIT 10

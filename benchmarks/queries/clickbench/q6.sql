@@ -1,0 +1,1 @@
+SELECT MIN("EventDate") AS mn, MAX("EventDate") AS mx FROM hits

@@ -10,6 +10,7 @@ modes, and capacity policy.
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Optional, Sequence
 
 import jax.numpy as jnp
@@ -239,6 +240,239 @@ def _unify_key_dictionaries(probe: Table, build: Table, probe_keys, build_keys):
         probe = remap(pc, probe, pk)
         build = remap(bc, build, bk)
     return probe, build
+
+
+_MW_ORIG = "__mw_orig"
+
+
+@dataclass(frozen=True)
+class MultiwayJoinStep:
+    """Parameters of one probe step of a fused multiway join — exactly the
+    knobs of the binary HashJoinExec the step replaced, so fusion is
+    reversible (``to_binary_chain``) without re-deriving capacities and the
+    fused plan sizes its tables byte-identically to the chain it fused."""
+
+    probe_keys: tuple
+    build_keys: tuple
+    join_type: str
+    out_capacity: int
+    num_slots: int
+    residual: Optional[PhysicalExpr] = None
+    mark_name: str = "__mark"
+    expansion_factor: float = 1.0
+    null_aware: bool = False
+
+    @classmethod
+    def from_join(cls, j: "HashJoinExec") -> "MultiwayJoinStep":
+        return cls(
+            probe_keys=tuple(j.probe_keys),
+            build_keys=tuple(j.build_keys),
+            join_type=j.join_type,
+            out_capacity=int(j.out_capacity),
+            num_slots=int(j.num_slots),
+            residual=j.residual,
+            mark_name=j.mark_name,
+            expansion_factor=float(j.expansion_factor),
+            null_aware=bool(j.null_aware),
+        )
+
+
+class MultiwayHashJoinExec(ExecutionPlan):
+    """A fused chain of >= 2 hash joins executed as ONE stage. Children are
+    ``[probe, build_1 .. build_K]``; ``steps[k]`` joins the running probe
+    stream against ``build_k``. The planner's fusion pass
+    (planner/distributed._multiway_fusion_pass) only builds this node when
+    every step's probe keys come from the BASE probe stream, which is what
+    lets the intermediate shuffles be deleted (re-hashing the same keys to
+    the same task count is an identity re-partition) and lets the cascaded
+    pallas kernel resolve all K probes in one grid pass.
+
+    Execution is exact by construction: the reference path IS the original
+    binary chain (``to_binary_chain``), rebuilt with the captured per-step
+    capacities; the cascaded kernel path (DFTPU_PALLAS=1 + static
+    eligibility) replaces only the per-step probe loops, feeding their
+    resolved slots into the same expansion kernel via
+    ``hash_join(precomputed=...)``.
+    """
+
+    def __init__(self, probe: ExecutionPlan, builds: Sequence[ExecutionPlan],
+                 steps: Sequence[MultiwayJoinStep]):
+        super().__init__()
+        if len(builds) != len(steps) or len(steps) < 2:
+            raise ValueError(
+                f"multiway join needs >= 2 steps with one build each; got "
+                f"{len(steps)} steps / {len(builds)} builds"
+            )
+        self.probe = probe
+        self.builds = list(builds)
+        self.steps = list(steps)
+        self._chain_cache: Optional[HashJoinExec] = None
+
+    def children(self):
+        return [self.probe] + list(self.builds)
+
+    def with_new_children(self, children):
+        return MultiwayHashJoinExec(children[0], list(children[1:]),
+                                    self.steps)
+
+    def to_binary_chain(self, rederive: bool = False) -> HashJoinExec:
+        """The equivalent binary HashJoinExec chain. ``rederive=True`` drops
+        the captured capacities so the chain re-sizes from its (measured)
+        children — the bailout path when build estimates lied."""
+        cur = self.probe
+        for build, s in zip(self.builds, self.steps):
+            cur = HashJoinExec(
+                cur, build, list(s.probe_keys), list(s.build_keys),
+                s.join_type, residual=s.residual,
+                out_capacity=None if rederive else s.out_capacity,
+                num_slots=None if rederive else s.num_slots,
+                mark_name=s.mark_name,
+                expansion_factor=s.expansion_factor,
+                null_aware=s.null_aware,
+            )
+        return cur
+
+    def _chain(self) -> HashJoinExec:
+        if self._chain_cache is None:
+            self._chain_cache = self.to_binary_chain()
+        return self._chain_cache
+
+    def schema(self):
+        return self._chain().schema()
+
+    def output_capacity(self):
+        return self._chain().output_capacity()
+
+    def cascade_eligible(self) -> bool:
+        """Static (schema-only) eligibility for the cascaded pallas probe:
+        inner-only steps, no residual/null-aware modes, every step's probe
+        keys on the BASE probe stream, no string (dictionary) keys, and
+        every table within one VMEM partition. Anything else takes the
+        reference chain path."""
+        import numpy as np
+
+        from datafusion_distributed_tpu import precision
+        from datafusion_distributed_tpu.ops import pallas_hash
+
+        if not pallas_hash.use_pallas_hash():
+            return False
+        if np.dtype(precision.LANE_INT).itemsize != 4:
+            return False
+        base = self.probe.schema()
+        base_names = set(base.names)
+        for s, b in zip(self.steps, self.builds):
+            if (s.join_type != "inner" or s.residual is not None
+                    or s.null_aware):
+                return False
+            if s.num_slots > pallas_hash._MAX_VMEM_SLOTS:
+                return False
+            if not set(s.probe_keys) <= base_names:
+                return False
+            bschema = b.schema()
+            for kn in s.probe_keys:
+                if base.field(kn).dtype == DataType.STRING:
+                    return False
+            for kn in s.build_keys:
+                if bschema.field(kn).dtype == DataType.STRING:
+                    return False
+        return True
+
+    def _execute(self, ctx: ExecContext) -> Table:
+        if self.cascade_eligible():
+            return self._execute_cascade(ctx)
+        return self._chain()._execute(ctx)
+
+    def _execute_cascade(self, ctx: ExecContext) -> Table:
+        import jax
+        import numpy as np
+
+        from datafusion_distributed_tpu.ops import pallas_hash
+        from datafusion_distributed_tpu.ops.hash import hash_columns
+        from datafusion_distributed_tpu.ops.join import _fold_keys
+
+        probe_t = self.probe.execute(ctx)
+        builds_t = [b.execute(ctx) for b in self.builds]
+
+        sides = []
+        for s, bt in zip(self.steps, builds_t):
+            lane_plan = [
+                probe_t.column(pk).validity is not None
+                or bt.column(bk).validity is not None
+                for pk, bk in zip(s.probe_keys, s.build_keys)
+            ]
+            sides.append(build_join_table(
+                bt, list(s.build_keys), s.num_slots, lane_plan
+            ))
+
+        live0 = probe_t.row_mask()
+        n = probe_t.capacity
+        lmax = max(bs.raw_slot_keys.shape[1] for bs in sides)
+        keys_list, slot0_list, active_list = [], [], []
+        tkeys_parts, used_parts, table_slots = [], [], []
+        for s, bs in zip(self.steps, sides):
+            cols = [probe_t.column(k).data for k in s.probe_keys]
+            valids = [probe_t.column(k).validity for k in s.probe_keys]
+            km = _fold_keys(cols, valids, bs.lane_plan).astype(jnp.int32)
+            if km.shape[1] < lmax:
+                km = jnp.pad(km, ((0, 0), (0, lmax - km.shape[1])))
+            hk = bs.slot_used.shape[0]
+            h0 = hash_columns(list(cols), list(valids))
+            slot0 = (h0 & np.uint32(hk - 1)).astype(jnp.int32)
+            has_null = jnp.zeros(n, dtype=jnp.bool_)
+            for v in valids:
+                if v is not None:
+                    has_null = has_null | ~v
+            keys_list.append(km)
+            slot0_list.append(slot0)
+            active_list.append(live0 & ~has_null)
+            tk = bs.raw_slot_keys.astype(jnp.int32)
+            if tk.shape[1] < lmax:
+                tk = jnp.pad(tk, ((0, 0), (0, lmax - tk.shape[1])))
+            tkeys_parts.append(tk)
+            used_parts.append(bs.slot_used.astype(jnp.int32))
+            table_slots.append(hk)
+
+        found, over = pallas_hash.pallas_multiway_probe(
+            jnp.stack(keys_list, axis=1),
+            jnp.stack(slot0_list, axis=1),
+            jnp.stack(active_list, axis=1),
+            jnp.concatenate(tkeys_parts, axis=0),
+            jnp.concatenate(used_parts, axis=0),
+            tuple(table_slots),
+            interpret=jax.default_backend() != "tpu",
+        )
+
+        # hidden original-row index threads the one-shot probe results
+        # through the per-step expansions (dead/padded rows carry garbage
+        # slots that hash_join re-masks against its own row_mask)
+        cur = probe_t.with_column(
+            _MW_ORIG,
+            Column(jnp.arange(n, dtype=jnp.int32), None, DataType.INT32),
+        )
+        for k, (s, bs) in enumerate(zip(self.steps, sides)):
+            orig = jnp.clip(
+                cur.column(_MW_ORIG).data.astype(jnp.int32), 0, n - 1
+            )
+            pre = found[:, k][orig]
+            cur, overflow = hash_join(
+                cur, bs, list(s.probe_keys), "inner", s.out_capacity,
+                precomputed=(pre, over[k]),
+            )
+            ctx.record_overflow(self, overflow)
+        names = [nm for nm in cur.names if nm != _MW_ORIG]
+        return cur.select(names)
+
+    def display(self):
+        parts = []
+        for s in self.steps:
+            ks = ", ".join(
+                f"{p}={b}" for p, b in zip(s.probe_keys, s.build_keys)
+            )
+            parts.append(f"{s.join_type}[{ks}]")
+        return (
+            f"MultiwayHashJoin {' -> '.join(parts)} "
+            f"out_cap={self.output_capacity()}"
+        )
 
 
 class CrossJoinExec(ExecutionPlan):

@@ -273,7 +273,8 @@ class SessionConfig:
                     )
             elif key in ("fair_share", "zero_copy", "hedging",
                          "checkpointing", "pipelined_shuffle",
-                         "partial_agg_pushdown"):
+                         "partial_agg_pushdown", "multiway_join",
+                         "global_hash_agg"):
                 # boolean knobs: fair_share (serving scheduler policy),
                 # zero_copy (view-based data plane — `off` restores the
                 # copying plane everywhere), hedging (straggler
@@ -281,9 +282,12 @@ class SessionConfig:
                 # checkpoint/resume), pipelined_shuffle (streaming
                 # first-slice shuffle boundaries — `off` restores the
                 # materialized plane), partial_agg_pushdown (statistics-
-                # driven pre-exchange partial aggregation). One shared
-                # parser so SET-time coercion and runtime reads can't
-                # drift.
+                # driven pre-exchange partial aggregation), multiway_join
+                # (fuse key-compatible join chains into one stage,
+                # deleting intermediate shuffles), global_hash_agg
+                # (high-NDV aggregation as one shared hash table instead
+                # of per-partition tables + merge). One shared parser so
+                # SET-time coercion and runtime reads can't drift.
                 from datafusion_distributed_tpu.ops.table import (
                     parse_bool_knob,
                 )

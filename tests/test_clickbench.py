@@ -16,7 +16,15 @@ from datafusion_distributed_tpu.sql.context import SessionContext
 
 from tpch_oracle import compare_results
 
-QUERIES_DIR = "/root/reference/testdata/clickbench/queries"
+_REF_QUERIES_DIR = "/root/reference/testdata/clickbench/queries"
+_LOCAL_QUERIES_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks", "queries", "clickbench",
+)
+# the reference checkout when present, else the in-repo adapted set
+# (benchmarks/queries/clickbench/ — same fallback bench.py._qdir uses)
+QUERIES_DIR = (_REF_QUERIES_DIR if os.path.isdir(_REF_QUERIES_DIR)
+               else _LOCAL_QUERIES_DIR)
 ROWS = 20_000
 SEED = 3
 
