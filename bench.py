@@ -191,6 +191,23 @@ def _adaptivity_counter_totals():
         return (0.0, 0.0, 0.0, 0.0, 0.0, 0.0)
 
 
+def _leak_counter_totals():
+    """Summed `dftpu_leaked_resources` across kinds — sampled before/after
+    each query so a leak surfaced by a query-end sweep (runtime/leakcheck.py,
+    armed via DFTPU_LEAK_CHECK=1) lands in that query's event. Best-effort:
+    0 when the harness is off or telemetry never came up."""
+    try:
+        from datafusion_distributed_tpu.runtime.telemetry import (
+            DEFAULT_REGISTRY,
+        )
+
+        snap = DEFAULT_REGISTRY.snapshot()
+        fam = (snap.get("dftpu_leaked_resources") or {}).get("samples", [])
+        return sum(v for _labels, v in fam)
+    except Exception:
+        return 0.0
+
+
 def _emit(fh, **kw):
     kw["ts"] = round(time.time(), 3)
     fh.write(json.dumps(kw) + "\n")
@@ -364,6 +381,7 @@ def _child_main() -> None:
             best = float("inf")
             wire0, saved0 = _wire_counter_totals()
             adapt0 = _adaptivity_counter_totals()
+            leaks0 = _leak_counter_totals()
             # warm-up run compiles; second run measures steady-state
             # latency (the reference reports p50 of repeat runs)
             for _attempt in range(2):
@@ -428,6 +446,12 @@ def _child_main() -> None:
             ):
                 if b1 > b0:
                     ev[key] = int(b1 - b0)
+            # resources the leak harness flagged at this query's end sweep
+            # (only moves under DFTPU_LEAK_CHECK=1; any nonzero delta is a
+            # regression bench_compare surfaces)
+            leaks1 = _leak_counter_totals()
+            if leaks1 > leaks0:
+                ev["leaked_resources"] = int(leaks1 - leaks0)
             if warm_s is not None:
                 ev["warm_s"] = warm_s
             if hbm_gbps:
@@ -1137,8 +1161,12 @@ def main() -> None:
                      "wire_bytes_saved", "adapt_skew_splits",
                      "adapt_bailouts", "adapt_replans",
                      "joins_fused", "exchanges_deleted",
-                     "global_agg_selected")
+                     "global_agg_selected", "leaked_resources")
                     if k in ev}
+                if ev.get("leaked_resources"):
+                    state["meta"]["leaked_resources_total"] = (
+                        state["meta"].get("leaked_resources_total", 0)
+                        + int(ev["leaked_resources"]))
                 print(f"  [{plat}] {ev['q']}: {ev['secs']}s "
                       f"({ev.get('gbps', '?')} GB/s, "
                       f"{ev.get('pct_hbm_roofline', '?')}% roofline)",

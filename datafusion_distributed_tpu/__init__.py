@@ -31,6 +31,17 @@ if _os.environ.get("DFTPU_LOCK_CHECK", "0") not in ("", "0"):
 
     _lockcheck.install()
 
+# Runtime resource-leak harness (runtime/leakcheck.py): the dynamic half
+# of the resource model enforced statically by
+# tools/check_resource_lifecycle.py. Installed before submodule imports
+# so every tracked acquisition (store entries, spill slots, shm tokens,
+# stream pullers, checkpoint slices) is witnessed; see README "Resource
+# lifecycle".
+if _os.environ.get("DFTPU_LEAK_CHECK", "0") not in ("", "0"):
+    from datafusion_distributed_tpu.runtime import leakcheck as _leakcheck
+
+    _leakcheck.install()
+
 # Precision policy: 32-bit TPU-native compute by default; DFTPU_PRECISION=x64
 # restores exact f64/i64 (see precision.py for the full rationale).
 from datafusion_distributed_tpu import precision  # noqa: F401

@@ -209,7 +209,10 @@ class FaultPlan:
         #: both modes: they bound total injected damage, not per-query
         #: schedules.
         self.query_scoped = bool(query_scoped)
-        self.fired: list[dict] = []  # guarded-by: _lock
+        # forensic log of injected faults: bounded so a long-lived
+        # serving process under sustained chaos (soak tests) cannot grow
+        # it forever — schedules assert on far fewer than the cap
+        self.fired: list[dict] = []  # guarded-by: _lock; per-query: bounded 4096
         self._lock = threading.Lock()
         #: (spec_idx, query_scope, site, stage, task) -> call count (the
         #: nth-call input of the hash, so repeated attempts of one task
@@ -224,6 +227,16 @@ class FaultPlan:
         #: event idx -> matching-call count / fired flag
         self._member_calls: dict[int, int] = {}  # guarded-by: _lock
         self._member_fired: set = set()  # guarded-by: _lock
+
+    _FIRED_CAP = 4096
+
+    def _note_fired_locked(self, rec: dict) -> None:
+        """Record an injected fault; oldest entries roll off past the
+        cap so a long-lived serving process never grows the log
+        unboundedly."""
+        self.fired.append(rec)
+        if len(self.fired) > self._FIRED_CAP:
+            del self.fired[: len(self.fired) - self._FIRED_CAP]
 
     def membership_due(self, site: str, url: str, key) -> list:
         """Membership events whose trigger this call just satisfied (each
@@ -244,7 +257,7 @@ class FaultPlan:
                 if nth != ev.nth_call:
                     continue
                 self._member_fired.add(i)
-                self.fired.append({
+                self._note_fired_locked({
                     "site": site, "url": ev.url, "stage_id": stage_id,
                     "task_number": task_number,
                     "kind": f"membership_{ev.action}", "nth_call": nth,
@@ -295,7 +308,7 @@ class FaultPlan:
                     continue
                 self._totals[i] = self._totals.get(i, 0) + 1
                 self._per_stage[sk] = self._per_stage.get(sk, 0) + 1
-                self.fired.append({
+                self._note_fired_locked({
                     "site": site, "url": url, "stage_id": stage_id,
                     "task_number": task_number, "kind": spec.kind,
                     "nth_call": nth,
@@ -327,7 +340,7 @@ class FaultPlan:
             self._stragglers[sk] = verdict
             if verdict:
                 self._totals[i] = self._totals.get(i, 0) + 1
-                self.fired.append({
+                self._note_fired_locked({
                     "site": site, "url": url, "stage_id": stage_id,
                     "task_number": task_number, "kind": "straggler",
                     "nth_call": 0,

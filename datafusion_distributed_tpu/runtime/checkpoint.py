@@ -47,6 +47,8 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Optional
 
+from datafusion_distributed_tpu.runtime import leakcheck as _leakcheck
+
 #: query-record lifecycle states
 ADMITTED = "admitted"  # running (or interrupted mid-run): recoverable
 RESUMED = "resumed"    # picked up by ServingSession.recover()
@@ -142,7 +144,7 @@ class CheckpointStore:
                 r for r in self._records.values() if r.status != DONE
             ]
 
-    def release(self, record_id: str, channels) -> int:
+    def release(self, record_id: str, channels) -> int:  # releases: checkpoint-slice
         """The query resolved (or was cancelled): drop its record and
         release every staged checkpoint slice through ``channels``
         (departed workers already released theirs with their process);
@@ -152,6 +154,11 @@ class CheckpointStore:
         if rec is None:
             return 0
         released = 0
+        if _leakcheck.enabled():
+            for sk in rec.stages:
+                _leakcheck.note_release(
+                    "checkpoint-slice", (record_id, sk[0], sk[1])
+                )
         for ck in rec.stages.values():
             for url, tid, _nbytes in ck.slices:
                 try:
@@ -165,7 +172,7 @@ class CheckpointStore:
         return released
 
     # -- stage snapshots ------------------------------------------------------
-    def save_stage(self, record_id: str, exec_index: int, stage_id: int,
+    def save_stage(self, record_id: str, exec_index: int, stage_id: int,  # acquires: checkpoint-slice (managed)
                    fingerprint: str, tables, replicated: bool,
                    pinned: bool, t_prod: int, resolver,
                    channels) -> Optional[int]:
@@ -231,6 +238,16 @@ class CheckpointStore:
                 rec.stages[(exec_index, stage_id)] = ck
                 self.saves += 1
                 released = False
+                if _leakcheck.enabled():
+                    # recovery checkpoints INTENTIONALLY outlive the
+                    # query (no query attribution): CheckpointStore
+                    # release/_drop_stage are the release paths, so only
+                    # assert_clean-style audits see a stuck slice
+                    _leakcheck.note_acquire(
+                        "checkpoint-slice",
+                        (record_id, exec_index, stage_id),
+                        tag="CheckpointStore.save_stage",
+                    )
         if displaced is not None:
             for url, tid, _nb in displaced.slices:
                 try:
@@ -282,6 +299,10 @@ class CheckpointStore:
                 _, rid, key = min(cands)
                 evicted = self._records[rid].stages.pop(key)
                 self.evicted_budget += 1
+                if _leakcheck.enabled():
+                    _leakcheck.note_release(
+                        "checkpoint-slice", (rid, key[0], key[1])
+                    )
             for url, tid, _nb in evicted.slices:
                 try:
                     getattr(channels.get_worker(url), "table_store").remove(
@@ -333,6 +354,10 @@ class CheckpointStore:
             )
         if ck is None:
             return
+        if _leakcheck.enabled():
+            _leakcheck.note_release(
+                "checkpoint-slice", (record_id, exec_index, stage_id)
+            )
         for url, tid, _nb in ck.slices:
             try:
                 getattr(channels.get_worker(url), "table_store").remove(

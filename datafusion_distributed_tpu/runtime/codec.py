@@ -18,6 +18,7 @@ import threading
 import uuid
 from typing import Any, Callable, Optional
 
+from datafusion_distributed_tpu.runtime import leakcheck as _leakcheck
 from datafusion_distributed_tpu.ops.aggregate import AggSpec
 from datafusion_distributed_tpu.ops.sort import SortKey
 from datafusion_distributed_tpu.ops.table import Table
@@ -280,8 +281,8 @@ class TableStore:
         self.spill_count = 0  # guarded-by: _lock
         self.refault_count = 0  # guarded-by: _lock
         # -- per-query staging attribution (logical demand, spill-blind) ----
-        self._query_bytes: dict[str, int] = {}  # guarded-by: _lock
-        self._query_peak: dict[str, int] = {}  # guarded-by: _lock
+        self._query_bytes: dict[str, int] = {}  # guarded-by: _lock; per-query: bounded 512
+        self._query_peak: dict[str, int] = {}  # guarded-by: _lock; per-query: bounded 512
 
     # -- accounting core (callers hold self._lock) ---------------------------
     def _insert_locked(self, tid: str, table: Table,
@@ -293,6 +294,12 @@ class TableStore:
         )
         dict.__setitem__(self.tables, tid, table)
         self._meta[tid] = meta
+        if _leakcheck.enabled():
+            _leakcheck.note_acquire(
+                "store-entry", (id(self), tid),
+                query_id=meta.owner_query,
+                tag="view" if base is not None else "owner",
+            )
         if base is None:
             self._by_identity[id(table)] = tid
             self._owned_nbytes += meta.nbytes
@@ -339,6 +346,8 @@ class TableStore:
             dict.__delitem__(self.tables, tid)
         if meta is None:
             return
+        if _leakcheck.enabled():
+            _leakcheck.note_release("store-entry", (id(self), tid))
         if meta.base is not None:
             b = self._meta.get(meta.base)
             if b is not None:
@@ -393,7 +402,7 @@ class TableStore:
         return tid
 
     # -- public surface ------------------------------------------------------
-    def put(self, table: Table) -> str:
+    def put(self, table: Table) -> str:  # acquires: store-entry (managed)
         tid = uuid.uuid4().hex
         with self._lock:
             self.put_count += 1
@@ -409,7 +418,7 @@ class TableStore:
         self.enforce_budget()
         return tid
 
-    def put_as(self, tid: str, table: Table) -> str:
+    def put_as(self, tid: str, table: Table) -> str:  # acquires: store-entry (managed)
         """Stage under a caller-chosen id (the wire receive path — the
         shipping side minted the id and the plan references it — and the
         checkpoint store's accounted staging surface)."""
@@ -417,7 +426,7 @@ class TableStore:
         self.enforce_budget()
         return tid
 
-    def put_view(self, base_tid: str, table: Optional[Table] = None,
+    def put_view(self, base_tid: str, table: Optional[Table] = None,  # acquires: store-entry (managed)
                  lo: int = 0, count: Optional[int] = None) -> str:
         """Register a zero-copy VIEW of an existing entry as its own id:
         shares the base buffers (zero owned bytes; the base stays pinned by
@@ -646,7 +655,7 @@ class TableStore:
 
         return slice_view(self.get(tid), lo, count)
 
-    def remove(self, tids) -> None:
+    def remove(self, tids) -> None:  # releases: store-entry
         with self._lock:
             for tid in tids:
                 self._release_locked(tid)

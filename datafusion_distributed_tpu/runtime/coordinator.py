@@ -394,7 +394,7 @@ class Coordinator:
     collect_metrics: bool = True
     metrics: dict = field(default_factory=dict)  # TaskKey -> worker metrics
     # (query_id, stage_id) -> streaming-plane stats (bytes/chunks/early_exit)
-    stream_metrics: dict = field(default_factory=dict)
+    stream_metrics: dict = field(default_factory=dict)  # per-query: swept-by sweep_query
     # `SET distributed.*` options propagated to every worker with the plan
     # (the config-over-headers flow, `config_extension_ext.rs:1-82`)
     config_options: dict = field(default_factory=dict)
@@ -557,14 +557,14 @@ class Coordinator:
         # (query_id, stage_id) -> (prepared producer plan, t_prod, ttl):
         # the re-ship source when a worker holding a shipped peer-producer
         # plan departs the membership mid-query (_heal_departed_peers)
-        self._peer_plan_registry: dict = {}
+        self._peer_plan_registry: dict = {}  # per-query: swept-by sweep_query
         # accumulated ACROSS heal passes (healing is incremental — each
         # failing consumer heals when IT retries, possibly long after the
         # pass that moved a producer): producer key tuple -> the url now
         # serving it, and the set of shipped copies whose on-worker plan
         # pre-dates a spec rewrite and must be refreshed before trusted
-        self._peer_url_map: dict = {}
-        self._peer_stale: set = set()
+        self._peer_url_map: dict = {}  # per-query: swept-by sweep_query
+        self._peer_stale: set = set()  # per-query: swept-by sweep_query
         # per-query caches (span plans are keyed by query_id; the plan-walk
         # verdicts key by object id which is only stable within a query).
         # The lock serializes span check-and-ship: concurrent stage tasks
@@ -670,21 +670,11 @@ class Coordinator:
             # resolves
             for t in self._stream_feeds:
                 t.join(timeout=30.0)
-            for worker, key in self._peer_shipped:
-                try:
-                    # peer producers report metrics at query end (the
-                    # last-drop metrics flush rides no coordinator stream
-                    # to observe earlier)
-                    self._record_task_progress(worker, key)
-                except Exception:
-                    pass
-                try:
-                    if hasattr(worker, "release_task"):
-                        worker.release_task(key)
-                    else:
-                        worker.registry.invalidate(key)
-                except Exception:
-                    pass  # cleanup must not mask the query's own error
+            # release THIS query's shipped peer producers promptly (their
+            # per-entry TTL is only the crash backstop, not the release
+            # path — DFTPU301/307); sweep_query re-runs the same helper
+            # idempotently for direct _peer_boundary users
+            self._release_peer_tasks(query_id)
             # close the trace AFTER the peer sweep so last-drop worker
             # spans (peer producers report at query end) still splice
             tracer.end_span(qspan)
@@ -745,6 +735,36 @@ class Coordinator:
 
         return probe
 
+    def _release_peer_tasks(self, query_id: str) -> None:
+        """Release every shipped peer-producer task belonging to
+        ``query_id`` and forget it. Idempotent — released entries are
+        removed from ``_peer_shipped``, so execute's finally and
+        ``sweep_query`` can both call this (the latter covers direct
+        ``_peer_boundary`` users that never enter execute)."""
+        shipped = getattr(self, "_peer_shipped", None)
+        if not shipped:
+            return  # coordinator never executed (or nothing shipped)
+        remaining = []
+        for worker, key in list(shipped):
+            if key.query_id != query_id:
+                remaining.append((worker, key))
+                continue
+            try:
+                # peer producers report metrics at query end (the
+                # last-drop metrics flush rides no coordinator stream
+                # to observe earlier)
+                self._record_task_progress(worker, key)
+            except Exception:
+                pass
+            try:
+                if hasattr(worker, "release_task"):
+                    worker.release_task(key)
+                else:
+                    worker.registry.invalidate(key)
+            except Exception:
+                pass  # cleanup must not mask the query's own error
+        shipped[:] = remaining
+
     def sweep_query(self, query_id: str) -> None:
         """Drop THIS query's accumulated per-task/stream metrics — the
         unbounded per-query dicts a long-lived serving coordinator would
@@ -781,6 +801,24 @@ class Coordinator:
             k for k in list(self.stream_metrics) if k[0] == query_id
         ]:
             self.stream_metrics.pop(key, None)
+        # peer-plane state: release any still-shipped producer tasks
+        # (re-entrant no-op after execute's finally), then drop the
+        # query's re-ship plans and heal bookkeeping — a reused
+        # coordinator otherwise grows these forever (DFTPU307)
+        self._release_peer_tasks(query_id)
+        plans = getattr(self, "_peer_plan_registry", None)
+        if plans:
+            for k in [k for k in list(plans) if k[0] == query_id]:
+                plans.pop(k, None)
+        heal_lock = getattr(self, "_peer_heal_lock", None)
+        if heal_lock is not None:
+            with self._peer_heal_lock:
+                url_map = getattr(self, "_peer_url_map", None) or {}
+                for k in [k for k in list(url_map) if k[0] == query_id]:
+                    url_map.pop(k, None)
+                stale = getattr(self, "_peer_stale", None) or set()
+                for k in [k for k in list(stale) if k[0] == query_id]:
+                    stale.discard(k)
         spans = getattr(self, "_span_shipped", None)
         ok = getattr(self, "_span_ok_cache", None)
         if spans or ok:
@@ -792,6 +830,11 @@ class Coordinator:
                 # _try_dispatch_span's check-then-insert
                 for k in [k for k in (ok or ()) if k[0] == query_id]:
                     ok.pop(k, None)
+        # query end is the leak-harness checkpoint: any tracked resource
+        # still attributed to this query is a leak
+        from datafusion_distributed_tpu.runtime import leakcheck
+
+        leakcheck.sweep_query(query_id)
 
     def _check_worker_versions(self) -> None:
         from datafusion_distributed_tpu.runtime.errors import WorkerError

@@ -316,7 +316,7 @@ class MeshWorker(Worker):
         self.devices = list(devices)
         self.mesh = Mesh(np.asarray(self.devices), (AXIS,))
         self.mesh_width = len(self.devices)
-        self._spans: dict = {}  # (query_id, stage_id, lo) -> _SpanState
+        self._spans: dict = {}  # (query_id, stage_id, lo) -> _SpanState; per-query: bounded 16
 
     # -- control plane ------------------------------------------------------
     def set_stage_plan(self, query_id: str, stage_id: int, lo: int, hi: int,

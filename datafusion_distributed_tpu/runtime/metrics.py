@@ -46,9 +46,9 @@ class MetricsStore:
     #: query_id -> {stage_id: {"submit_s","start_s","end_s","wall_s",
     #:                          "queue_s","plane"}} (LRU-ordered: a touch
     #: moves the query to the end; eviction pops from the front)
-    stage_spans: dict = field(default_factory=dict)  # guarded-by: _lock
+    stage_spans: dict = field(default_factory=dict)  # guarded-by: _lock; per-query: bounded 64
     #: query_id -> total query wall seconds
-    query_walls: dict = field(default_factory=dict)  # guarded-by: _lock
+    query_walls: dict = field(default_factory=dict)  # guarded-by: _lock; per-query: bounded 64
 
     def __post_init__(self):
         import threading

@@ -324,15 +324,15 @@ class GlobalStageScheduler:
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._pending: list[_StageJob] = []  # guarded-by: _lock
-        self._pass: dict[str, float] = {}  # guarded-by: _lock
-        self._prio: dict[str, int] = {}  # guarded-by: _lock
-        self._weight: dict[str, float] = {}  # guarded-by: _lock
-        self._qseq: dict[str, int] = {}  # guarded-by: _lock
+        self._pass: dict[str, float] = {}  # guarded-by: _lock; per-query: swept-by unregister_query
+        self._prio: dict[str, int] = {}  # guarded-by: _lock; per-query: swept-by unregister_query
+        self._weight: dict[str, float] = {}  # guarded-by: _lock; per-query: swept-by unregister_query
+        self._qseq: dict[str, int] = {}  # guarded-by: _lock; per-query: swept-by unregister_query
         self._qseq_next = 0  # guarded-by: _lock
         #: per-query in-flight stage count + mean stage wall (EMA): the
         #: provisional-charge inputs
-        self._running_stages: dict[str, int] = {}  # guarded-by: _lock
-        self._mean_wall: dict[str, float] = {}  # guarded-by: _lock
+        self._running_stages: dict[str, int] = {}  # guarded-by: _lock; per-query: swept-by unregister_query
+        self._mean_wall: dict[str, float] = {}  # guarded-by: _lock; per-query: swept-by unregister_query
         #: qids registered implicitly by submit() (direct coordinator
         #: use, no ServingSession driving unregister): reaped when their
         #: last job drains, so a long-lived scheduler does not grow
@@ -671,8 +671,8 @@ class ServingSession:
         self._lock = threading.Lock()
         # arrival order preserved
         self._queued: list[QueryHandle] = []  # guarded-by: _lock
-        self._running: dict[str, QueryHandle] = {}  # guarded-by: _lock
-        self._drivers: dict[str, threading.Thread] = {}  # guarded-by: _lock
+        self._running: dict[str, QueryHandle] = {}  # guarded-by: _lock; per-query: swept-by _drive
+        self._drivers: dict[str, threading.Thread] = {}  # guarded-by: _lock; per-query: swept-by _drive
         self._admitted_total = 0  # guarded-by: _lock
         self._completed = {DONE: 0, FAILED: 0, CANCELLED: 0,
                            PREEMPTED: 0}  # guarded-by: _lock

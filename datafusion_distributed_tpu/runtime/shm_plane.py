@@ -50,6 +50,8 @@ import uuid
 from struct import error as _struct_error
 from typing import Optional
 
+from datafusion_distributed_tpu.runtime import leakcheck as _leakcheck
+
 from datafusion_distributed_tpu.runtime.spill import (
     _HEADER,
     _MAGIC,
@@ -103,7 +105,7 @@ def open_segment_at(d: str, name: str) -> tuple[bytes, int]:
     return payload, cap
 
 
-def acquire_at(d: str, name: str) -> str:
+def acquire_at(d: str, name: str) -> str:  # acquires: shm-segment
     """Add a reference to a live segment (broadcast fan-out); -> the new
     token. Only valid while an existing reference is held."""
     if not os.path.exists(os.path.join(d, f"{name}.seg")):
@@ -113,13 +115,18 @@ def acquire_at(d: str, name: str) -> str:
     os.makedirs(refs, exist_ok=True)
     with open(os.path.join(refs, token), "wb"):
         pass
+    if _leakcheck.enabled():
+        _leakcheck.note_acquire("shm-segment", (name, token),
+                                tag="acquire_at")
     return token
 
 
-def release_at(d: str, name: str, token: str) -> None:
+def release_at(d: str, name: str, token: str) -> None:  # releases: shm-segment
     """Drop one reference; the LAST release unlinks the segment.
     Idempotent per token and safe on an already-torn segment (the
     `segment_lost` degradation path releases what it failed to read)."""
+    if _leakcheck.enabled():
+        _leakcheck.note_release("shm-segment", (name, token))
     refs = os.path.join(d, f"{name}.refs")
     try:
         os.unlink(os.path.join(refs, token))
@@ -192,7 +199,7 @@ class SegmentPool:
             return False
 
     # -- blocking I/O entry points (never call under a lock) -----------------
-    def publish(self, payload, capacity: int = 0) -> tuple[str, str]:
+    def publish(self, payload, capacity: int = 0) -> tuple[str, str]:  # acquires: shm-segment
         """Write an `encode_table` payload as a named segment with ONE
         reference token; -> (name, token). The token transfers to the
         consumer (ride it in the S-frame); whoever holds it releases.
@@ -220,7 +227,7 @@ class SegmentPool:
             self.published_bytes += len(payload)
         return name, token
 
-    def publish_file(self, path: str) -> tuple[str, str]:
+    def publish_file(self, path: str) -> tuple[str, str]:  # acquires: shm-segment
         """Serve an existing DFSP-framed file (a SpillManager slot) as a
         segment WITHOUT decoding it: hardlink into the pool (same
         filesystem), byte-copy fallback across devices. -> (name, token).
@@ -279,15 +286,18 @@ class SegmentPool:
         os.makedirs(refs, exist_ok=True)
         with open(os.path.join(refs, token), "wb"):
             pass
+        if _leakcheck.enabled():
+            _leakcheck.note_acquire("shm-segment", (name, token),
+                                    tag="SegmentPool.publish")
         return token
 
-    def acquire(self, name: str) -> str:
+    def acquire(self, name: str) -> str:  # acquires: shm-segment
         """Add a reference for an additional reader (broadcast fan-out);
         -> the new token. Only valid while holding an existing
         reference — acquire-after-last-release is a protocol error."""
         return acquire_at(self._ensure_dir(), name)
 
-    def release(self, name: str, token: str) -> None:
+    def release(self, name: str, token: str) -> None:  # releases: shm-segment
         """Drop one reference; the LAST release unlinks the segment."""
         release_at(self._ensure_dir(), name, token)
 
@@ -295,6 +305,8 @@ class SegmentPool:
         refs = os.path.join(self._ensure_dir(), f"{name}.refs")
         try:
             for t in os.listdir(refs):
+                if _leakcheck.enabled():
+                    _leakcheck.note_release("shm-segment", (name, t))
                 try:
                     os.unlink(os.path.join(refs, t))
                 except OSError:
@@ -337,6 +349,17 @@ class SegmentPool:
             d, self._dir = self._dir, None
         if d is None:
             return
+        if _leakcheck.enabled():
+            # the rmtree reclaims every surviving token file wholesale
+            try:
+                for refs in os.listdir(d):
+                    if not refs.endswith(".refs"):
+                        continue
+                    name = refs[: -len(".refs")]
+                    for t in os.listdir(os.path.join(d, refs)):
+                        _leakcheck.note_release("shm-segment", (name, t))
+            except OSError:
+                pass
         import shutil
 
         shutil.rmtree(d, ignore_errors=True)

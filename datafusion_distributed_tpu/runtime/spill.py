@@ -44,6 +44,8 @@ import threading
 import uuid
 from typing import Optional
 
+from datafusion_distributed_tpu.runtime import leakcheck as _leakcheck
+
 _MAGIC = b"DFSP"
 _VERSION = 1
 _HEADER = struct.Struct(">4sIIQ")  # magic, version, capacity, payload len
@@ -163,7 +165,7 @@ class SpillManager:
             return self._dir
 
     # -- blocking I/O entry points (never call under a store lock) ----------
-    def write_spill(self, table, nbytes: int) -> SpillSlot:
+    def write_spill(self, table, nbytes: int) -> SpillSlot:  # acquires: spill-slot
         """Encode ``table`` into a framed spill file; -> its slot.
         BLOCKING (disk write) — registered with the DFTPU205 lint."""
         from datafusion_distributed_tpu.runtime.codec import encode_table
@@ -193,6 +195,9 @@ class SpillManager:
             self._live.add(path)
             self.spills += 1
             self.spill_bytes += slot.nbytes
+        if _leakcheck.enabled():
+            _leakcheck.note_acquire("spill-slot", path,
+                                    tag="SpillManager.write_spill")
         return slot
 
     def read_spill(self, slot: SpillSlot):
@@ -224,11 +229,13 @@ class SpillManager:
         return table
 
     # -- lifecycle -----------------------------------------------------------
-    def release(self, slot: SpillSlot) -> None:
+    def release(self, slot: SpillSlot) -> None:  # releases: spill-slot
         """Unlink a slot's file (idempotent)."""
         if slot.released:
             return
         slot.released = True
+        if _leakcheck.enabled():
+            _leakcheck.note_release("spill-slot", slot.path)
         with self._lock:
             self._live.discard(slot.path)
         try:

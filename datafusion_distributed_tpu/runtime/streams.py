@@ -33,6 +33,7 @@ import weakref
 from dataclasses import dataclass, field
 from typing import Callable, Iterator, Optional
 
+from datafusion_distributed_tpu.runtime import leakcheck as _leakcheck
 from datafusion_distributed_tpu.ops.table import Table, concat_tables
 from datafusion_distributed_tpu.plan.physical import (
     DistributedTaskContext,
@@ -272,6 +273,9 @@ def stream_stage_chunks(
 
     def run(i: int, pull) -> None:
         held = False
+        if _leakcheck.enabled():
+            _leakcheck.note_acquire("stream-puller", (id(out_q), i),
+                                    tag="stream_stage_chunks")
         try:
             if gate is not None:
                 gate.acquire()
@@ -287,6 +291,11 @@ def stream_stage_chunks(
         finally:
             if held:
                 gate.release()
+            # an abandoned puller (join timeout) stays live in the leak
+            # harness until its thread actually exits — leaked-while-hung,
+            # self-releasing, matching the telemetry counter's intent
+            if _leakcheck.enabled():
+                _leakcheck.note_release("stream-puller", (id(out_q), i))
             out_q.put(("done", i, None, 0))
 
     threads = [
@@ -579,6 +588,9 @@ def stream_partition_chunks(
 
     def run(i: int, pull) -> None:
         held = False
+        if _leakcheck.enabled():
+            _leakcheck.note_acquire("stream-puller", (id(out_q), i),
+                                    tag="stream_partition_chunks")
         try:
             if gate is not None:
                 gate.acquire()
@@ -594,6 +606,8 @@ def stream_partition_chunks(
         finally:
             if held:
                 gate.release()
+            if _leakcheck.enabled():
+                _leakcheck.note_release("stream-puller", (id(out_q), i))
             out_q.put(("done", i, None, 0))
 
     threads = [

@@ -443,7 +443,7 @@ class TraceStore:
         self.query_cap = query_cap
         self.span_cap = span_cap
         # insertion order == LRU order
-        self._traces: dict = {}  # guarded-by: _lock
+        self._traces: dict = {}  # guarded-by: _lock; per-query: swept-by finish
         self._running: set = set()  # guarded-by: _lock
         self._lock = threading.Lock()
         self._started_total = 0  # guarded-by: _lock

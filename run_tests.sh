@@ -48,6 +48,17 @@ if ! python tools/check_concurrency.py; then
     echo "with a justification)"
     FAILED+=("tools/check_concurrency.py[lint-gate]")
 fi
+# Resource-lifecycle lint gate (tools/check_resource_lifecycle.py):
+# pure-AST, sub-second — declared acquire/release discipline
+# (DFTPU301-307) over the whole package, before any XLA compile is
+# paid. Stale allowlist entries fail the gate too.
+echo "=== tools/check_resource_lifecycle.py (resource-lifecycle lint gate)"
+if ! python tools/check_resource_lifecycle.py; then
+    echo "LINT FAILED: resource-lifecycle violations (see above;"
+    echo "intentional exceptions go in tools/resource_allowlist.txt"
+    echo "with a justification)"
+    FAILED+=("tools/check_resource_lifecycle.py[lint-gate]")
+fi
 # Static-verifier gate SECOND (tests/test_plan_verify.py): the seeded
 # malformed-plan classes must each be rejected with their DFTPU0xx code,
 # and the snapshot-suite/inlined clean sweep must verify with zero errors
@@ -96,8 +107,8 @@ fi
 # traces across parameter variations (the recompile gate's serving arm).
 # Runs under DFTPU_LOCK_CHECK=1 (see the race-harness note above): the
 # 8-thread mixed run is the widest cross-thread schedule in the suite.
-echo "=== tests/test_serving.py (multi-query serving gate, DFTPU_LOCK_CHECK=1)"
-if ! env DFTPU_LOCK_CHECK=1 python -m pytest tests/test_serving.py \
+echo "=== tests/test_serving.py (multi-query serving gate, DFTPU_LOCK_CHECK=1 DFTPU_LEAK_CHECK=strict)"
+if ! env DFTPU_LOCK_CHECK=1 DFTPU_LEAK_CHECK=strict python -m pytest tests/test_serving.py \
         -q --no-header \
         -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
     FAILED+=("tests/test_serving.py[gate+lockcheck]")
@@ -111,8 +122,8 @@ fi
 # or staged-slice loss (departed worker), zero leaked slices either way.
 # Deterministic under DFTPU_CHAOS_SEED; runs under DFTPU_LOCK_CHECK=1
 # (hedge races + checkpoint saves are cross-thread schedules).
-echo "=== tests/test_hedging_recovery.py (hedging + query-recovery gate, DFTPU_LOCK_CHECK=1)"
-if ! env DFTPU_LOCK_CHECK=1 python -m pytest tests/test_hedging_recovery.py \
+echo "=== tests/test_hedging_recovery.py (hedging + query-recovery gate, DFTPU_LOCK_CHECK=1 DFTPU_LEAK_CHECK=strict)"
+if ! env DFTPU_LOCK_CHECK=1 DFTPU_LEAK_CHECK=strict python -m pytest tests/test_hedging_recovery.py \
         -q --no-header \
         -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
     FAILED+=("tests/test_hedging_recovery.py[gate+lockcheck]")
@@ -126,8 +137,8 @@ fi
 # checkpoint byte cap, zero leaked slices AND spill files. Runs under
 # DFTPU_LOCK_CHECK=1: spill swaps, the red-line monitor, and producer
 # backpressure are cross-thread schedules.
-echo "=== tests/test_memory_pressure.py (memory-pressure gate, DFTPU_LOCK_CHECK=1)"
-if ! env DFTPU_LOCK_CHECK=1 python -m pytest tests/test_memory_pressure.py \
+echo "=== tests/test_memory_pressure.py (memory-pressure gate, DFTPU_LOCK_CHECK=1 DFTPU_LEAK_CHECK=strict)"
+if ! env DFTPU_LOCK_CHECK=1 DFTPU_LEAK_CHECK=strict python -m pytest tests/test_memory_pressure.py \
         -q --no-header \
         -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
     FAILED+=("tests/test_memory_pressure.py[gate+lockcheck]")
@@ -188,8 +199,8 @@ fi
 # acceptance number).
 # Runs under DFTPU_LOCK_CHECK=1: the 8-thread churn run exercises the
 # TableStore/TaskRegistry lock pairs the static graph predicts.
-echo "=== tests/test_data_plane.py (zero-copy data-plane gate, DFTPU_LOCK_CHECK=1)"
-if ! env DFTPU_LOCK_CHECK=1 python -m pytest tests/test_data_plane.py \
+echo "=== tests/test_data_plane.py (zero-copy data-plane gate, DFTPU_LOCK_CHECK=1 DFTPU_LEAK_CHECK=strict)"
+if ! env DFTPU_LOCK_CHECK=1 DFTPU_LEAK_CHECK=strict python -m pytest tests/test_data_plane.py \
         -q --no-header \
         -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
     FAILED+=("tests/test_data_plane.py[gate+lockcheck]")
@@ -204,8 +215,8 @@ fi
 # exchange bytes). Runs under DFTPU_LOCK_CHECK=1: the feeder thread's
 # cross-thread slice handoff (PartitionFeed/StreamScanExec) is exactly
 # the schedule the PR 9 race harness exists for.
-echo "=== tests/test_pipelined_shuffle.py (pipelined-shuffle gate, DFTPU_LOCK_CHECK=1)"
-if ! env DFTPU_LOCK_CHECK=1 python -m pytest tests/test_pipelined_shuffle.py \
+echo "=== tests/test_pipelined_shuffle.py (pipelined-shuffle gate, DFTPU_LOCK_CHECK=1 DFTPU_LEAK_CHECK=strict)"
+if ! env DFTPU_LOCK_CHECK=1 DFTPU_LEAK_CHECK=strict python -m pytest tests/test_pipelined_shuffle.py \
         -q --no-header \
         -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
     FAILED+=("tests/test_pipelined_shuffle.py[gate+lockcheck]")

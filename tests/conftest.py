@@ -35,6 +35,16 @@ _LOCKCHECK_SUITES = ("test_serving", "test_stage_scheduler",
                      "test_adaptivity")
 if any(s in a for a in sys.argv for s in _LOCKCHECK_SUITES):
     os.environ.setdefault("DFTPU_LOCK_CHECK", "1")
+# Resource-leak harness (runtime/leakcheck.py): the suites whose seeded
+# chaos/churn/hedging schedules double as a leak harness run with it
+# armed when targeted directly — query-end sweeps must find zero
+# surviving tracked resources (strict raises ResourceLeakError with the
+# acquisition stack). setdefault: DFTPU_LEAK_CHECK=0 still opts out.
+_LEAKCHECK_SUITES = ("test_serving", "test_data_plane",
+                     "test_pipelined_shuffle", "test_memory_pressure",
+                     "test_hedging_recovery", "test_resource_lifecycle")
+if any(s in a for a in sys.argv for s in _LEAKCHECK_SUITES):
+    os.environ.setdefault("DFTPU_LEAK_CHECK", "strict")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # single-core box: give mesh collectives starvation headroom (shared

@@ -178,6 +178,18 @@ def compare(baseline: dict, current: dict, threshold: float = 0.10,
                 f"serving:{name}", bs[name], cs[name], threshold,
                 higher_is_better=hib,
             ))
+    # leak-harness totals (runtime/leakcheck.py via DFTPU_LEAK_CHECK):
+    # resources still live at query-end sweeps, folded into BENCH_DETAIL
+    # meta by bench.py. A missing key means "harness off or zero leaks" —
+    # both read as 0, so any nonzero current total flags as a regression
+    # even against a baseline that predates the harness.
+    bl = (baseline.get("meta") or {}).get("leaked_resources_total") or 0
+    cl = (current.get("meta") or {}).get("leaked_resources_total") or 0
+    if bl or cl:
+        comparisons.append(_compare_value(
+            "leaked_resources_total", bl, cl, threshold,
+            higher_is_better=False,
+        ))
     # micro_bench cases (data_plane_copy/view/shm, wire roundtrips, ...):
     # intersection of both documents' case sets, per-metric direction
     # from _MICRO_DIRECTIONS. A case either side marked "skipped" (e.g.

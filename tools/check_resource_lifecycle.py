@@ -1,0 +1,1080 @@
+#!/usr/bin/env python
+"""Resource-lifecycle lint for datafusion_distributed_tpu (dftpu-leaks).
+
+The Rust reference gets resource cleanup for free from ownership and
+`Drop`: a RecordBatch buffer, Flight stream, or spill file cannot
+outlive its last owner. This runtime re-implements those lifecycles by
+hand (refcounted TableStore entries, spill slots, /dev/shm segments with
+cross-process refcount tokens, stream puller threads, checkpoint
+slices), so the equivalent discipline is DECLARED and statically
+enforced, exactly like the guarded-by/concurrency model
+(tools/check_concurrency.py) and tracer-safety rules.
+
+Declarations (the resource model)
+---------------------------------
+A manager class annotates its lifecycle methods with a trailing comment
+on the ``def`` line, or equivalently a class-level ``_RESOURCES`` map::
+
+    class SpillManager:
+        def write_spill(self, table, nbytes):  # acquires: spill-slot
+            ...
+        def release(self, slot):  # releases: spill-slot
+            ...
+
+    # or:  _RESOURCES = {"write_spill": "acquires: spill-slot",
+    #                    "release":     "releases: spill-slot"}
+
+``# acquires: <kind> (managed)`` declares a MANAGER-OWNED kind: callers
+are not path-checked because release is owned by the runtime sweep
+(TableStore entries are released by refcount + the query-end sweep, not
+by every put() caller). Unqualified ``acquires:`` kinds are
+CALLER-OWNED: every acquisition site is held to the path rules below.
+``# transfers: <kind>`` on a function declares that returning/yielding a
+held handle is an ownership TRANSFER to the caller, not an escape.
+
+Call-site matching is name + receiver based: ``h = pool.publish(...)``
+matches ``SegmentPool.publish`` because the receiver text contains a
+word of the declaring class's name (``pool``). That keeps generic method
+names (``acquire``, ``release``) from matching unrelated objects
+(``lock.acquire()``, ``gate.release()``).
+
+Per-query state (rule DFTPU307) is declared on the field assignment::
+
+    self._calls = {}  # per-query: swept-by sweep_query
+    self._query_peak = {}  # per-query: bounded 512
+
+Rules (DFTPU3xx; 0xx = plan verifier, 1xx = tracer safety,
+2xx = concurrency)
+------------------
+  DFTPU301  leak-on-path        a caller-owned acquired handle reaches
+                                a return / the end of the function with
+                                no release on that path (early returns
+                                included); also an acquisition whose
+                                result is discarded
+  DFTPU302  release-not-exception-safe  an intervening call between
+                                acquire and release can raise while the
+                                release is outside try/finally (or the
+                                handle is live across a bare ``raise``)
+  DFTPU303  double-release      the same handle released twice on one
+                                path
+  DFTPU304  escape-without-transfer  an acquired handle escapes via
+                                return/yield and the function carries no
+                                ``# transfers: <kind>`` annotation
+  DFTPU305  leak-on-cancel-path  DFTPU301 where the leaking exit sits on
+                                a cancel / retry / hedge-loser branch of
+                                coordinator dispatch — the branches the
+                                chaos schedules exercise
+  DFTPU306  unregistered-file-creation  spill/shm-style file creation
+                                (write-mode open, os.open, tempfile.*,
+                                os.link) in runtime/ outside a class or
+                                function that declares a resource
+                                lifecycle — every data-plane file must
+                                be registered with its manager
+  DFTPU307  unswept-per-query-growth  a per-query-keyed dict field
+                                (key expression mentions
+                                query/qid/qscope) with no
+                                ``per-query: swept-by <method>`` hook
+                                (the named sweeper must exist and touch
+                                the field) or ``per-query: bounded <N>``
+                                cap
+
+Intentional exceptions go in tools/resource_allowlist.txt
+(path::RULE::qualname  # justification — shared lint_common.py format;
+stale entries fail the gate). ``--json`` additionally emits the declared
+resource model, which runtime/leakcheck.py merges with its observed
+acquire/release log into the DFTPU_LEAK_CHECK_ARTIFACT dump.
+
+Pure stdlib AST — no jax, no device, no package import; sub-second.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from lint_common import (  # noqa: E402
+    Finding,
+    apply_allowlist,
+    load_allowlist,
+    report_text,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO_ROOT, "datafusion_distributed_tpu")
+DEFAULT_ALLOWLIST = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "resource_allowlist.txt"
+)
+
+# the annotation regexes run on TRAILING-COMMENT text only (the part
+# after '#' on a def/assign line), so they compose with guarded-by:
+#   self._x = {}  # guarded-by: _lock; per-query: bounded 512
+_ACQ_RE = re.compile(
+    r"\bacquires:\s*([a-z0-9][a-z0-9-]*)(\s*\(\s*managed\s*\))?"
+)
+_REL_RE = re.compile(r"\breleases:\s*([a-z0-9][a-z0-9-]*)")
+_TRANS_RE = re.compile(r"\btransfers:\s*([a-z0-9][a-z0-9-]*)")
+_PQ_RE = re.compile(
+    r"\bper-query:\s*(?:swept-by\s+(\w+)|bounded\s+(\d+))"
+)
+#: branch flavors whose leaked exits report as DFTPU305 (the dispatch
+#: branches seeded chaos/hedging schedules exercise) instead of 301
+_CANCELISH_RE = re.compile(
+    r"cancel|hedge|retry|loser|abandon|preempt", re.IGNORECASE
+)
+#: per-query key heuristic: the subscript key's source text names the
+#: query id space (PR 13 ids: query_id / qid; chaos query scopes: qscope)
+_QKEY_RE = re.compile(r"query|qid|qscope", re.IGNORECASE)
+
+
+def _camel_words(name: str) -> frozenset:
+    return frozenset(
+        w.lower() for w in re.findall(r"[A-Z][a-z0-9]+|[A-Z]+(?![a-z])", name)
+    ) or frozenset({name.lower()})
+
+
+@dataclass(frozen=True)
+class Acquirer:
+    kind: str
+    managed: bool
+    hints: frozenset  # receiver-name words that select this declaration
+    owner: str  # "Class.method" or module-level "func"
+
+
+@dataclass(frozen=True)
+class Releaser:
+    kind: str
+    hints: frozenset
+    owner: str
+
+
+@dataclass
+class Model:
+    """The declared package-wide resource model (pass 1 output)."""
+
+    acquirers: dict = field(default_factory=dict)  # method -> [Acquirer]
+    releasers: dict = field(default_factory=dict)  # method -> [Releaser]
+    transfers: dict = field(default_factory=dict)  # qualname(+path) -> kind
+    #: classes/functions that declared ANY lifecycle method — the
+    #: surfaces allowed to create data-plane files (DFTPU306)
+    manager_classes: set = field(default_factory=set)
+    manager_funcs: set = field(default_factory=set)
+
+    def add_acquirer(self, method: str, a: Acquirer) -> None:
+        self.acquirers.setdefault(method, []).append(a)
+
+    def add_releaser(self, method: str, r: Releaser) -> None:
+        self.releasers.setdefault(method, []).append(r)
+
+
+def _def_line_comment(src_lines: list, node) -> str:
+    """The trailing comment text of a def/assign line (annotations ride
+    the line the statement starts on)."""
+    line = src_lines[node.lineno - 1]
+    return line.split("#", 1)[1] if "#" in line else ""
+
+
+def _seg(src_lines: list, node) -> str:
+    """Best-effort source text of an expression (single line is the
+    overwhelmingly common case for keys/conditions/receivers)."""
+    try:
+        if node.lineno == node.end_lineno:
+            return src_lines[node.lineno - 1][
+                node.col_offset:node.end_col_offset
+            ]
+        return "\n".join(
+            src_lines[node.lineno - 1:node.end_lineno]
+        )
+    except Exception:
+        return ""
+
+
+# --------------------------------------------------------------------------
+# Pass 1: index the declared model
+# --------------------------------------------------------------------------
+
+def _parse_lifecycle_comment(text: str):
+    """-> ("acquires", kind, managed) | ("releases", kind, False) | None"""
+    m = _ACQ_RE.search(text)
+    if m:
+        return ("acquires", m.group(1), bool(m.group(2)))
+    m = _REL_RE.search(text)
+    if m:
+        return ("releases", m.group(1), False)
+    return None
+
+
+def _index_module(relpath: str, tree: ast.Module, src_lines: list,
+                  model: Model) -> None:
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            hints = _camel_words(node.name)
+            declared = False
+            # class-level _RESOURCES = {"method": "acquires: kind"} map
+            res_map: dict = {}
+            for stmt in node.body:
+                if (
+                    isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id == "_RESOURCES"
+                    and isinstance(stmt.value, ast.Dict)
+                ):
+                    for k, v in zip(stmt.value.keys, stmt.value.values):
+                        if (
+                            isinstance(k, ast.Constant)
+                            and isinstance(v, ast.Constant)
+                            and isinstance(k.value, str)
+                            and isinstance(v.value, str)
+                        ):
+                            res_map[k.value] = "# " + v.value
+            for stmt in node.body:
+                if not isinstance(
+                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                owner = f"{node.name}.{stmt.name}"
+                texts = [_def_line_comment(src_lines, stmt)]
+                if stmt.name in res_map:
+                    texts.append(res_map[stmt.name])
+                for text in texts:
+                    parsed = _parse_lifecycle_comment(text)
+                    if parsed is None:
+                        continue
+                    verb, kind, managed = parsed
+                    declared = True
+                    if verb == "acquires":
+                        model.add_acquirer(
+                            stmt.name,
+                            Acquirer(kind, managed, hints, owner),
+                        )
+                    else:
+                        model.add_releaser(
+                            stmt.name, Releaser(kind, hints, owner)
+                        )
+                    m = _TRANS_RE.search(text)
+                    if m:
+                        model.transfers[(relpath, owner)] = m.group(1)
+            if declared:
+                model.manager_classes.add((relpath, node.name))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            text = _def_line_comment(src_lines, node)
+            parsed = _parse_lifecycle_comment(text)
+            if parsed is not None:
+                verb, kind, managed = parsed
+                model.manager_funcs.add((relpath, node.name))
+                if verb == "acquires":
+                    model.add_acquirer(
+                        node.name,
+                        Acquirer(kind, managed, frozenset(), node.name),
+                    )
+                else:
+                    model.add_releaser(
+                        node.name, Releaser(kind, frozenset(), node.name)
+                    )
+            m = _TRANS_RE.search(text)
+            if m:
+                model.transfers[(relpath, node.name)] = m.group(1)
+
+
+# --------------------------------------------------------------------------
+# Pass 2: per-function path discipline (DFTPU301-305)
+# --------------------------------------------------------------------------
+
+def _call_attr(call: ast.Call):
+    """-> (method_name, receiver_source_node|None) for a call."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr, f.value
+    if isinstance(f, ast.Name):
+        return f.id, None
+    return None, None
+
+
+class _FuncChecker:
+    """Path-sensitive acquire/release walker over ONE function body.
+
+    Approximations (deliberate — this is a lint, not an abstract
+    interpreter): loop bodies run 0-or-1 times; acquisitions inside a
+    ``try`` are assumed to have happened when a handler runs; nested
+    ``def``/``lambda`` bodies are opaque. The allowlist absorbs the
+    residue; the seeded fixtures in tests/test_resource_lifecycle.py pin
+    what must fire."""
+
+    MAX_STATES = 32
+
+    def __init__(self, model: Model, relpath: str, qualname: str,
+                 func, src_lines: list, findings: list):
+        self.model = model
+        self.relpath = relpath
+        self.qualname = qualname
+        self.func = func
+        self.src = src_lines
+        self.findings = findings
+        self.next_rid = 0
+        self.rid_kind: dict = {}
+        self.rid_line: dict = {}
+        self.name_rid: dict = {}  # handle name -> rid (last binding)
+        self.scoped: set = set()  # rids managed by a `with` block
+        self.finally_released: set = set()  # handle NAMES released in finally
+        self.reported: set = set()  # (rid, rule) dedup
+        self.transfer_kind = model.transfers.get((relpath, qualname))
+        if self.transfer_kind is None and "." in qualname:
+            self.transfer_kind = model.transfers.get(
+                (relpath, qualname.split(".", 1)[1])
+            )
+        # a declared acquirer IS the acquiring surface for its kind: the
+        # inner acquire-call (e.g. a wrapper delegating to a module-level
+        # acquirer) hands ownership to OUR caller, who the walker checks
+        # at every call site instead
+        self.self_kinds = frozenset(
+            a.kind
+            for acqs in model.acquirers.values()
+            for a in acqs
+            if a.owner == qualname
+        )
+        self._rid_by_node: dict = {}
+
+    # -- model matching ----------------------------------------------------
+
+    def _recv_matches(self, recv, hints: frozenset) -> bool:
+        if not hints:
+            return recv is None  # module-level declaration: bare call
+        if recv is None:
+            return False
+        text = _seg(self.src, recv).lower()
+        return any(h in text for h in hints)
+
+    def match_acquire(self, call: ast.Call):
+        name, recv = _call_attr(call)
+        for a in self.model.acquirers.get(name, ()):
+            if a.kind in self.self_kinds:
+                continue
+            if self._recv_matches(recv, a.hints):
+                return a
+        return None
+
+    def match_release(self, call: ast.Call):
+        name, recv = _call_attr(call)
+        for r in self.model.releasers.get(name, ()):
+            if self._recv_matches(recv, r.hints):
+                return r
+        return None
+
+    # -- precompute --------------------------------------------------------
+
+    def _arg_names(self, call: ast.Call) -> list:
+        out = []
+        for a in list(call.args) + [k.value for k in call.keywords]:
+            for n in ast.walk(a):
+                if isinstance(n, ast.Name):
+                    out.append(n.id)
+        return out
+
+    def _precompute_finally(self) -> None:
+        for node in ast.walk(self.func):
+            if not isinstance(node, ast.Try) or not node.finalbody:
+                continue
+            for stmt in node.finalbody:
+                for call in ast.walk(stmt):
+                    if isinstance(call, ast.Call) and (
+                        self.match_release(call) is not None
+                    ):
+                        self.finally_released.update(self._arg_names(call))
+
+    # -- findings ----------------------------------------------------------
+
+    def _emit(self, rid, rule: str, line: int, msg: str) -> None:
+        if (rid, rule) in self.reported:
+            return
+        self.reported.add((rid, rule))
+        self.findings.append(Finding(
+            self.relpath, line, rule, self.qualname, msg
+        ))
+
+    # -- path walk ---------------------------------------------------------
+
+    def run(self) -> None:
+        # fast scan: does this function bind any caller-owned acquire?
+        tracked = False
+        for node in ast.walk(self.func):
+            if isinstance(node, ast.Call):
+                a = self.match_acquire(node)
+                if a is not None and not a.managed:
+                    tracked = True
+                    break
+        if not tracked:
+            return
+        self._precompute_finally()
+        self._check_exception_safety()
+        state = {"held": frozenset(), "released": frozenset()}
+        falls = self._walk(self.func.body, [state], flavor=None)
+        for st in falls:
+            self._check_exit(st, self.func.body[-1].end_lineno or 0,
+                             flavor=None, returned=None)
+
+    # DFTPU302, structural half: a release exists but sits outside any
+    # try/finally while calls between acquire and release can raise.
+    def _check_exception_safety(self) -> None:
+        acquires = []  # (line, names, kind)
+        releases = {}  # name -> [line]
+        calls = []  # (line) of every call
+        for node in ast.walk(self.func):
+            if not isinstance(node, ast.Call):
+                continue
+            calls.append(node.lineno)
+            a = self.match_acquire(node)
+            if a is not None and not a.managed:
+                names = self._binding_names(node)
+                if names:
+                    acquires.append((node.lineno, names, a.kind))
+                continue
+            r = self.match_release(node)
+            if r is not None:
+                for n in self._arg_names(node):
+                    releases.setdefault(n, []).append(node.lineno)
+        for line, names, kind in acquires:
+            if any(n in self.finally_released for n in names):
+                continue
+            rel_lines = sorted(
+                ln for n in names for ln in releases.get(n, ())
+            )
+            if not rel_lines:
+                continue  # no release at all: the path walker owns it
+            first_rel = rel_lines[0]
+            if any(line < c < first_rel for c in calls):
+                self.findings.append(Finding(
+                    self.relpath, first_rel, "DFTPU302", self.qualname,
+                    f"release of {kind} handle "
+                    f"{'/'.join(sorted(set(names)))} is not "
+                    "exception-safe: calls between the acquisition "
+                    f"(line {line}) and this release can raise and skip "
+                    "it — move the release into try/finally (or a with "
+                    "block)",
+                ))
+
+    def _binding_names(self, call: ast.Call) -> list:
+        """Names an ``x = recv.acquire(...)`` / ``a, b = ...`` statement
+        binds to the acquired handle (computed from the parent map)."""
+        parent = self._parents.get(call)
+        names: list = []
+        if isinstance(parent, ast.Assign):
+            for t in parent.targets:
+                if isinstance(t, ast.Name):
+                    names.append(t.id)
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    names.extend(
+                        e.id for e in t.elts if isinstance(e, ast.Name)
+                    )
+        elif isinstance(parent, ast.withitem):
+            v = parent.optional_vars
+            if isinstance(v, ast.Name):
+                names.append(v.id)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                names.extend(
+                    e.id for e in v.elts if isinstance(e, ast.Name)
+                )
+        return names
+
+    @property
+    def _parents(self) -> dict:
+        p = getattr(self, "_parent_map", None)
+        if p is None:
+            p = {}
+            for node in ast.walk(self.func):
+                for child in ast.iter_child_nodes(node):
+                    p[child] = node
+                    # withitem context exprs: map the call to the item
+                    if isinstance(node, ast.With):
+                        for item in node.items:
+                            p[item.context_expr] = item
+            self._parent_map = p
+        return p
+
+    def _new_rid(self, kind: str, line: int, node=None) -> int:
+        # one rid per acquire SITE (not per path state) so a call reached
+        # by several merged paths yields one finding, not one per state
+        if node is not None and node in self._rid_by_node:
+            return self._rid_by_node[node]
+        self.next_rid += 1
+        self.rid_kind[self.next_rid] = kind
+        self.rid_line[self.next_rid] = line
+        if node is not None:
+            self._rid_by_node[node] = self.next_rid
+        return self.next_rid
+
+    def _stmt_events(self, stmt, state, flavor):
+        """Apply acquire/release events of ONE simple statement to
+        ``state`` (returns the new state)."""
+        held = set(state["held"])
+        released = set(state["released"])
+        # aliasing / escape-to-structure: ``x = handle`` re-binds the
+        # handle; ``obj.attr = handle`` / ``d[k] = handle`` parks it in a
+        # structure whose owner takes over the lifecycle (the runtime
+        # harness's job, not the path walker's)
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Name):
+            rid = self.name_rid.get(stmt.value.id)
+            if rid is not None and rid in held:
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        self.name_rid[t.id] = rid
+                    elif isinstance(t, (ast.Attribute, ast.Subscript)):
+                        self.scoped.add(rid)
+        for node in ast.walk(stmt):
+            # ``yield handle``: the handle escapes to the consumer —
+            # fine under a transfers: declaration, DFTPU304 otherwise
+            if isinstance(node, (ast.Yield, ast.YieldFrom)):
+                val = getattr(node, "value", None)
+                if val is not None:
+                    for n in ast.walk(val):
+                        if not isinstance(n, ast.Name):
+                            continue
+                        rid = self.name_rid.get(n.id)
+                        if rid is None or rid not in held:
+                            continue
+                        kind = self.rid_kind[rid]
+                        if self.transfer_kind == kind:
+                            self.scoped.add(rid)
+                        else:
+                            self._emit(
+                                rid, "DFTPU304", node.lineno,
+                                f"acquired {kind} handle {n.id} escapes "
+                                "via return/yield without a "
+                                f"'# transfers: {kind}' annotation "
+                                "(ownership is ambiguous: neither this "
+                                "function nor the caller provably "
+                                "releases it)",
+                            )
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            a = self.match_acquire(node)
+            if a is not None and not a.managed:
+                names = self._binding_names(node)
+                rid = self._new_rid(a.kind, node.lineno, node)
+                if not names:
+                    self._emit(
+                        rid, "DFTPU301", node.lineno,
+                        f"acquired {a.kind} is discarded (call result "
+                        "not bound — nothing can ever release it)",
+                    )
+                    continue
+                for n in names:
+                    self.name_rid[n] = rid
+                held.add(rid)
+                continue
+            r = self.match_release(node)
+            if r is not None:
+                for n in self._arg_names(node):
+                    rid = self.name_rid.get(n)
+                    if rid is None:
+                        continue
+                    if rid in released:
+                        self._emit(
+                            rid, "DFTPU303", node.lineno,
+                            f"double release of {self.rid_kind[rid]} "
+                            f"handle {n} (first release already ran on "
+                            "this path)",
+                        )
+                    released.add(rid)
+                    held.discard(rid)
+        return {"held": frozenset(held), "released": frozenset(released)}
+
+    def _check_exit(self, state, line: int, flavor, returned) -> None:
+        """A path leaves the function: flag every still-held rid."""
+        ret_names: set = set()
+        if returned is not None:
+            for n in ast.walk(returned):
+                if isinstance(n, ast.Name):
+                    ret_names.add(n.id)
+        for rid in state["held"]:
+            if rid in self.scoped:
+                continue
+            kind = self.rid_kind[rid]
+            names = sorted(
+                n for n, r in self.name_rid.items() if r == rid
+            )
+            if any(n in self.finally_released for n in names):
+                continue
+            if any(n in ret_names for n in names):
+                if self.transfer_kind == kind:
+                    continue  # declared ownership transfer
+                self._emit(
+                    rid, "DFTPU304", line,
+                    f"acquired {kind} handle {'/'.join(names)} escapes "
+                    "via return/yield without a '# transfers: "
+                    f"{kind}' annotation (ownership is ambiguous: "
+                    "neither this function nor the caller provably "
+                    "releases it)",
+                )
+                continue
+            rule = "DFTPU305" if flavor else "DFTPU301"
+            extra = (
+                f" on the {flavor} branch (the path seeded "
+                "chaos/hedging schedules exercise)" if flavor else ""
+            )
+            self._emit(
+                rid, rule, line,
+                f"{kind} acquired at line {self.rid_line[rid]} is not "
+                f"released on this path{extra}",
+            )
+
+    def _flavor_of(self, node) -> str:
+        text = _seg(self.src, node)
+        m = _CANCELISH_RE.search(text)
+        return m.group(0).lower() if m else ""
+
+    def _walk(self, stmts, states, flavor):
+        """-> list of fall-through states after executing ``stmts``."""
+        for stmt in stmts:
+            if len(states) > self.MAX_STATES:
+                held = frozenset().union(*(s["held"] for s in states))
+                rel = frozenset().union(*(s["released"] for s in states))
+                states = [{"held": held, "released": rel}]
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested scopes are opaque
+            if isinstance(stmt, ast.Return):
+                for st in states:
+                    st = self._stmt_events(stmt, st, flavor)
+                    self._check_exit(st, stmt.lineno, flavor, stmt.value)
+                return []
+            if isinstance(stmt, ast.Raise):
+                # a raise with held, finally-unprotected handles leaks on
+                # the exception path — the structural 302 check reports
+                # the release shape; here flag only never-released rids
+                for st in states:
+                    st = self._stmt_events(stmt, st, flavor)
+                    self._check_exit(st, stmt.lineno,
+                                     flavor or "raise", None)
+                return []
+            if isinstance(stmt, ast.If):
+                f2 = self._flavor_of(stmt.test) or flavor
+                out = []
+                for st in states:
+                    st = self._stmt_events(stmt.test, st, flavor)
+                    out.extend(self._walk(list(stmt.body), [st], f2))
+                    out.extend(
+                        self._walk(list(stmt.orelse), [st], flavor)
+                    )
+                states = out
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                out = []
+                for st in states:
+                    out.append(st)  # zero iterations
+                    out.extend(self._walk(list(stmt.body), [st], flavor))
+                states = out
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                pre = []
+                for st in states:
+                    scoped_rids = []
+                    for item in stmt.items:
+                        call = item.context_expr
+                        if isinstance(call, ast.Call):
+                            a = self.match_acquire(call)
+                            if a is not None and not a.managed:
+                                names = self._binding_names(call)
+                                rid = self._new_rid(a.kind, call.lineno,
+                                                    call)
+                                for n in names:
+                                    self.name_rid[n] = rid
+                                self.scoped.add(rid)
+                                scoped_rids.append(rid)
+                                st = {
+                                    "held": st["held"] | {rid},
+                                    "released": st["released"],
+                                }
+                    body_out = self._walk(list(stmt.body), [st], flavor)
+                    for b in body_out:
+                        pre.append({
+                            "held": frozenset(
+                                b["held"] - set(scoped_rids)
+                            ),
+                            "released": frozenset(
+                                b["released"] | set(scoped_rids)
+                            ),
+                        })
+                states = pre
+                continue
+            if isinstance(stmt, ast.Try):
+                out = []
+                for st in states:
+                    body_out = self._walk(list(stmt.body), [st], flavor)
+                    handler_out = []
+                    for h in stmt.handlers:
+                        hf = (
+                            self._flavor_of(h.type) if h.type else ""
+                        ) or flavor
+                        handler_out.extend(
+                            self._walk(list(h.body), [dict(st)], hf)
+                        )
+                    merged = body_out + handler_out
+                    if stmt.orelse:
+                        merged = (
+                            self._walk(list(stmt.orelse), body_out,
+                                       flavor)
+                            + handler_out
+                        )
+                    if stmt.finalbody:
+                        fin = []
+                        for m in merged:
+                            fin.extend(
+                                self._walk(list(stmt.finalbody), [m],
+                                           flavor)
+                            )
+                        merged = fin
+                    out.extend(merged)
+                states = out
+                continue
+            # simple statement: apply its calls
+            states = [
+                self._stmt_events(stmt, st, flavor) for st in states
+            ]
+        return states
+
+
+# --------------------------------------------------------------------------
+# Pass 2b: DFTPU306 — file creation outside a declared manager
+# --------------------------------------------------------------------------
+
+_FILE_CREATORS = ("mkstemp", "mkdtemp", "NamedTemporaryFile",
+                  "TemporaryFile", "SpooledTemporaryFile")
+
+
+def _is_file_creation(call: ast.Call, src_lines: list) -> str:
+    name, recv = _call_attr(call)
+    recv_text = _seg(src_lines, recv).lower() if recv is not None else ""
+    if name == "open" and recv is None:
+        mode = None
+        if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+            mode = call.args[1].value
+        for k in call.keywords:
+            if k.arg == "mode" and isinstance(k.value, ast.Constant):
+                mode = k.value.value
+        if isinstance(mode, str) and any(c in mode for c in "wxa+"):
+            return f"open(..., {mode!r})"
+        return ""
+    if recv_text == "os" and name in ("open", "link"):
+        return f"os.{name}"
+    if recv_text == "tempfile" and name in _FILE_CREATORS:
+        return f"tempfile.{name}"
+    if name in _FILE_CREATORS and recv is None:
+        return name
+    return ""
+
+
+def _check_file_creation(relpath: str, tree: ast.Module, src_lines: list,
+                         model: Model, findings: list) -> None:
+    if f"runtime{os.sep}" not in relpath and "/runtime/" not in relpath:
+        return
+
+    def scan_func_body(func, qualname, managed):
+        for call in ast.walk(func):
+            if isinstance(call, ast.Call):
+                what = _is_file_creation(call, src_lines)
+                if what and not managed:
+                    findings.append(Finding(
+                        relpath, call.lineno, "DFTPU306", qualname,
+                        f"{what} creates a file outside a declared "
+                        "resource manager — register it with its "
+                        "manager (or annotate the owning surface with "
+                        "an acquires:/releases: lifecycle)",
+                    ))
+                    return  # one finding per function is enough
+
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            managed = (relpath, node.name) in model.manager_classes
+            if managed:
+                continue
+            for stmt in node.body:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    scan_func_body(
+                        stmt, f"{node.name}.{stmt.name}", managed
+                    )
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            managed = (relpath, node.name) in model.manager_funcs
+            if not managed:
+                scan_func_body(node, node.name, managed)
+
+
+# --------------------------------------------------------------------------
+# Pass 2c: DFTPU307 — per-query dict growth without a sweep hook
+# --------------------------------------------------------------------------
+
+def _field_annotations(cls: ast.ClassDef, src_lines: list) -> dict:
+    """-> {field: ("swept-by", method) | ("bounded", n)} from trailing
+    comments on ``self.<field> = ...`` / ``self.<field>: T = ...``
+    assignments anywhere in the class, plus class-level (dataclass)
+    field declarations like ``spans: dict = field(default_factory=dict)``."""
+    out: dict = {}
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        else:
+            continue
+        for t in targets:
+            name = None
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                name = t.attr
+            elif isinstance(t, ast.Name) and node in cls.body:
+                # class-level declaration (dataclass field)
+                name = t.id
+            if name is None:
+                continue
+            m = _PQ_RE.search(_def_line_comment(src_lines, node))
+            if m:
+                if m.group(1):
+                    out[name] = ("swept-by", m.group(1))
+                else:
+                    out[name] = ("bounded", int(m.group(2)))
+    return out
+
+
+def _sweeper_touches(sweeper, field_name: str, methods: dict,
+                     _seen=None) -> bool:
+    """Does ``sweeper`` (or any same-class method it calls through
+    ``self.<m>(...)``) reference ``self.<field_name>``? Delegation to a
+    ``_locked`` helper is the dominant idiom."""
+    if _seen is None:
+        _seen = set()
+    if sweeper.name in _seen:
+        return False
+    _seen.add(sweeper.name)
+    for n in ast.walk(sweeper):
+        if isinstance(n, ast.Attribute) and n.attr == field_name:
+            return True
+        # defensive access idiom: getattr(self, "field", None)
+        if (
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Name)
+            and n.func.id == "getattr"
+            and len(n.args) >= 2
+            and isinstance(n.args[1], ast.Constant)
+            and n.args[1].value == field_name
+        ):
+            return True
+        if (
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and isinstance(n.func.value, ast.Name)
+            and n.func.value.id == "self"
+            and n.func.attr in methods
+        ):
+            if _sweeper_touches(methods[n.func.attr], field_name,
+                                methods, _seen):
+                return True
+    return False
+
+
+def _check_per_query_growth(relpath: str, tree: ast.Module,
+                            src_lines: list, findings: list) -> None:
+    if f"runtime{os.sep}" not in relpath and "/runtime/" not in relpath:
+        return
+    for cls in tree.body:
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        annos = _field_annotations(cls, src_lines)
+        methods = {
+            s.name: s for s in cls.body
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        seen: set = set()
+        for meth in methods.values():
+            for node in ast.walk(meth):
+                field_name = None
+                key_node = None
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if (
+                            isinstance(t, ast.Subscript)
+                            and isinstance(t.value, ast.Attribute)
+                            and isinstance(t.value.value, ast.Name)
+                            and t.value.value.id == "self"
+                        ):
+                            field_name = t.value.attr
+                            key_node = t.slice
+                elif (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "setdefault"
+                    and isinstance(node.func.value, ast.Attribute)
+                    and isinstance(node.func.value.value, ast.Name)
+                    and node.func.value.value.id == "self"
+                    and node.args
+                ):
+                    field_name = node.func.value.attr
+                    key_node = node.args[0]
+                if field_name is None or key_node is None:
+                    continue
+                if not _QKEY_RE.search(_seg(src_lines, key_node)):
+                    continue
+                if field_name in seen:
+                    continue
+                seen.add(field_name)
+                qualname = f"{cls.name}.{meth.name}"
+                anno = annos.get(field_name)
+                if anno is None:
+                    findings.append(Finding(
+                        relpath, node.lineno, "DFTPU307", qualname,
+                        f"per-query keyed growth of self.{field_name} "
+                        "with no declared sweep hook or bound — a "
+                        "long-lived serving process grows it forever; "
+                        "annotate the field '# per-query: swept-by "
+                        "<method>' (and sweep it) or '# per-query: "
+                        "bounded <N>'",
+                    ))
+                elif anno[0] == "swept-by":
+                    sweeper = methods.get(anno[1])
+                    ok = sweeper is not None and _sweeper_touches(
+                        sweeper, field_name, methods
+                    )
+                    if not ok:
+                        findings.append(Finding(
+                            relpath, node.lineno, "DFTPU307", qualname,
+                            f"self.{field_name} declares 'per-query: "
+                            f"swept-by {anno[1]}' but "
+                            f"{cls.name}.{anno[1]} "
+                            + ("does not exist"
+                               if sweeper is None
+                               else "never touches the field")
+                            + " — the sweep hook is a dead annotation",
+                        ))
+
+
+# --------------------------------------------------------------------------
+# Driver
+# --------------------------------------------------------------------------
+
+def _package_files() -> list:
+    out = []
+    for root, _dirs, files in os.walk(PACKAGE):
+        for f in sorted(files):
+            if f.endswith(".py"):
+                out.append(os.path.join(root, f))
+    return sorted(out)
+
+
+def _parse_all(files=None) -> list:
+    """-> [(relpath, tree, src_lines)] parsed ONCE and shared by both
+    passes (parsing dominates the lint's runtime)."""
+    out = []
+    for path in files or _package_files():
+        relpath = os.path.relpath(path, REPO_ROOT)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                src = f.read()
+            tree = ast.parse(src, filename=path)
+        except (OSError, SyntaxError):
+            continue
+        out.append((relpath, tree, src.splitlines()))
+    return out
+
+
+def build_model(files=None, parsed=None) -> Model:
+    """Pass-1 only: the declared resource model (runtime/leakcheck.py
+    loads this for its merged static-vs-observed artifact)."""
+    model = Model()
+    for relpath, tree, src_lines in parsed or _parse_all(files):
+        _index_module(relpath, tree, src_lines, model)
+    return model
+
+
+def declared_model_json(model: Model = None) -> dict:
+    model = model or build_model()
+    kinds: dict = {}
+    for lst in model.acquirers.values():
+        for a in lst:
+            k = kinds.setdefault(
+                a.kind,
+                {"acquirers": [], "releasers": [], "managed": False},
+            )
+            k["acquirers"].append(a.owner)
+            k["managed"] = k["managed"] or a.managed
+    for lst in model.releasers.values():
+        for r in lst:
+            kinds.setdefault(
+                r.kind,
+                {"acquirers": [], "releasers": [], "managed": False},
+            )["releasers"].append(r.owner)
+    for k in kinds.values():
+        k["acquirers"] = sorted(set(k["acquirers"]))
+        k["releasers"] = sorted(set(k["releasers"]))
+    return kinds
+
+
+def analyze(files=None):
+    """-> (findings, model). Pure — no allowlist, no I/O besides reads."""
+    parsed = _parse_all(files)
+    model = build_model(parsed=parsed)
+    findings: list = []
+    for relpath, tree, src_lines in parsed:
+
+        def check_func(func, qualname):
+            _FuncChecker(
+                model, relpath, qualname, func, src_lines, findings
+            ).run()
+
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                check_func(node, node.name)
+            elif isinstance(node, ast.ClassDef):
+                for stmt in node.body:
+                    if isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        check_func(stmt, f"{node.name}.{stmt.name}")
+        _check_file_creation(relpath, tree, src_lines, model, findings)
+        _check_per_query_growth(relpath, tree, src_lines, findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, model
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Resource-lifecycle lint (DFTPU301-307)"
+    )
+    ap.add_argument("files", nargs="*",
+                    help="specific files (default: whole package)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings + declared model")
+    ap.add_argument("--allowlist", default=DEFAULT_ALLOWLIST)
+    args = ap.parse_args(argv)
+
+    files = [os.path.abspath(f) for f in args.files] or None
+    findings, model = analyze(files)
+    allow = load_allowlist(args.allowlist)
+    violations, allowed, stale = apply_allowlist(
+        findings, allow, check_stale=not args.files
+    )
+    if args.json:
+        print(json.dumps({
+            "violations": [f.__dict__ for f in violations],
+            "allowed": [f.__dict__ for f in allowed],
+            "stale": ["::".join(k) for k in stale],
+            "model": declared_model_json(model),
+        }, indent=2, sort_keys=True))
+        return 1 if (violations or stale) else 0
+    n_files = len(files) if files else len(_package_files())
+    return report_text(
+        violations, allowed, stale, args.allowlist, REPO_ROOT,
+        "resource-lifecycle", n_files,
+    )
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
