@@ -109,6 +109,8 @@ class Console:
                       lambda: self._render_serving(lines, shared))
         self._section(lines, "robustness",
                       lambda: self._render_robustness(lines))
+        self._section(lines, "result cache",
+                      lambda: self._render_result_cache(lines, shared))
         self._section(lines, "data plane",
                       lambda: self._render_data_plane(lines, shared))
         self._section(lines, "telemetry",
@@ -268,6 +270,52 @@ class Console:
                 )
             lines.append(line)
 
+    def _render_result_cache(self, lines: list, shared: dict) -> None:
+        """Result/sub-plan cache line: hit/miss totals with a hit-rate
+        sparkline (fed through the telemetry sample below — the ring
+        records at most one point per frame), live bytes vs budget, and
+        spill/invalidation counters. Quiet (no line) until the cache
+        sees traffic, like the robustness panel."""
+        rcs = self.obs.get_result_cache()
+        cache = rcs.get("cache") or {}
+        shared["rc"] = cache
+        if cache.get("error") or not (
+                cache.get("hits") or cache.get("misses")
+                or cache.get("entries")):
+            return
+        rate = cache.get("hit_rate")
+        line = (
+            f"\n{_BOLD}result cache{_RESET}  "
+            f"{cache.get('hits', 0)} hits / "
+            f"{cache.get('misses', 0)} misses"
+        )
+        spark = self.history.sparkline("rc_hit_rate")
+        if spark:
+            line += f"  hit-rate {spark}"
+        if rate is not None:
+            line += f" {rate * 100:.0f}%"
+        line += (
+            f"  {_fmt_bytes(cache.get('nbytes', 0))} in "
+            f"{cache.get('entries', 0)}+{cache.get('subplan_entries', 0)} "
+            f"entries"
+        )
+        extras = []
+        if cache.get("budget_bytes"):
+            extras.append(f"budget {_fmt_bytes(cache['budget_bytes'])}")
+        if cache.get("spills"):
+            extras.append(
+                f"spilled {_fmt_bytes(cache.get('spilled_nbytes', 0))} "
+                f"({cache.get('refaults', 0)} refaults)"
+            )
+        if cache.get("invalidations"):
+            extras.append(f"{cache['invalidations']} invalidations")
+        sp = rcs.get("subplan", {})
+        if sp.get("stages_restored"):
+            extras.append(f"{sp['stages_restored']} stages restored")
+        if extras:
+            line += f"  {_DIM}" + ", ".join(extras) + _RESET
+        lines.append(line)
+
     def _render_data_plane(self, lines: list, shared: dict) -> None:
         dp = shared.get("dp", {})
         if dp.get("entries") or dp.get("peak_nbytes"):
@@ -307,6 +355,7 @@ class Console:
                        if lat.get("p99") is not None else None),
             "staged_bytes": dp.get("nbytes"),
             "faults": sum(faults.values()) if faults else 0,
+            "rc_hit_rate": (shared.get("rc") or {}).get("hit_rate"),
         })
         if len(self.history) < 2:
             return  # nothing to trend yet (first frame / empty tier)

@@ -388,6 +388,30 @@ class CheckpointStore:
         return out
 
 
+def exchange_fingerprints(plan) -> dict:
+    """{stage_id: fingerprint-or-None} over a plan's PRISTINE exchange
+    subtrees, pre-hoist — literal values are structural, so two queries
+    differing only in literals can never share a stage snapshot. Shared
+    by `QueryCheckpointer.begin_execute` (intra-query checkpoint keys)
+    and the cross-query sub-plan cache (runtime/result_cache.py), so
+    the two tiers' keys can never drift."""
+    from datafusion_distributed_tpu.plan.fingerprint import (
+        plan_fingerprint,
+    )
+
+    fps: dict = {}
+    try:
+        exchanges = plan.collect(
+            lambda n: getattr(n, "is_exchange", False)
+        )
+    except Exception:
+        exchanges = []
+    for node in exchanges:
+        sid = node.stage_id if node.stage_id is not None else 0
+        fps[sid] = plan_fingerprint(node)
+    return fps
+
+
 class QueryCheckpointer:
     """Per-query facade installed as `Coordinator.checkpoints`: binds one
     store record to one cluster and tracks the execute-call sequence so
@@ -411,25 +435,9 @@ class QueryCheckpointer:
 
     def begin_execute(self, plan) -> None:
         """Stamp a new execute() and fingerprint its pristine exchange
-        subtrees (pre-hoist, so literal values are structural — two
-        queries differing only in literals can never share a stage
-        checkpoint)."""
-        from datafusion_distributed_tpu.plan.fingerprint import (
-            plan_fingerprint,
-        )
-
+        subtrees (pre-hoist — see `exchange_fingerprints`)."""
         self._exec_index += 1
-        fps: dict = {}
-        try:
-            exchanges = plan.collect(
-                lambda n: getattr(n, "is_exchange", False)
-            )
-        except Exception:
-            exchanges = []
-        for node in exchanges:
-            sid = node.stage_id if node.stage_id is not None else 0
-            fps[sid] = plan_fingerprint(node)
-        self._stage_fps = fps
+        self._stage_fps = exchange_fingerprints(plan)
 
     def stage_fingerprint(self, stage_id: int) -> Optional[str]:
         return self._stage_fps.get(stage_id)

@@ -447,6 +447,13 @@ class Coordinator:
     # exchange boundary snapshots its consumer slices on completion and
     # restores them — fingerprint-validated — on a resumed execute
     checkpoints: "object" = None
+    # cross-query result/sub-plan cache (runtime/result_cache.py
+    # ResultCache): when set, materialized exchange boundaries save
+    # their frontier under the subtree's pre-hoist fingerprint and a
+    # LATER query sharing that prefix restores it instead of
+    # re-executing the producer stage (checkpoint restore, which is
+    # intra-query and validates against worker slices, wins first)
+    result_cache: "object" = None
     # measured peak staged bytes attributed to this coordinator's
     # executes across the workers' TableStores (harvested by
     # sweep_query): the MEASURED side of the serving tier's
@@ -611,6 +618,14 @@ class Coordinator:
                 self.checkpoints.begin_execute(plan)
             except Exception:
                 self.checkpoints = None  # never fail the query for it
+        if self.result_cache is not None:
+            # stamp this execute's pre-hoist exchange fingerprints so
+            # boundaries can restore frontiers a PRIOR query produced
+            # (cross-query sub-plan sharing, runtime/result_cache.py)
+            try:
+                self.result_cache.begin_query(query_id, plan)
+            except Exception:
+                self.result_cache = None  # never fail the query for it
         # pin this query's spans against the shared store's LRU for as
         # long as it runs (runtime/metrics.py begin/finish_query)
         self.stage_metrics.begin_query(query_id)
@@ -793,6 +808,13 @@ class Coordinator:
                 pass  # departed worker: its attribution died with it
         if peak > self.staged_peak_bytes:
             self.staged_peak_bytes = peak
+        if self.result_cache is not None:
+            # shed this execute's sub-plan fingerprint map (the cached
+            # frontiers themselves stay — they are the cross-query point)
+            try:
+                self.result_cache.end_query(query_id)
+            except Exception:
+                pass
         # list() snapshots are taken in C (no GIL release) so sweeping one
         # query never races another in-flight query's inserts
         for key in [k for k in list(self.metrics) if k.query_id == query_id]:
@@ -1275,10 +1297,16 @@ class Coordinator:
             )
             if restored is not None:
                 return restored
+            restored = self._restore_subplan_cache(
+                plan, producer, query_id, stage_id
+            )
+            if restored is not None:
+                return restored
             scan = self._materialize_exchange_body(
                 plan, producer, query_id, stage_id, t_prod
             )
             self._save_stage_checkpoint(query_id, stage_id, t_prod, scan)
+            self._save_subplan_cache(query_id, stage_id, t_prod, scan)
             return scan
 
     # -- query checkpoint/resume (runtime/checkpoint.py) ---------------------
@@ -1349,6 +1377,63 @@ class Coordinator:
                 "checkpoint_saved", stage=stage_id,
                 slices=len(scan.tasks), bytes=staged,
             )
+
+    # -- cross-query sub-plan cache (runtime/result_cache.py) -----------------
+    def _restore_subplan_cache(self, plan, producer, query_id: str,
+                               stage_id: int):
+        """Consumer-side scan rebuilt from a frontier a PRIOR query
+        cached under this exchange subtree's pre-hoist fingerprint, or
+        None. Slices come from the cache's own store (never a worker),
+        so a restore is correct under any membership churn. Shares the
+        checkpoint tier's eligibility gate: an adaptive coordinator's
+        runtime-derived lattices opt out of both."""
+        rc = self.result_cache
+        if rc is None or not self._checkpoint_eligible():
+            return None
+        try:
+            hit = rc.restore_subplan(query_id, stage_id)
+        except Exception:
+            return None  # cache trouble must never fail the query
+        if hit is None:
+            return None
+        slices, replicated, pinned, _t_prod = hit
+        scan = MemoryScanExec(slices, producer.schema(), pinned=pinned,
+                              replicated=replicated)
+        self.faults.bump("subplan_cache_stages_restored")
+        self._event("subplan_cache_restored", stage=stage_id,
+                    slices=len(slices))
+        self.stream_metrics[(query_id, stage_id)] = {
+            "plane": "result-cache",
+            "coordinator_bytes": 0,
+            "partitions": len(slices),
+        }
+        self._seed_consumer_scan(plan, scan)
+        return scan
+
+    def _save_subplan_cache(self, query_id: str, stage_id: int,
+                            t_prod: int, scan) -> None:
+        """Offer a just-materialized boundary to the cross-query cache.
+        Same guards as `_save_stage_checkpoint`: only MemoryScan
+        results (a peer-plane boundary never materialized here) and
+        never a bailed-out boundary (its widened-capacity annotation
+        dies with the scan)."""
+        rc = self.result_cache
+        if rc is None or not self._checkpoint_eligible():
+            return
+        if type(scan) is not MemoryScanExec:
+            return
+        if getattr(scan, "bailout_raw_rows", False):
+            return
+        try:
+            staged = rc.save_subplan(
+                query_id, stage_id, list(scan.tasks), scan.replicated,
+                scan.pinned, t_prod,
+            )
+        except Exception:
+            return
+        if staged is not None:
+            self._event("subplan_cache_saved", stage=stage_id,
+                        slices=len(scan.tasks), bytes=staged)
 
     def _materialize_exchange_body(
         self, plan: ExecutionPlan, producer: ExecutionPlan, query_id: str,

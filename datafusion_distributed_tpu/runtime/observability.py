@@ -99,7 +99,8 @@ class ObservabilityService:
 
     def __init__(self, resolver, channels, sample_system: bool = False,
                  health=None, fault_counters=None, serving=None,
-                 trace_store=None, checkpoints=None, telemetry=None):
+                 trace_store=None, checkpoints=None, telemetry=None,
+                 result_cache=None):
         self.resolver = resolver
         self.channels = channels
         self.health = health
@@ -108,6 +109,10 @@ class ObservabilityService:
         # checkpoint store (runtime/checkpoint.py) surfaced by
         # get_robustness; falls back to the wired serving session's store
         self.checkpoints = checkpoints
+        # result/sub-plan cache (runtime/result_cache.py) surfaced by
+        # get_result_cache; falls back to the wired serving session's
+        # context cache
+        self.result_cache = result_cache
         # distributed-tracing store surfaced by get_trace_summary (None =
         # the process-wide default, runtime/tracing.py)
         self.trace_store = trace_store
@@ -311,6 +316,57 @@ class ObservabilityService:
                 out["checkpoint"]["store"] = store.stats()
             except Exception as e:
                 out["checkpoint"]["store"] = {"error": str(e)}
+        return out
+
+    def get_result_cache(self) -> dict:
+        """Fingerprint-keyed result/sub-plan cache counters
+        (runtime/result_cache.py): hit/miss/fill totals for both tiers,
+        invalidation count, live bytes vs budget, and spill/refault
+        accounting from the cache's backing TableStore — resolved from
+        the wired cache directly or through the serving session's
+        SessionContext. Sub-plan restore totals come from the wired
+        FaultCounters (``subplan_cache_stages_restored``). Per-worker
+        rows report each worker store's spill/refault counters (the
+        layer cached frontiers bypass) and degrade like
+        `get_data_plane`: an unreachable worker contributes an error
+        entry and the rest still answer. Empty ``cache`` sub-dict
+        without wiring — same degradation contract as get_robustness."""
+        fc = (
+            self.fault_counters.as_dict()
+            if self.fault_counters is not None else {}
+        )
+        out: dict = {
+            "subplan": {
+                "stages_restored": fc.get("subplan_cache_stages_restored",
+                                          0),
+            },
+            "cache": {},
+        }
+        rc = self.result_cache
+        if rc is None and self.serving is not None:
+            ctx = getattr(self.serving, "ctx", None)
+            rc = getattr(ctx, "_result_cache", None)
+        if rc is not None:
+            try:
+                out["cache"] = rc.stats()
+            except Exception as e:
+                out["cache"] = {"error": str(e)}
+        workers: dict = {}
+        for url in self.resolver.get_urls():
+            try:
+                info = self.channels.get_worker(url).get_info()
+            except Exception as e:
+                workers[url] = {"error": str(e)}
+                continue
+            stats = info.get("store")
+            if not isinstance(stats, dict):
+                continue
+            workers[url] = {
+                k: int(stats.get(k, 0))
+                for k in ("spills", "refaults", "spilled_nbytes",
+                          "spill_files")
+            }
+        out["workers"] = workers
         return out
 
     def get_task_progress(self, keys) -> dict:

@@ -149,6 +149,10 @@ class QueryHandle:
         # BEFORE the cancel event fires so _drive classifies the
         # resulting TaskCancelledError as preemption, not a user cancel
         self._preempted = False
+        # served from the result cache (runtime/result_cache.py): such
+        # a query reserved NO admission budget and its ~0-byte "peak"
+        # must never pollute the measured-bytes re-cost history
+        self._cache_hit = False
         # measured peak staged bytes (TableStore attribution summed
         # across workers), harvested when the query resolves — the
         # measured side of the est_bytes admission loop
@@ -706,6 +710,7 @@ class ServingSession:
             self.health.telemetry_families,
             self._serving_families,
             self._slo_families,
+            self._result_cache_families,
             default_event_log().telemetry_families,
             lambda: self.query_latency.telemetry_families(
                 "dftpu_query_latency_seconds",
@@ -773,6 +778,18 @@ class ServingSession:
                    [({}, completed.get(PREEMPTED, 0))]),
         ]
 
+    def _result_cache_families(self) -> list:
+        """`dftpu_result_cache_*` families when the session context has
+        ever created a cache (knob-on), eagerly zero-valued from its
+        first snapshot; empty while the tier is off."""
+        rc = getattr(self.ctx, "_result_cache", None)
+        if rc is None:
+            return []
+        try:
+            return rc.telemetry_families()
+        except Exception:
+            return []
+
     def _slo_families(self) -> list:
         return self.slo.telemetry_families(
             p99_target_ms=self._opt("slo_p99_ms", None),
@@ -835,6 +852,15 @@ class ServingSession:
                 "serving submit requires a SELECT statement "
                 "(DDL/SET-only scripts have no result to serve)"
             )
+        # result-cache fast path (runtime/result_cache.py): consult the
+        # whole-result cache BEFORE costing — a hit resolves on the
+        # client thread with est_bytes=0, reserving NO admission budget
+        # and no queue slot for execution it will skip (the bursty-
+        # serving fast path; resumed queries always re-execute)
+        if _resume is None:
+            hit = self._cache_fast_path(sql, df, priority)
+            if hit is not None:
+                return hit
         # the admission footprint: the single-node physical plan's
         # device-buffer bound — the same plan_device_bytes estimate the
         # overflow-retry budget guard keys on (sql/context.py). Planning
@@ -855,6 +881,55 @@ class ServingSession:
             self._queued.append(handle)
             self._admit_locked()
         return handle
+
+    def _result_cache(self):
+        """The session context's ResultCache (None when the knob is
+        off, or when the context predates the surface)."""
+        try:
+            return self.ctx.result_cache()
+        except AttributeError:
+            return None
+
+    def _cache_fast_path(self, sql: str, df, priority: int):
+        """A resolved QueryHandle served by reference from the
+        whole-result cache, or None (cache off / miss / unkeyable).
+        The handle never touches admission: it is admitted+done in one
+        step, charged zero budget, and excluded from re-cost history."""
+        rc = self._result_cache()
+        if rc is None:
+            return None
+        try:
+            key = df._result_cache_key(self.num_tasks)
+        except Exception:
+            key = None
+        if key is None:
+            return None
+        cached = rc.lookup(key)
+        if cached is None:
+            return None
+        h = QueryHandle(self, sql, df, priority, 0)
+        h._cache_hit = True
+        h.admitted_s = time.monotonic()
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("serving session is closed")
+            self._admitted_total += 1
+            self._completed[DONE] = self._completed.get(DONE, 0) + 1
+        h._finish(DONE, result=cached)
+        wall = h.wall_s()
+        if wall is not None:
+            self.query_latency.record(wall)
+            self.slo.record(wall, ok=True)
+        from datafusion_distributed_tpu.runtime.eventlog import log_event
+
+        log_event("query_admitted", serving_query_id=h.query_id,
+                  priority=h.priority, est_bytes=0, cache_hit=True,
+                  queue_wait_s=0.0)
+        log_event("query_done", serving_query_id=h.query_id,
+                  cache_hit=True, priority=h.priority,
+                  wall_s=round(wall, 6) if wall is not None else None)
+        self.history.sample(self.telemetry)
+        return h
 
     # -- admission control --------------------------------------------------
     def _recost_locked(self, h: QueryHandle) -> int:
@@ -971,6 +1046,7 @@ class ServingSession:
             on_query_end=on_query_end,
             hedges=self.hedge_budget,
             checkpoints=checkpointer,
+            result_cache=self._result_cache(),
         )
         return coord
 
@@ -990,6 +1066,11 @@ class ServingSession:
             out = h._df.collect_coordinated_table(
                 coordinator=coord, num_tasks=self.num_tasks
             )
+            if getattr(coord, "last_query_id", None) is None:
+                # the coordinator never executed: the result cache
+                # served this query while it sat in the queue (or a
+                # concurrent identical submission's single-flight fill)
+                h._cache_hit = True
             h._finish(DONE, result=out)
         except TaskCancelledError as e:
             if h._preempted:
@@ -1016,7 +1097,10 @@ class ServingSession:
             # runs) re-cost future admissions of this SQL from it
             peak = int(getattr(coord, "staged_peak_bytes", 0) or 0)
             h.peak_staged_bytes = peak
-            if h._state == DONE and peak > 0:
+            # cache-served completions never update the measured-bytes
+            # history: their ~0-byte "peak" would poison the re-cost
+            # loop into under-admitting the next COLD run of this SQL
+            if h._state == DONE and peak > 0 and not h._cache_hit:
                 with self._lock:
                     self._measured_bytes[h.sql] = peak
                     while len(self._measured_bytes) > 256:
@@ -1048,7 +1132,7 @@ class ServingSession:
                 f"query_{h._state}", serving_query_id=h.query_id,
                 query_id=getattr(coord, "last_query_id", None),
                 wall_s=round(wall, 6) if wall is not None else None,
-                priority=h.priority,
+                priority=h.priority, cache_hit=h._cache_hit,
             )
             with self._lock:
                 self._running.pop(h.query_id, None)
@@ -1310,6 +1394,9 @@ class ServingSession:
         out["slo"] = self.slo_snapshot()
         if self.checkpoints is not None:
             out["checkpoints"] = self.checkpoints.stats()
+        rc = getattr(self.ctx, "_result_cache", None)
+        if rc is not None:
+            out["result_cache"] = rc.stats()
         return out
 
     # -- lifecycle ----------------------------------------------------------

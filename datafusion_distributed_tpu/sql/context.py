@@ -264,6 +264,16 @@ class SessionConfig:
                         "checkpoint_budget_bytes must be >= 0 (0 = "
                         "uncapped)"
                     )
+            elif key == "result_cache_budget_bytes":
+                # ResultCache byte budget (runtime/result_cache.py):
+                # cold entries past it SPILL (SpillManager) instead of
+                # evicting, and refault byte-exactly on the next hit
+                value = float(value)
+                if value < 0:
+                    raise ValueError(
+                        "result_cache_budget_bytes must be >= 0 (0 = "
+                        "unlimited)"
+                    )
             elif key == "serving_stage_slots":
                 value = int(value)
                 if value < 0:
@@ -274,7 +284,7 @@ class SessionConfig:
             elif key in ("fair_share", "zero_copy", "hedging",
                          "checkpointing", "pipelined_shuffle",
                          "partial_agg_pushdown", "multiway_join",
-                         "global_hash_agg"):
+                         "global_hash_agg", "result_cache"):
                 # boolean knobs: fair_share (serving scheduler policy),
                 # zero_copy (view-based data plane — `off` restores the
                 # copying plane everywhere), hedging (straggler
@@ -286,7 +296,9 @@ class SessionConfig:
                 # (fuse key-compatible join chains into one stage,
                 # deleting intermediate shuffles), global_hash_agg
                 # (high-NDV aggregation as one shared hash table instead
-                # of per-partition tables + merge). One shared parser so
+                # of per-partition tables + merge), result_cache
+                # (fingerprint-keyed whole-result + sub-plan reuse —
+                # runtime/result_cache.py). One shared parser so
                 # SET-time coercion and runtime reads can't drift.
                 from datafusion_distributed_tpu.ops.table import (
                     parse_bool_knob,
@@ -753,6 +765,32 @@ class DataFrame:
             cfg = replace(cfg, size_tasks_to_data=True)
         return cfg
 
+    def _result_cache_key(self, num_tasks: int):
+        """Whole-result cache key for this query at the session's live
+        configuration (plan/fingerprint.py result_cache_key): the
+        post-hoist staged-plan fingerprint + literal parameter vectors,
+        extended with the full PlannerConfig snapshot, the catalog
+        generation, and the task profile (f32 sums are only bitwise-
+        reproducible under an identical task split, so a profile change
+        must miss). None when caching cannot apply (unfingerprintable
+        plan — e.g. unresolved scalar subqueries)."""
+        from datafusion_distributed_tpu.plan.fingerprint import (
+            result_cache_key,
+        )
+
+        try:
+            plan = self.distributed_plan(
+                num_tasks, self._seeded_host_config(num_tasks),
+                self.ctx.config.planner,
+            )
+            return result_cache_key(plan, extra=(
+                self._pcfg_key(self.ctx.config.planner),
+                self.ctx.catalog.generation,
+                int(num_tasks),
+            ))
+        except Exception:
+            return None
+
     def collect_coordinated_table(
         self,
         coordinator=None,
@@ -765,7 +803,39 @@ class DataFrame:
         ``coordinator`` an in-memory cluster of ``num_workers`` is spun up —
         the reference's InMemoryChannelResolver rung its whole TPC suite
         runs on (`tpch_correctness_test.rs:23-80`). ``adaptive=True`` uses
-        the AdaptiveCoordinator (dynamic_task_count analogue)."""
+        the AdaptiveCoordinator (dynamic_task_count analogue).
+
+        With `SET distributed.result_cache` on, the whole-result cache
+        is consulted FIRST (runtime/result_cache.py): a hit returns the
+        staged result by reference — no cluster, no coordinator, no
+        execution, zero new XLA traces. Concurrent submissions of one
+        key single-flight: one executes, the rest block for its fill."""
+        rc = self.ctx.result_cache()
+        key = self._result_cache_key(num_tasks) if rc is not None else None
+        if key is None:
+            return self._collect_coordinated_uncached(
+                coordinator, num_workers, num_tasks, adaptive
+            )
+        state, cached = rc.begin(key)
+        if state == "hit":
+            return cached
+        try:
+            out = self._collect_coordinated_uncached(
+                coordinator, num_workers, num_tasks, adaptive
+            )
+        except BaseException:
+            rc.fail(key)
+            raise
+        rc.fill(key, out)
+        return out
+
+    def _collect_coordinated_uncached(
+        self,
+        coordinator=None,
+        num_workers: int = 2,
+        num_tasks: int = 4,
+        adaptive: bool = False,
+    ) -> Table:
         from datafusion_distributed_tpu.runtime.coordinator import (
             AdaptiveCoordinator,
             Coordinator,
@@ -780,6 +850,11 @@ class DataFrame:
                 config_options=self.ctx.config.distributed_snapshot(),
                 passthrough_headers=dict(self.ctx.config.passthrough_headers),
             )
+        if getattr(coordinator, "result_cache", None) is None:
+            # cross-query sub-plan frontier sharing rides the same
+            # coordinator hook as checkpoint restore (None when the
+            # result_cache knob is off)
+            coordinator.result_cache = self.ctx.result_cache()
         pcfg = self.ctx.config.planner
         dcfg = self._seeded_host_config(num_tasks)
         last_err: Optional[Exception] = None
@@ -905,8 +980,42 @@ class SessionContext:
         # threads against this one cache.
         self._plans: dict = {}
         self._plans_lock = threading.Lock()
+        # fingerprint-keyed whole-result + sub-plan cache (runtime/
+        # result_cache.py), created lazily on the first consult with
+        # `SET distributed.result_cache` on; _plans_lock guards creation
+        self._result_cache = None  # guarded-by: _plans_lock
 
     _PLAN_CACHE_ENTRIES = 128
+
+    def result_cache(self):
+        """The session's ResultCache when `SET distributed.result_cache`
+        is on, else None. Every consult reconciles the cache with the
+        live catalog generation (lazy invalidation — covers table
+        registrations that bypassed SessionContext.register_table) and
+        the `result_cache_budget_bytes` knob."""
+        from datafusion_distributed_tpu.ops.table import parse_bool_knob
+
+        opts = self.config.distributed_options
+        try:
+            if not parse_bool_knob(opts.get("result_cache", False)):
+                return None
+        except ValueError:
+            return None
+        rc = self._result_cache
+        if rc is None:
+            from datafusion_distributed_tpu.runtime.result_cache import (
+                ResultCache,
+            )
+
+            with self._plans_lock:
+                rc = self._result_cache
+                if rc is None:
+                    rc = self._result_cache = ResultCache()
+        rc.sync(
+            generation=self.catalog.generation,
+            budget_bytes=opts.get("result_cache_budget_bytes", 0),
+        )
+        return rc
 
     def _plan_cache_get(self, key):
         with self._plans_lock:
@@ -931,13 +1040,20 @@ class SessionContext:
             paths = [paths]
         tables = [pq.read_table(p) for p in paths]
         arrow = pa.concat_tables(tables) if len(tables) > 1 else tables[0]
-        self.catalog.register_table(name, arrow_to_table(arrow, capacity=capacity))
+        self.register_table(name, arrow_to_table(arrow, capacity=capacity))
 
     def register_arrow(self, name: str, arrow_table, capacity=None):
-        self.catalog.register_table(name, arrow_to_table(arrow_table, capacity))
+        self.register_table(name, arrow_to_table(arrow_table, capacity))
 
     def register_table(self, name: str, table: Table):
         self.catalog.register_table(name, table)
+        rc = self._result_cache
+        if rc is not None:
+            # eager half of result-cache invalidation: the generation
+            # bump above makes every cached entry (whole-result AND
+            # sub-plan frontier) stale — drop them NOW so a post-update
+            # query can never be served pre-update rows
+            rc.invalidate_generation(self.catalog.generation)
 
     # -- SQL ------------------------------------------------------------------
     def sql(self, query: str) -> DataFrame:

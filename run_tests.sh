@@ -270,6 +270,23 @@ if ! python -m pytest tests/test_multiway_join.py \
         -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
     FAILED+=("tests/test_multiway_join.py[gate]")
 fi
+# Result-cache gate (tests/test_result_cache.py): the fingerprint-keyed
+# whole-result + sub-plan cache (runtime/result_cache.py) — hit/miss/
+# LRU/spill-refault unit arms, literal-variant correctness, PlannerConfig
+# and catalog-generation key misses, register_table invalidation (no
+# stale reads), sub-plan prefix reuse across distinct queries, TPC-H
+# byte-identity cache-on vs cache-off (incl. seeded chaos + membership
+# churn), zero new XLA traces on a hit, and the 8-thread serving
+# stampede (concurrent identical submissions execute once). Runs under
+# DFTPU_LOCK_CHECK=1 + strict leak sweeps: the single-flight Condition
+# and the cache's unattributed store entries are exactly what the two
+# harnesses exist to police.
+echo "=== tests/test_result_cache.py (result-cache gate, DFTPU_LOCK_CHECK=1 DFTPU_LEAK_CHECK=strict)"
+if ! env DFTPU_LOCK_CHECK=1 DFTPU_LEAK_CHECK=strict python -m pytest tests/test_result_cache.py \
+        -q --no-header \
+        -p no:cacheprovider "${MARKER_ARGS[@]}" "$@"; then
+    FAILED+=("tests/test_result_cache.py[gate+lockcheck]")
+fi
 for f in tests/test_*.py; do
     [ "$f" = "tests/test_memory_pressure.py" ] && continue  # ran above
     [ "$f" = "tests/test_multiway_join.py" ] && continue  # ran above (gate)
@@ -285,6 +302,7 @@ for f in tests/test_*.py; do
     [ "$f" = "tests/test_data_plane.py" ] && continue  # ran above (gate)
     [ "$f" = "tests/test_shm_plane.py" ] && continue  # ran above (gate)
     [ "$f" = "tests/test_adaptivity.py" ] && continue  # ran above (gate)
+    [ "$f" = "tests/test_result_cache.py" ] && continue  # ran above (gate)
     echo "=== $f"
     if ! python -m pytest "$f" -q --no-header -p no:cacheprovider \
             "${MARKER_ARGS[@]}" "$@"; then

@@ -32,7 +32,7 @@ if "xla_force_host_platform_device_count" not in flags:
 # setdefault: DFTPU_LOCK_CHECK=0 still opts a run out explicitly.
 _LOCKCHECK_SUITES = ("test_serving", "test_stage_scheduler",
                      "test_data_plane", "test_shm_plane",
-                     "test_adaptivity")
+                     "test_adaptivity", "test_result_cache")
 if any(s in a for a in sys.argv for s in _LOCKCHECK_SUITES):
     os.environ.setdefault("DFTPU_LOCK_CHECK", "1")
 # Resource-leak harness (runtime/leakcheck.py): the suites whose seeded
@@ -42,7 +42,8 @@ if any(s in a for a in sys.argv for s in _LOCKCHECK_SUITES):
 # acquisition stack). setdefault: DFTPU_LEAK_CHECK=0 still opts out.
 _LEAKCHECK_SUITES = ("test_serving", "test_data_plane",
                      "test_pipelined_shuffle", "test_memory_pressure",
-                     "test_hedging_recovery", "test_resource_lifecycle")
+                     "test_hedging_recovery", "test_resource_lifecycle",
+                     "test_result_cache")
 if any(s in a for a in sys.argv for s in _LEAKCHECK_SUITES):
     os.environ.setdefault("DFTPU_LEAK_CHECK", "strict")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

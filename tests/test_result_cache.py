@@ -1,0 +1,514 @@
+"""Result-cache gate (wired into run_tests.sh): the fingerprint-keyed
+whole-result + sub-plan cache (runtime/result_cache.py).
+
+Contracts pinned here:
+
+- Whole-result hits are byte-identical to cold execution (same Table by
+  reference through the zero-copy TableStore) and perform ZERO new XLA
+  traces — including right after flipping `SET distributed.result_cache`
+  on over a warm program cache.
+- The key carries the hoisted-literal parameter vector (a q6 discount
+  variant is never served another variant's rows), the full
+  PlannerConfig snapshot, and the catalog generation: mutating any of
+  them misses; `register_table` on a cached input invalidates eagerly
+  (no stale reads).
+- Byte-budgeted LRU: entries past `result_cache_budget_bytes` SPILL via
+  the store's SpillManager instead of evicting, refault byte-exactly on
+  the next hit, and recency (a lookup) protects an entry from being the
+  spill victim. `clear()` leaves zero entries and zero spill files.
+- Sub-plan tier: two distinct queries sharing an exchange-subtree
+  prefix reuse the first query's staged frontier (subplan fill then
+  subplan hit) with identical results.
+- TPC-H byte identity cache-on vs cache-off — including under seeded
+  chaos and DynamicCluster churn; a hit after every worker departs
+  still answers (the fast path never consults the cluster).
+- 8-thread serving stampede: concurrent identical submissions
+  single-flight into ONE execution (fills == 1), everyone gets the
+  same bytes.
+
+Runs under DFTPU_LOCK_CHECK=1 + DFTPU_LEAK_CHECK=strict (conftest arms
+both when this file is targeted): the single-flight Condition and the
+cache's unattributed store entries are exactly what those harnesses
+police.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from datafusion_distributed_tpu.io.parquet import arrow_to_table
+from datafusion_distributed_tpu.plan import physical as phys
+from datafusion_distributed_tpu.runtime.chaos import (
+    FaultPlan,
+    MembershipEvent,
+    one_crash_per_stage,
+    wrap_cluster,
+)
+from datafusion_distributed_tpu.runtime.coordinator import (
+    Coordinator,
+    DynamicCluster,
+    InMemoryCluster,
+)
+from datafusion_distributed_tpu.runtime.result_cache import ResultCache
+
+CHAOS_SEED = int(os.environ.get("DFTPU_CHAOS_SEED", "20260803"))
+FAST = {"task_retry_backoff_s": 0.001}
+
+Q6_TPL = """
+select sum(l_extendedprice * l_discount) as revenue
+from lineitem
+where l_shipdate >= date '1994-01-01'
+  and l_shipdate < date '1995-01-01'
+  and l_discount between {lo} and {hi}
+  and l_quantity < 24
+"""
+
+_QDIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmarks", "queries", "tpch")
+
+
+def _q(name: str) -> str:
+    with open(os.path.join(_QDIR, f"{name}.sql")) as f:
+        return f.read()
+
+
+TPCH = {"q1": _q("q1"), "q3": _q("q3"), "q5": _q("q5")}
+
+
+def _fresh_ctx(cache: bool = True, **opts):
+    from datafusion_distributed_tpu.data.tpchgen import gen_tpch
+    from datafusion_distributed_tpu.sql.context import SessionContext
+
+    ctx = SessionContext()
+    ctx.config.distributed_options["bytes_per_task"] = 1
+    ctx.config.distributed_options["broadcast_joins"] = False
+    ctx.config.distributed_options["result_cache"] = cache
+    ctx.config.distributed_options.update(opts)
+    for name, arrow in gen_tpch(sf=0.002, seed=7).items():
+        ctx.register_arrow(name, arrow)
+    return ctx
+
+
+def _run(ctx, sql, cluster, **opts):
+    df = ctx.sql(sql)
+    coord = Coordinator(resolver=cluster, channels=cluster,
+                        config_options={**FAST, **opts})
+    out = df.collect_coordinated_table(coordinator=coord, num_tasks=4)
+    return df._strip_quals(out).to_pandas(), coord
+
+
+def _assert_frames_identical(got, base, label=""):
+    assert list(got.columns) == list(base.columns), label
+    for col in base.columns:
+        g, b = got[col].to_numpy(), base[col].to_numpy()
+        assert len(g) == len(b), (label, col)
+        if b.dtype.kind == "f":
+            # bit-exact, not just value-equal: the cache must hand back
+            # the exact float payload the cold run produced
+            assert np.array_equal(
+                g.view(f"u{g.dtype.itemsize}"),
+                b.view(f"u{b.dtype.itemsize}"),
+            ), (label, col)
+        else:
+            assert np.array_equal(g, b), (label, col)
+
+
+def _table(n: int, seed: int):
+    rng = np.random.default_rng(seed)
+    return arrow_to_table(pa.table({
+        "k": pa.array(np.arange(n, dtype=np.int64)),
+        "v": pa.array(rng.random(n)),
+    }))
+
+
+def _same_bytes(a, b) -> bool:
+    if a.names != b.names or a.num_rows != b.num_rows:
+        return False
+    for ca, cb in zip(a.columns, b.columns):
+        if not np.array_equal(np.asarray(ca.data).view(np.uint8),
+                              np.asarray(cb.data).view(np.uint8)):
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Unit tier: ResultCache directly (hit / miss / single-flight / LRU /
+# spill-refault / clear)
+# ---------------------------------------------------------------------------
+
+def test_unit_hit_miss_fill():
+    rc = ResultCache()
+    t = _table(256, 0)
+    state, got = rc.begin(("k1",))
+    assert state == "miss" and got is None
+    rc.fill(("k1",), t)
+    state, got = rc.begin(("k1",))
+    assert state == "hit" and _same_bytes(got, t)
+    assert rc.lookup(("k2",)) is None
+    st = rc.stats()
+    assert st["hits"] == 1 and st["misses"] == 1 and st["fills"] == 1
+    assert rc.clear() >= 1 and rc.stats()["entries"] == 0
+
+
+def test_unit_fail_releases_flight():
+    rc = ResultCache()
+    state, _ = rc.begin(("k",))
+    assert state == "miss"
+    rc.fail(("k",))  # owner aborted: the key must be re-claimable
+    state, _ = rc.begin(("k",))
+    assert state == "miss"
+    rc.fill(("k",), _table(16, 1))
+    assert rc.lookup(("k",)) is not None
+    rc.clear()
+
+
+def test_unit_lru_spills_coldest_and_refaults_byte_exact():
+    from datafusion_distributed_tpu.runtime.tracing import table_nbytes
+
+    t1, t2, t3 = _table(4096, 1), _table(4096, 2), _table(4096, 3)
+    per = table_nbytes(t1)
+    rc = ResultCache()
+    # budget fits ~two entries resident: filling the third must spill
+    # the coldest, not drop it
+    rc.sync(generation=0, budget_bytes=int(per * 2.5))
+    for key, t in ((("k1",), t1), (("k2",), t2)):
+        assert rc.begin(key)[0] == "miss"
+        rc.fill(key, t)
+    assert rc.lookup(("k1",)) is not None  # touch: k2 becomes coldest
+    assert rc.begin(("k3",))[0] == "miss"
+    rc.fill(("k3",), t3)
+    st = rc.stats()
+    assert st["spills"] >= 1 and st["spilled_nbytes"] > 0, st
+    # recency protected k1: reading it back refaults nothing new
+    r0 = rc.stats()["refaults"]
+    assert _same_bytes(rc.lookup(("k1",)), t1)
+    assert rc.stats()["refaults"] == r0
+    # the spilled victim (k2) refaults byte-exactly
+    assert _same_bytes(rc.lookup(("k2",)), t2)
+    assert rc.stats()["refaults"] > r0
+    assert _same_bytes(rc.lookup(("k3",)), t3)
+    rc.clear()
+    st = rc.stats()
+    assert st["entries"] == 0 and st["spill_files"] == 0, st
+
+
+def test_unit_single_flight_stampede():
+    rc = ResultCache()
+    t = _table(64, 4)
+    ready = threading.Barrier(9)
+    results: list = []
+
+    def owner():
+        state, _ = rc.begin(("k",))
+        assert state == "miss"
+        ready.wait()
+        rc.fill(("k",), t)
+
+    def waiter():
+        ready.wait()
+        state, got = rc.begin(("k",))
+        results.append((state, got))
+
+    threads = [threading.Thread(target=owner)] + [
+        threading.Thread(target=waiter) for _ in range(8)
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert len(results) == 8
+    assert all(s == "hit" and _same_bytes(g, t) for s, g in results)
+    assert rc.stats()["fills"] == 1
+    rc.clear()
+
+
+def test_unit_generation_invalidation():
+    rc = ResultCache()
+    rc.sync(generation=1)
+    rc.begin(("k",))
+    rc.fill(("k",), _table(32, 5))
+    rc.invalidate_generation(2)
+    assert rc.lookup(("k",)) is None
+    st = rc.stats()
+    assert st["invalidations"] == 1 and st["entries"] == 0
+    rc.invalidate_generation(2)  # same generation: no-op
+    assert rc.stats()["invalidations"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Integration tier: SessionContext + coordinator path
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cached_ctx():
+    return _fresh_ctx(cache=True)
+
+
+def test_whole_result_hit_byte_identical_and_zero_traces(cached_ctx):
+    ctx = cached_ctx
+    sql = Q6_TPL.format(lo=0.05, hi=0.07)
+    cold, _ = _run(ctx, sql, InMemoryCluster(2))
+    st0 = ctx.result_cache().stats()
+    t0 = phys.trace_count()
+    warm, _ = _run(ctx, sql, InMemoryCluster(2))
+    assert phys.trace_count() == t0, "a cache hit traced something new"
+    _assert_frames_identical(warm, cold, "q6-warm")
+    st1 = ctx.result_cache().stats()
+    assert st1["hits"] == st0["hits"] + 1
+    assert st1["fills"] == st0["fills"]
+
+
+def test_literal_variants_get_their_own_entries(cached_ctx):
+    ctx = cached_ctx
+    li = None
+    results = {}
+    for lo, hi in ((0.02, 0.04), (0.05, 0.07)):
+        got, _ = _run(ctx, Q6_TPL.format(lo=lo, hi=hi),
+                      InMemoryCluster(2))
+        results[(lo, hi)] = got
+    # repeats of each variant hit, and each returns ITS answer
+    for (lo, hi), first in results.items():
+        again, _ = _run(ctx, Q6_TPL.format(lo=lo, hi=hi),
+                        InMemoryCluster(2))
+        _assert_frames_identical(again, first, f"variant-{lo}")
+    li = ctx.catalog.tables["lineitem"].to_pandas()
+    for (lo, hi), got in results.items():
+        m = (
+            (li.l_shipdate.to_numpy().astype("datetime64[D]")
+             >= np.datetime64("1994-01-01", "D"))
+            & (li.l_shipdate.to_numpy().astype("datetime64[D]")
+               < np.datetime64("1995-01-01", "D"))
+            & (li.l_discount.to_numpy() >= lo - 1e-9)
+            & (li.l_discount.to_numpy() <= hi + 1e-9)
+            & (li.l_quantity.to_numpy() < 24)
+        )
+        exp = float((li.l_extendedprice.to_numpy()[m]
+                     * li.l_discount.to_numpy()[m]).sum())
+        assert np.isclose(float(got["revenue"][0]), exp,
+                          rtol=1e-3, atol=1e-2), (lo, hi)
+
+
+def test_planner_config_snapshot_keys_the_cache(cached_ctx):
+    ctx = cached_ctx
+    sql = Q6_TPL.format(lo=0.03, hi=0.05)
+    base, _ = _run(ctx, sql, InMemoryCluster(2))
+    fills0 = ctx.result_cache().stats()["fills"]
+    prev = ctx.config.planner.agg_slot_factor
+    ctx.config.planner.agg_slot_factor = prev * 2
+    try:
+        got, _ = _run(ctx, sql, InMemoryCluster(2))
+    finally:
+        ctx.config.planner.agg_slot_factor = prev
+    assert ctx.result_cache().stats()["fills"] == fills0 + 1, (
+        "a PlannerConfig change must MISS, not serve the old plan's rows"
+    )
+    _assert_frames_identical(got, base, "pcfg-variant")
+    # restoring the config hits the original entry again
+    h0 = ctx.result_cache().stats()["hits"]
+    _run(ctx, sql, InMemoryCluster(2))
+    assert ctx.result_cache().stats()["hits"] == h0 + 1
+
+
+def test_register_table_invalidates_no_stale_reads():
+    from datafusion_distributed_tpu.sql.context import SessionContext
+
+    ctx = SessionContext()
+    ctx.config.distributed_options["bytes_per_task"] = 1
+    ctx.config.distributed_options["result_cache"] = True
+    n = 512
+    ctx.register_arrow("t", pa.table({
+        "k": pa.array((np.arange(n) % 7).astype(np.int64)),
+        "v": pa.array(np.ones(n)),
+    }))
+    sql = "select k, sum(v) as s from t group by k order by k"
+    first, _ = _run(ctx, sql, InMemoryCluster(2))
+    assert float(first["s"].sum()) == float(n)
+    _run(ctx, sql, InMemoryCluster(2))  # warm hit
+    inv0 = ctx.result_cache().stats()["invalidations"]
+    ctx.register_arrow("t", pa.table({
+        "k": pa.array((np.arange(n) % 7).astype(np.int64)),
+        "v": pa.array(np.full(n, 2.0)),
+    }))
+    st = ctx.result_cache().stats()
+    assert st["invalidations"] > inv0
+    assert st["entries"] == 0 and st["subplan_entries"] == 0
+    second, _ = _run(ctx, sql, InMemoryCluster(2))
+    assert float(second["s"].sum()) == float(2 * n), (
+        "stale cached rows served after register_table"
+    )
+
+
+def test_knob_flip_zero_new_traces():
+    ctx = _fresh_ctx(cache=False)
+    sql = Q6_TPL.format(lo=0.05, hi=0.07)
+    base, _ = _run(ctx, sql, InMemoryCluster(2))
+    assert ctx.result_cache() is None
+    t0 = phys.trace_count()
+    ctx.config.distributed_options["result_cache"] = True
+    miss, _ = _run(ctx, sql, InMemoryCluster(2))  # warm programs: fill
+    hit, _ = _run(ctx, sql, InMemoryCluster(2))
+    assert phys.trace_count() == t0, (
+        "flipping result_cache on traced something new"
+    )
+    _assert_frames_identical(miss, base, "flip-miss")
+    _assert_frames_identical(hit, base, "flip-hit")
+
+
+# ---------------------------------------------------------------------------
+# Sub-plan tier: shared exchange-subtree prefix across distinct queries
+# ---------------------------------------------------------------------------
+
+def test_subplan_prefix_reuse_across_distinct_queries():
+    from datafusion_distributed_tpu.sql.context import SessionContext
+
+    ctx = SessionContext()
+    opts = ctx.config.distributed_options
+    opts["bytes_per_task"] = 1
+    opts["result_cache"] = True
+    # size_tasks_to_data collapses sf-tiny inputs to single-task plans
+    # with no exchanges at all, and pipelined boundaries materialize as
+    # StreamScanExec (not cacheable) — force the materialized multi-task
+    # shape the sub-plan tier keys on
+    opts["size_tasks_to_data"] = False
+    opts["pipelined_shuffle"] = False
+    n = 50_000
+    rng = np.random.default_rng(3)
+    ctx.register_arrow("t", pa.table({
+        "k": pa.array((np.arange(n) % 97).astype(np.int64)),
+        "v": pa.array(rng.random(n)),
+    }))
+    asc, _ = _run(ctx, "select k, sum(v) as s from t group by k "
+                       "order by k", InMemoryCluster(2))
+    st = ctx.result_cache().stats()
+    assert st["subplan_fills"] >= 1, (
+        "the shared scan+partial-agg+shuffle prefix never filled", st
+    )
+    desc, _ = _run(ctx, "select k, sum(v) as s from t group by k "
+                        "order by k desc", InMemoryCluster(2))
+    st = ctx.result_cache().stats()
+    assert st["subplan_hits"] >= 1, (
+        "the second query re-executed a cached exchange prefix", st
+    )
+    _assert_frames_identical(
+        desc.sort_values("k").reset_index(drop=True),
+        asc.sort_values("k").reset_index(drop=True),
+        "subplan-prefix",
+    )
+
+
+# ---------------------------------------------------------------------------
+# TPC-H byte identity: cache-on vs cache-off, chaos, churn
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("qname", sorted(TPCH))
+def test_tpch_byte_identity_cache_on_vs_off(qname):
+    off = _fresh_ctx(cache=False)
+    base, _ = _run(off, TPCH[qname], InMemoryCluster(4),
+                   stage_parallelism=4)
+    on = _fresh_ctx(cache=True)
+    cold, _ = _run(on, TPCH[qname], InMemoryCluster(4),
+                   stage_parallelism=4)
+    warm, _ = _run(on, TPCH[qname], InMemoryCluster(4),
+                   stage_parallelism=4)
+    _assert_frames_identical(cold, base, f"{qname}-cold")
+    _assert_frames_identical(warm, base, f"{qname}-warm")
+    assert on.result_cache().stats()["hits"] >= 1
+
+
+def test_tpch_byte_identity_under_chaos():
+    off = _fresh_ctx(cache=False)
+    base, _ = _run(off, TPCH["q3"], InMemoryCluster(4),
+                   stage_parallelism=4)
+    on = _fresh_ctx(cache=True)
+    on.config.distributed_options["max_task_retries"] = 8
+    cluster = InMemoryCluster(4)
+    chaos = wrap_cluster(cluster, one_crash_per_stage(CHAOS_SEED))
+    cold, _ = _run(on, TPCH["q3"], chaos, stage_parallelism=4)
+    assert chaos.plan.fired, "chaos schedule never fired"
+    warm, _ = _run(on, TPCH["q3"], InMemoryCluster(4),
+                   stage_parallelism=4)
+    _assert_frames_identical(cold, base, "q3-chaos-cold")
+    _assert_frames_identical(warm, base, "q3-chaos-warm")
+
+
+def test_hit_survives_total_worker_departure():
+    """Churn hardening: fill under a mid-query leave, then depart EVERY
+    worker — the warm submission must still answer identically (a hit
+    never consults the cluster; `get_worker` on a departed url raises,
+    so any consultation fails loudly)."""
+    off = _fresh_ctx(cache=False)
+    base, _ = _run(off, TPCH["q1"], InMemoryCluster(4),
+                   stage_parallelism=4)
+    on = _fresh_ctx(cache=True)
+    on.config.distributed_options["max_task_retries"] = 8
+    cluster = DynamicCluster(4)
+    victim = cluster.get_urls()[-1]
+    chaos = wrap_cluster(cluster, FaultPlan(CHAOS_SEED, [], membership=[
+        MembershipEvent("leave", victim, site="execute", nth_call=1),
+    ]))
+    cold, _ = _run(on, TPCH["q1"], chaos, stage_parallelism=4)
+    _assert_frames_identical(cold, base, "q1-churn-cold")
+    for url in list(cluster.get_urls()):
+        cluster.remove_worker(url)
+    assert cluster.get_urls() == []
+    warm, _ = _run(on, TPCH["q1"], cluster, stage_parallelism=4)
+    _assert_frames_identical(warm, base, "q1-departed-warm")
+
+
+# ---------------------------------------------------------------------------
+# Serving tier: stampede single-flight + fast-path stats
+# ---------------------------------------------------------------------------
+
+def test_serving_stampede_executes_once():
+    from datafusion_distributed_tpu.runtime.serving import ServingSession
+
+    ctx = _fresh_ctx(cache=True)
+    sql = Q6_TPL.format(lo=0.05, hi=0.07)
+    results: list = []
+    errors: list = []
+    with ServingSession(ctx, num_workers=4, num_tasks=4,
+                        max_concurrent_queries=8) as srv:
+        start = threading.Barrier(8)
+
+        def client():
+            try:
+                start.wait()
+                h = srv.submit(sql)
+                results.append(h.result(timeout=600).to_pandas())
+            except BaseException as e:  # pragma: no cover - diagnostics
+                errors.append(f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=client) for _ in range(8)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert not errors, errors
+        st = srv.stats()["result_cache"]
+    assert st["fills"] == 1, (
+        "concurrent identical submissions executed more than once", st
+    )
+    assert len(results) == 8
+    for got in results[1:]:
+        _assert_frames_identical(got, results[0], "stampede")
+
+
+def test_serving_fast_path_skips_admission_charge():
+    from datafusion_distributed_tpu.runtime.serving import ServingSession
+
+    ctx = _fresh_ctx(cache=True)
+    sql = Q6_TPL.format(lo=0.05, hi=0.07)
+    with ServingSession(ctx, num_workers=2, num_tasks=4) as srv:
+        cold = srv.submit(sql).result(timeout=600).to_pandas()
+        h = srv.submit(sql)
+        warm = h.result(timeout=600).to_pandas()
+        assert h._cache_hit and h.est_bytes == 0, (
+            "a cache-served query reserved admission budget"
+        )
+        st = srv.stats()
+        assert st["in_use_bytes"] == 0 and st["queued_bytes"] == 0
+        assert st["result_cache"]["hits"] >= 1
+    _assert_frames_identical(warm, cold, "fast-path")

@@ -171,6 +171,9 @@ def compare(baseline: dict, current: dict, threshold: float = 0.10,
         "straggler_p99_ms_on": False,
         "slo_latency_attainment": True,
         "peak_staged_bytes": False,
+        "burst_p99_ms_cache_off": False,
+        "burst_p99_ms_cache_on": False,
+        "cache_hit_rate": True,
     }
     for name, hib in serving_metrics.items():
         if bs.get(name) is not None and cs.get(name) is not None:
